@@ -1,0 +1,44 @@
+"""Root pytest configuration.
+
+Tests run on a virtual 8-device CPU mesh so that every sharding/pjit path is
+exercised without TPU hardware (the driver separately dry-runs the multi-chip
+path; see ``__graft_entry__.py``).  This mirrors the reference's precision gate
+(`conftest.py:50` refuses to run without true longdouble): we instead require
+float64 (jax_enable_x64), which the package enables at import.
+"""
+
+import os
+
+# Must be set before the CPU backend client is created.  NOTE: this image
+# preloads a TPU ("axon") PJRT plugin via sitecustomize, whose emulated f64
+# is not IEEE-correctly-rounded; tests must run on the true-IEEE CPU backend.
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+try:  # hide the axon/TPU backend from the test session entirely
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+from hypothesis import HealthCheck, settings  # noqa: E402
+
+# jax op dispatch is slow per-call; deadlines are meaningless here (the
+# reference tunes hypothesis similarly in its conftest profiles).
+settings.register_profile(
+    "pint_tpu",
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+settings.load_profile("pint_tpu")
+
+
+def pytest_report_header(config):
+    import jax
+
+    return f"jax devices: {jax.device_count()} ({jax.default_backend()})"

@@ -1,0 +1,106 @@
+"""pint_tpu — a TPU-native pulsar timing framework.
+
+A ground-up JAX/XLA re-architecture with the capabilities of PINT (the
+NANOGrav pulsar-timing package; reference layout surveyed in /root/repo/SURVEY.md):
+TOA loading and clock correction, solar-system barycentering, a composable
+physical timing model, phase residuals, and WLS/GLS/downhill/wideband fitting.
+
+Design stance (vs the reference, see SURVEY.md §7):
+
+* Times live on device as two-float ``(day:int, frac:float64)`` pairs
+  (:mod:`pint_tpu.timescales`), and absolute pulse phase is accumulated in
+  double-double arithmetic (:mod:`pint_tpu.dd`) — replacing the reference's
+  ``np.longdouble`` (80-bit) dependency, which XLA/TPU does not have.
+* Model components are pure jittable functions of ``(params, TOABatch)``;
+  design matrices come from autodiff (jacfwd) rather than thousands of lines
+  of hand-written derivatives (reference `src/pint/models/timing_model.py:2157`).
+* Fits are jitted linear-algebra kernels (QR/Cholesky/eigh — chosen for
+  float64 support on TPU) vmapped over grid points and pulsar ensembles, and
+  shard_mapped over a `jax.sharding.Mesh` for multi-chip scale-out
+  (replacing the reference's ProcessPoolExecutor, `src/pint/gridutils.py:322`).
+
+Physical constants follow the reference's choices
+(`src/pint/__init__.py:56-106`): IAU/tempo conventions.
+"""
+
+import jax
+
+# Pulsar timing is meaningless in float32: absolute phase needs ~21 significant
+# digits (handled by double-double on top of f64). Enable x64 before anything
+# else in the package builds jitted functions.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+# --- fundamental constants (SI) ----------------------------------------------
+#: speed of light [m/s] (exact, SI definition)
+c = 299792458.0
+#: astronomical unit [m] (IAU 2012 exact)
+AU = 149597870700.0
+#: light-second [m]
+ls = c
+#: Julian year [s]
+JULIAN_YEAR = 365.25 * 86400.0
+#: seconds per day
+SECS_PER_DAY = 86400.0
+#: days per Julian century / millennium
+DAYS_PER_CENTURY = 36525.0
+#: MJD of the J2000.0 epoch (TT): 2000 Jan 1.5 TT
+MJD_J2000 = 51544.5
+
+# --- tempo/pulsar conventions -------------------------------------------------
+#: Dispersion constant, tempo convention (reference `src/pint/__init__.py:90`):
+#: delay[s] = DM[pc/cm^3] / (2.41e-4 * freq[MHz]^2).  This is *defined* as
+#: 1/2.41e-4 exactly, not the more precise physical e^2/(2 pi m_e c) value,
+#: for compatibility with tempo/tempo2.
+DMconst = 1.0 / 2.41e-4  # s MHz^2 cm^3 / pc
+
+#: GM_sun / c^3 [s] — solar mass in time units (IAU 2009 GM_sun)
+GMsun = 1.32712440018e20  # m^3/s^2
+Tsun = GMsun / c**3  # 4.92549094765e-06 s
+
+# Planetary GM values [m^3/s^2] (IAU/DE421-era values, as used for Shapiro
+# delays; reference `src/pint/__init__.py:92-106` uses the same bodies).
+GM_BODY = {
+    "sun": GMsun,
+    "mercury": 2.2032e13,
+    "venus": 3.24858592e14,
+    "earth": 3.986004418e14,
+    "moon": 4.9028e12,
+    "mars": 4.282837e13,
+    "jupiter": 1.26686534e17,
+    "saturn": 3.7931187e16,
+    "uranus": 5.793939e15,
+    "neptune": 6.836529e15,
+    "pluto": 8.71e11,
+}
+#: T_body = GM/c^3 [s] for Shapiro delay per body
+T_BODY = {k: v / c**3 for k, v in GM_BODY.items()}
+
+#: parsec [m] (exact from au and arcsec definition)
+PARSEC = AU * 3600.0 * 180.0 / 3.141592653589793
+#: kilometer per second in AU/day, etc. left to pint_tpu.units
+
+#: mean obliquity of the ecliptic at J2000, IERS 2010 [arcsec]
+OBLIQUITY_J2000_ARCSEC = 84381.406
+
+# Re-exports of the most-used API surface (kept lazy-ish: these modules only
+# depend on jax/numpy).
+from pint_tpu.dd import DD  # noqa: E402,F401
+from pint_tpu.phase import Phase  # noqa: E402,F401
+
+__all__ = [
+    "c",
+    "AU",
+    "ls",
+    "DMconst",
+    "Tsun",
+    "GM_BODY",
+    "T_BODY",
+    "PARSEC",
+    "SECS_PER_DAY",
+    "MJD_J2000",
+    "DD",
+    "Phase",
+    "__version__",
+]

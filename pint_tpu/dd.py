@@ -1,0 +1,338 @@
+"""Double-word arithmetic as backend-generic error-free transforms.
+
+This module is part of the precision foundation of pint_tpu.  The reference
+package leans on ``np.longdouble`` (x87 80-bit) everywhere absolute pulse
+phase is computed (reference `src/pint/pulsar_mjd.py:529-637` implements the
+same error-free transforms for its two-float day/fraction arithmetic, and
+`src/pint/phase.py:7` splits phase into integer+fraction for the same reason).
+XLA/TPU has no float128, so extended precision is built from unevaluated
+multi-word float sums using the classic error-free transforms (Dekker 1971;
+Knuth TAOCP v2; Hida, Li & Bailey's QD algorithms).
+
+Hardware reality (measured, see ``tests/test_dd.py``):
+
+* **float32 is correctly-rounded IEEE on TPU** (subnormals flush to zero) —
+  error-free transforms hold exactly.
+* **float64 on TPU is software-emulated and NOT correctly rounded** (~48-bit
+  double-f32 emulation), so DD-over-f64 must not be used in on-device
+  precision-critical paths.  It *is* valid on CPU (host precompute, tests),
+  where f64 is true IEEE.
+
+Consequently this module is deliberately backend- and dtype-generic: the
+algorithms use only ``+ - *`` plus a dtype-aware Dekker split constant, so
+they run unchanged on numpy float64 arrays (host, ~106-bit DD), jax float64
+on the CPU backend, and jax float32 on TPU (~48-bit DD; quadruple-word f32 in
+:mod:`pint_tpu.qs` provides the ~90-bit path used for absolute phase
+on device).
+
+Everything is branch-free and shape-polymorphic: a ``DD`` is a NamedTuple of
+two equal-shaped arrays, so it is automatically a JAX pytree and flows
+through ``jit``/``vmap``/``grad``/``scan`` untouched.
+
+Verified against mpmath in ``tests/test_dd.py`` (hypothesis fuzzing),
+mirroring the reference's precision tests (`tests/test_precision.py`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import numpy as np
+
+Arrayish = Union[float, np.ndarray]
+
+# Dekker splitting constants: 2^ceil(p/2) + 1 for p-bit significands.
+_SPLIT_F64 = 134217729.0  # 2^27 + 1
+_SPLIT_F32 = 4097.0  # 2^12 + 1
+
+
+def _split_const(a):
+    dt = getattr(a, "dtype", None)
+    if dt is not None and dt == np.float32:
+        return np.float32(_SPLIT_F32)
+    return _SPLIT_F64
+
+
+def two_sum(a, b):
+    """Error-free sum: returns (s, e) with s = fl(a+b) and a+b = s+e exactly."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def quick_two_sum(a, b):
+    """Error-free sum assuming |a| >= |b|: (s, e) with a+b = s+e exactly."""
+    s = a + b
+    e = b - (s - a)
+    return s, e
+
+
+def split(a):
+    """Dekker split into high/low half-width parts (exact)."""
+    t = _split_const(a) * a
+    hi = t - (t - a)
+    lo = a - hi
+    return hi, lo
+
+
+def two_prod(a, b):
+    """Error-free product: (p, e) with p = fl(a*b) and a*b = p+e exactly."""
+    p = a * b
+    ahi, alo = split(a)
+    bhi, blo = split(b)
+    e = ((ahi * bhi - p) + ahi * blo + alo * bhi) + alo * blo
+    return p, e
+
+
+class DD(NamedTuple):
+    """A double-word number: value = hi + lo, |lo| <= ulp(hi)/2.
+
+    NamedTuple => automatically a JAX pytree; broadcastable like its leaves.
+    """
+
+    hi: Arrayish
+    lo: Arrayish
+
+    def __add__(self, other):
+        return add(self, _coerce(other, self))
+
+    def __radd__(self, other):
+        return add(_coerce(other, self), self)
+
+    def __sub__(self, other):
+        return sub(self, _coerce(other, self))
+
+    def __rsub__(self, other):
+        return sub(_coerce(other, self), self)
+
+    def __mul__(self, other):
+        return mul(self, _coerce(other, self))
+
+    def __rmul__(self, other):
+        return mul(_coerce(other, self), self)
+
+    def __truediv__(self, other):
+        return div(self, _coerce(other, self))
+
+    def __neg__(self):
+        return DD(-self.hi, -self.lo)
+
+    @property
+    def shape(self):
+        return np.shape(self.hi)
+
+    def astype_float(self):
+        return self.hi + self.lo
+
+
+def _coerce(x, like: DD) -> DD:
+    if isinstance(x, DD):
+        return x
+    z = like.hi * 0
+    return DD(z + x, z)
+
+
+def from_float(x) -> DD:
+    """Promote a float array/scalar to DD exactly (lo = 0)."""
+    return DD(x, x * 0)
+
+
+def from_two(hi, lo) -> DD:
+    """Build a normalized DD from an unnormalized two-float sum hi+lo."""
+    s, e = two_sum(hi, lo)
+    return DD(s, e)
+
+
+def from_string(s: str):
+    """Host-side: parse a decimal string to an exact (hi, lo) float64 pair."""
+    from decimal import Decimal, getcontext
+
+    getcontext().prec = 50
+    d = Decimal(s)
+    hi = float(d)
+    lo = float(d - Decimal(hi))
+    return DD(np.float64(hi), np.float64(lo))
+
+
+def to_float(x: DD):
+    return x.hi + x.lo
+
+
+def normalize(x: DD) -> DD:
+    s, e = quick_two_sum(x.hi, x.lo)
+    return DD(s, e)
+
+
+def add(x: DD, y: DD) -> DD:
+    """DD + DD (QD 'ieee_add' accurate variant)."""
+    s1, s2 = two_sum(x.hi, y.hi)
+    t1, t2 = two_sum(x.lo, y.lo)
+    s2 = s2 + t1
+    s1, s2 = quick_two_sum(s1, s2)
+    s2 = s2 + t2
+    s1, s2 = quick_two_sum(s1, s2)
+    return DD(s1, s2)
+
+
+def add_f(x: DD, f) -> DD:
+    """DD + float."""
+    s1, s2 = two_sum(x.hi, f)
+    s2 = s2 + x.lo
+    s1, s2 = quick_two_sum(s1, s2)
+    return DD(s1, s2)
+
+
+def sub(x: DD, y: DD) -> DD:
+    return add(x, DD(-y.hi, -y.lo))
+
+
+def mul(x: DD, y: DD) -> DD:
+    """DD * DD."""
+    p1, p2 = two_prod(x.hi, y.hi)
+    p2 = p2 + (x.hi * y.lo + x.lo * y.hi)
+    p1, p2 = quick_two_sum(p1, p2)
+    return DD(p1, p2)
+
+
+def mul_f(x: DD, f) -> DD:
+    """DD * float."""
+    p1, p2 = two_prod(x.hi, f)
+    p2 = p2 + x.lo * f
+    p1, p2 = quick_two_sum(p1, p2)
+    return DD(p1, p2)
+
+
+def prod_ff(a, b) -> DD:
+    """float * float -> exact DD."""
+    p, e = two_prod(a, b)
+    return DD(p, e)
+
+
+def sum_ff(a, b) -> DD:
+    """float + float -> exact DD."""
+    s, e = two_sum(a, b)
+    return DD(s, e)
+
+
+def div(x: DD, y: DD) -> DD:
+    """DD / DD via Newton-corrected long division (QD algorithm)."""
+    q1 = x.hi / y.hi
+    r = add(x, -mul_f(y, q1))
+    q2 = r.hi / y.hi
+    r = add(r, -mul_f(y, q2))
+    q3 = r.hi / y.hi
+    q1_, q2_ = quick_two_sum(q1, q2)
+    return add_f(DD(q1_, q2_), q3)
+
+
+def neg(x: DD) -> DD:
+    return DD(-x.hi, -x.lo)
+
+
+def sq(x: DD) -> DD:
+    return mul(x, x)
+
+
+def scale_pow2(x: DD, k) -> DD:
+    """Exact multiply by a power of two."""
+    return DD(x.hi * k, x.lo * k)
+
+
+def _xp(x):
+    """numpy-or-jax dispatch for the few non-arithmetic ops (round/floor)."""
+    try:
+        import jax
+
+        if isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer):
+            import jax.numpy as jnp
+
+            return jnp
+    except Exception:
+        pass
+    return np
+
+
+def round_nearest(x: DD):
+    """Round-to-nearest-integer of a DD; returns (n: exact-int float, frac: DD).
+
+    n is the nearest integer to hi+lo and frac = x - n (|frac| <= 0.5).
+    This is the pulse-number split: the reference keeps (int, frac) Phase
+    pairs for exactly this reason (`src/pint/phase.py:7`).
+    """
+    xp = _xp(x.hi)
+    n = xp.round(x.hi)
+    r = add_f(x, -n)
+    adj = xp.round(r.hi + r.lo)
+    n = n + adj
+    r = add_f(r, -adj)
+    return n, r
+
+
+def floor(x: DD):
+    """Floor of a DD; returns (n: exact-int float, frac: DD in [0,1))."""
+    xp = _xp(x.hi)
+    n = xp.floor(x.hi)
+    r = add_f(x, -n)
+    adj = xp.floor(r.hi + r.lo)
+    n = n + adj
+    r = add_f(r, -adj)
+    return n, r
+
+
+def horner(dt: DD, coeffs) -> DD:
+    """Evaluate sum_k coeffs[k] * dt^k / k!  in DD (Taylor-Horner).
+
+    Equivalent of the reference's `taylor_horner` (`src/pint/utils.py:415`),
+    which it evaluates in longdouble.  ``coeffs`` is a sequence of scalars /
+    arrays (float or DD), lowest order first, WITHOUT factorial division —
+    i.e. this computes c0 + c1 dt + c2 dt^2/2! + ...
+    """
+    n = len(coeffs)
+    if n == 0:
+        return from_float(dt.hi * 0)
+    fact = 1.0
+    facts = []
+    for k in range(n):
+        facts.append(fact)
+        fact *= k + 1
+    acc = _as_dd(coeffs[-1], dt)
+    if facts[n - 1] != 1.0:
+        acc = mul_f(acc, 1.0 / facts[n - 1])
+    for k in range(n - 2, -1, -1):
+        ck = _as_dd(coeffs[k], dt)
+        if facts[k] != 1.0:
+            ck = mul_f(ck, 1.0 / facts[k])
+        acc = add(mul(acc, dt), ck)
+    return acc
+
+
+def horner_plain(dt: DD, coeffs) -> DD:
+    """Plain Horner: c0 + c1 dt + c2 dt^2 + ... in DD."""
+    n = len(coeffs)
+    if n == 0:
+        return from_float(dt.hi * 0)
+    acc = _as_dd(coeffs[-1], dt)
+    for k in range(n - 2, -1, -1):
+        acc = add(mul(acc, dt), _as_dd(coeffs[k], dt))
+    return acc
+
+
+def _as_dd(x, like: DD) -> DD:
+    return x if isinstance(x, DD) else _coerce(x, like)
+
+
+def where(cond, x: DD, y: DD) -> DD:
+    xp = _xp(x.hi)
+    return DD(xp.where(cond, x.hi, y.hi), xp.where(cond, x.lo, y.lo))
+
+
+def self_check() -> bool:
+    """Verify error-free transforms hold on host numpy (true IEEE f64)."""
+    a = np.float64(999999999999999.0)
+    b = np.float64(-878345505234691.4)
+    s, e = two_sum(a, b)
+    ok = float(s) + float(e) == float(a) + float(b) and float(s) == float(a + b)
+    p, ep = two_prod(np.float64(1.0 + 2.0**-30), np.float64(1.0 + 2.0**-31))
+    ok &= ep != 0.0 or p == (1.0 + 2.0**-30) * (1.0 + 2.0**-31)
+    return bool(ok)

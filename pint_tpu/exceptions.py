@@ -1,0 +1,131 @@
+"""Typed exception/warning taxonomy for pint_tpu.
+
+Mirrors the role of the reference's exception module
+(``src/pint/exceptions.py``): every failure mode raised by the framework has a
+named type so callers can catch precisely.
+"""
+
+
+class PintTpuError(Exception):
+    """Base class for all pint_tpu errors."""
+
+
+# --- model / parameter errors -------------------------------------------------
+class TimingModelError(PintTpuError):
+    """Generic error constructing or evaluating a timing model."""
+
+
+class MissingParameter(TimingModelError):
+    """A parameter needed by a component is absent from the model/par file."""
+
+    def __init__(self, module="", param="", msg=None):
+        self.module = module
+        self.param = param
+        super().__init__(msg or f"{module} is missing parameter {param!r}")
+
+
+class MissingBinaryError(TimingModelError):
+    """BINARY was requested but no/unknown binary model given."""
+
+
+class UnknownParameter(TimingModelError):
+    """A par-file line names a parameter no component owns."""
+
+
+class UnknownBinaryModel(TimingModelError):
+    """BINARY value names an unimplemented binary model."""
+
+
+class AliasConflict(TimingModelError):
+    """Two components claim the same parameter alias."""
+
+
+class PrefixError(TimingModelError):
+    """Malformed prefix parameter name (e.g. F0, DMX_0001)."""
+
+
+class InvalidModelParameters(TimingModelError):
+    """Parameter values outside the physical domain (e.g. ECC > 1)."""
+
+
+class ComponentConflict(TimingModelError):
+    """Two mutually exclusive components in one model."""
+
+
+# --- TOA / data errors --------------------------------------------------------
+class TOAError(PintTpuError):
+    """Generic TOA-layer error."""
+
+
+class TimFileError(TOAError):
+    """Malformed .tim file line or command."""
+
+
+# --- observatory / clock ------------------------------------------------------
+class ObservatoryError(PintTpuError):
+    """Unknown observatory or bad observatory definition."""
+
+
+class ClockCorrectionError(PintTpuError):
+    """Base for clock-correction problems."""
+
+
+class NoClockCorrections(ClockCorrectionError):
+    """No clock file available for an observatory."""
+
+
+class ClockCorrectionOutOfRange(ClockCorrectionError):
+    """TOA outside the span of the clock file."""
+
+
+# --- ephemeris ----------------------------------------------------------------
+class EphemerisError(PintTpuError):
+    """Solar-system ephemeris unavailable or out of range."""
+
+
+# --- fitting ------------------------------------------------------------------
+class FitError(PintTpuError):
+    """Base class for fitter failures."""
+
+
+class ConvergenceFailure(FitError):
+    """Iterative fit failed to converge."""
+
+
+class MaxiterReached(ConvergenceFailure):
+    """Downhill fitter hit the iteration cap without meeting tolerance."""
+
+
+class StepProblem(ConvergenceFailure):
+    """No acceptable step length found in line search."""
+
+
+class CorrelatedErrors(FitError):
+    """Fitter cannot handle the model's correlated-noise structure."""
+
+    def __init__(self, model):
+        trouble = [c.__class__.__name__ for c in getattr(model, "noise_components", [])]
+        super().__init__(
+            f"Model has correlated errors ({trouble}); use a GLS-capable fitter"
+        )
+
+
+# --- warnings -----------------------------------------------------------------
+class PintTpuWarning(UserWarning):
+    """Base warning class."""
+
+
+class DegeneracyWarning(PintTpuWarning):
+    """Near-degenerate combination of fit parameters detected (thresholded)."""
+
+
+class ClockCorrectionWarning(PintTpuWarning):
+    """Clock corrections missing/stale but proceeding anyway."""
+
+
+class PrecisionWarning(PintTpuWarning):
+    """An operation may have lost double-double precision."""
+
+
+class ApproximateEphemerisWarning(PintTpuWarning):
+    """Analytic (non-JPL) ephemeris in use; absolute barycentering is ~µs-level."""

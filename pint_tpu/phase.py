@@ -1,0 +1,73 @@
+"""Exact pulse phase as (integer, fractional) pairs.
+
+Equivalent of the reference's ``Phase`` namedtuple (`src/pint/phase.py:7`),
+re-done for JAX: the integer part is stored as an *exact-integer-valued*
+float64 (exact up to 2^53 ≈ 9e15 cycles — pulsar phases are ≲1e12) and the
+fractional part is float64 in [-0.5, 0.5).  Arithmetic re-normalizes so the
+fraction never loses precision to the large integer part.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from pint_tpu import dd as ddm
+from pint_tpu.dd import DD
+
+
+class Phase(NamedTuple):
+    """Pulse phase split as int + frac, frac in [-0.5, 0.5)."""
+
+    int: jnp.ndarray
+    frac: jnp.ndarray
+
+    def __add__(self, other):
+        other = _as_phase(other)
+        return _normalize(self.int + other.int, self.frac + other.frac)
+
+    def __sub__(self, other):
+        other = _as_phase(other)
+        return _normalize(self.int - other.int, self.frac - other.frac)
+
+    def __neg__(self):
+        return Phase(-self.int, -self.frac)
+
+    @property
+    def quantity(self):
+        return self.int + self.frac
+
+    def to_dd(self) -> DD:
+        return ddm.sum_ff(self.int, self.frac)
+
+
+def _as_phase(x) -> "Phase":
+    if isinstance(x, Phase):
+        return x
+    return from_float(x)
+
+
+def _normalize(i, f):
+    i = jnp.asarray(i, jnp.float64)
+    f = jnp.asarray(f, jnp.float64)
+    k = jnp.round(f)
+    return Phase(i + k, f - k)
+
+
+def from_float(x) -> Phase:
+    """Split a float64 phase into (int, frac)."""
+    x = jnp.asarray(x, jnp.float64)
+    i = jnp.round(x)
+    return Phase(i, x - i)
+
+
+def from_dd(x: DD) -> Phase:
+    """Split a double-double phase into (int, frac) with frac error ~1e-32."""
+    n, r = ddm.round_nearest(x)
+    return Phase(n, ddm.to_float(r))
+
+
+def zeros(shape=()) -> Phase:
+    z = jnp.zeros(shape, jnp.float64)
+    return Phase(z, z)

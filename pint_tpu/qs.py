@@ -1,0 +1,288 @@
+"""Quad-single arithmetic: ~90-bit extended precision from float32 words.
+
+Why this exists (measured on the target hardware, see ``tests/test_dd.py`` /
+``tests/test_qs.py``):
+
+* TPU float32 is correctly-rounded IEEE (with flush-to-zero below ~1e-38),
+  so Dekker/Knuth error-free transforms hold **exactly** in f32 on device.
+* TPU float64 is a ~48-bit software emulation that is *not* correctly
+  rounded, so error-free transforms over f64 silently fail on device.
+
+Absolute pulse phase needs ~70+ significant bits (1e12 cycles tracked to
+<1e-9 cycles; the reference uses ``np.longdouble`` for this, e.g.
+`src/pint/models/spindown.py:21` evaluating `taylor_horner` on longdouble
+``tdbld``).  A quadruple-f32 expansion (4 non-overlapping words ≈ 90+ bits)
+is the TPU-native answer; on CPU backends the same code runs on true IEEE
+f32 and is equally exact.
+
+Algorithms are the classic QD/CAMPARY floating-point expansion operations
+(Hida-Li-Bailey 2001; Joldes-Muller-Popescu 2016): two_sum/two_prod building
+blocks from :mod:`pint_tpu.dd`, with branch-free distillation renormalization
+(chained error-free sums) instead of QD's branchy renorm, so everything jits.
+
+Magnitude contract: all intermediate words must stay above the f32 subnormal
+cutoff (~1e-38) or below it only when exactly zero.  Phase-scale quantities
+(1e-12..1e12) satisfy this with room to spare.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from pint_tpu.dd import two_prod, two_sum
+
+_NW = 4  # words
+
+
+class QS(NamedTuple):
+    """A quad-single value = w0 + w1 + w2 + w3 (decreasing, non-overlapping)."""
+
+    w0: object
+    w1: object
+    w2: object
+    w3: object
+
+    @property
+    def words(self):
+        return (self.w0, self.w1, self.w2, self.w3)
+
+    def __add__(self, other):
+        return add(self, other) if isinstance(other, QS) else add_w(self, other)
+
+    def __sub__(self, other):
+        return self + (-other)
+
+    def __neg__(self):
+        return QS(-self.w0, -self.w1, -self.w2, -self.w3)
+
+    def __mul__(self, other):
+        return mul(self, other) if isinstance(other, QS) else mul_w(self, other)
+
+
+def _distill(words: Sequence, passes: int = 3):
+    """Branch-free renormalization: repeated bottom-up error-free summation.
+
+    Input: any list of same-shape words (unordered magnitudes OK if roughly
+    graded).  Output: list of the same length, nearly non-overlapping,
+    largest first.  Three passes are needed in the worst cancellation cases
+    (verified by hypothesis fuzzing in tests/test_qs.py).
+    """
+    ws = list(words)
+    n = len(ws)
+    for _ in range(passes):
+        s = ws[n - 1]
+        out = [None] * n
+        for i in range(n - 2, -1, -1):
+            s, e = two_sum(ws[i], s)
+            out[i + 1] = e
+        out[0] = s
+        ws = out
+    return ws
+
+
+def _renorm(words: Sequence, passes: int = 3) -> QS:
+    ws = _distill(words, passes=passes)
+    return QS(*ws[:_NW])
+
+
+def zeros_like(x) -> QS:
+    z = x * np.float32(0.0) if not hasattr(x, "aval") else x * 0
+    return QS(z, z, z, z)
+
+
+def from_words(w0, w1=None, w2=None, w3=None) -> QS:
+    z = w0 * 0
+    return _renorm([w0, w1 if w1 is not None else z, w2 if w2 is not None else z,
+                    w3 if w3 is not None else z])
+
+
+def from_f64_host(x) -> QS:
+    """Exact conversion from true-IEEE float64 (HOST numpy only).
+
+    A f64 significand (53 bits) fits in three f32 words exactly (provided no
+    word underflows); the fourth word is zero.
+    """
+    x = np.asarray(x, np.float64)
+    w0 = x.astype(np.float32)
+    r = x - w0.astype(np.float64)
+    w1 = r.astype(np.float32)
+    r2 = r - w1.astype(np.float64)
+    w2 = r2.astype(np.float32)
+    w3 = np.zeros_like(w2)
+    return QS(w0, w1, w2, w3)
+
+
+def from_dd_host(hi, lo) -> QS:
+    """Exact-ish conversion from a host double-double (numpy f64 pair).
+
+    Captures the top ~96 bits of the 106-bit DD — below the QS target
+    precision, so lossless for our purposes.
+    """
+    a = from_f64_host(np.asarray(hi, np.float64))
+    b = from_f64_host(np.asarray(lo, np.float64))
+    return add(a, b)
+
+
+def from_f64_device(x) -> QS:
+    """Conversion from a (possibly emulated) f64 on device: top ~48 bits.
+
+    Used for delays (≤ ~500 s, needed to ~ps ⇒ 48 bits is enough).  The
+    subtraction of the leading word is exact even under TPU's double-f32
+    f64 emulation (Sterbenz), so w1 captures the emulation's low word.
+    """
+    import jax.numpy as jnp
+
+    w0 = x.astype(jnp.float32)
+    r = x - w0.astype(x.dtype)
+    w1 = r.astype(jnp.float32)
+    r2 = r - w1.astype(x.dtype)
+    w2 = r2.astype(jnp.float32)
+    return _renorm([w0, w1, w2, jnp.zeros_like(w2)])
+
+
+def to_f64(q: QS):
+    """Collapse to float64 (true f64 on host; ~48-bit emulated on TPU)."""
+    if isinstance(q.w0, np.ndarray) or np.isscalar(q.w0):
+        return (
+            np.asarray(q.w0, np.float64)
+            + np.asarray(q.w1, np.float64)
+            + np.asarray(q.w2, np.float64)
+            + np.asarray(q.w3, np.float64)
+        )
+    import jax.numpy as jnp
+
+    return (
+        q.w0.astype(jnp.float64)
+        + q.w1.astype(jnp.float64)
+        + q.w2.astype(jnp.float64)
+        + q.w3.astype(jnp.float64)
+    )
+
+
+def add_w(q: QS, w) -> QS:
+    """QS + single f32 word."""
+    s0, e = two_sum(q.w0, w)
+    s1, e = two_sum(q.w1, e)
+    s2, e = two_sum(q.w2, e)
+    s3, e = two_sum(q.w3, e)
+    return _renorm([s0, s1, s2, s3, e])
+
+
+def add(a: QS, b: QS) -> QS:
+    """QS + QS: accumulate words (graded), then renormalize."""
+    s0, e0 = two_sum(a.w0, b.w0)
+    s1, e1 = two_sum(a.w1, b.w1)
+    s2, e2 = two_sum(a.w2, b.w2)
+    s3 = a.w3 + b.w3
+    return _renorm([s0, s1, e0, s2, e1, s3, e2], passes=3)
+
+
+def neg(q: QS) -> QS:
+    return QS(-q.w0, -q.w1, -q.w2, -q.w3)
+
+
+def sub(a: QS, b: QS) -> QS:
+    return add(a, neg(b))
+
+
+def mul_w(q: QS, w) -> QS:
+    """QS * single f32 word."""
+    p0, e0 = two_prod(q.w0, w)
+    p1, e1 = two_prod(q.w1, w)
+    p2, e2 = two_prod(q.w2, w)
+    p3 = q.w3 * w
+    return _renorm([p0, p1, e0, p2, e1, p3, e2], passes=3)
+
+
+def mul(a: QS, b: QS) -> QS:
+    """QS * QS, accurate to ~2^-90 relative."""
+    p00, e00 = two_prod(a.w0, b.w0)
+    p01, e01 = two_prod(a.w0, b.w1)
+    p10, e10 = two_prod(a.w1, b.w0)
+    p02, e02 = two_prod(a.w0, b.w2)
+    p11, e11 = two_prod(a.w1, b.w1)
+    p20, e20 = two_prod(a.w2, b.w0)
+    # order-3 terms: plain products (errors are below 2^-96)
+    t3 = (a.w0 * b.w3 + a.w3 * b.w0) + (a.w1 * b.w2 + a.w2 * b.w1)
+    # order-4: below target precision but nearly free
+    t4 = a.w1 * b.w3 + a.w2 * b.w2 + a.w3 * b.w1
+    return _renorm(
+        [p00, p01, p10, e00, p02, p11, p20, e01, e10, t3, e02, e11, e20, t4],
+        passes=3,
+    )
+
+
+def horner_taylor(dt: QS, coeffs: Sequence[QS]) -> QS:
+    """sum_k coeffs[k] dt^k / k! in QS (Taylor-Horner, cf. `utils.py:415`)."""
+    n = len(coeffs)
+    if n == 0:
+        return zeros_like(dt.w0)
+    fact = 1.0
+    facts = []
+    for k in range(n):
+        facts.append(fact)
+        fact *= k + 1
+    acc = coeffs[-1]
+    if facts[n - 1] != 1.0:
+        acc = mul_w(acc, _f32_like(dt.w0, 1.0 / facts[n - 1]))
+    for k in range(n - 2, -1, -1):
+        ck = coeffs[k]
+        if facts[k] != 1.0:
+            ck = mul_w(ck, _f32_like(dt.w0, 1.0 / facts[k]))
+        acc = add(mul(acc, dt), ck)
+    return acc
+
+
+def _f32_like(ref, v: float):
+    if isinstance(ref, np.ndarray) or np.isscalar(ref):
+        return np.float32(v)
+    import jax.numpy as jnp
+
+    return jnp.float32(v)
+
+
+def _round(x):
+    if isinstance(x, np.ndarray) or np.isscalar(x):
+        return np.round(x)
+    import jax.numpy as jnp
+
+    return jnp.round(x)
+
+
+def round_nearest(q: QS):
+    """Split into (n, frac): n = nearest integer (returned as f64-exact sum
+    of f32 words), frac = q - n with |frac| <= 0.5 as a QS.
+
+    Valid for |q| < 2^48 or so (pulse numbers ~1e12 qualify).  Each per-word
+    rounding is exact because large f32 words are themselves integers.
+    """
+    n_total = None
+    r = q
+    for _ in range(3):
+        nk = _round(r.w0)
+        r = add_w(r, -nk)
+        n_total = nk if n_total is None else n_total + _to64(nk)
+        n_total = _to64(n_total)
+    # final adjustment from the collapsed remainder
+    adj = _round(to_f64(r))
+    r = add_w(r, -_f32_like(r.w0, 1.0) * _to32(adj))
+    n_total = n_total + adj
+    return n_total, r
+
+
+def _to64(x):
+    if isinstance(x, np.ndarray) or np.isscalar(x):
+        return np.asarray(x, np.float64)
+    import jax.numpy as jnp
+
+    return x.astype(jnp.float64)
+
+
+def _to32(x):
+    if isinstance(x, np.ndarray) or np.isscalar(x):
+        return np.asarray(x, np.float32)
+    import jax.numpy as jnp
+
+    return x.astype(jnp.float32)

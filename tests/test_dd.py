@@ -1,0 +1,141 @@
+"""Hypothesis fuzzing of double-double arithmetic against mpmath.
+
+Mirrors the precision-test role of the reference's `tests/test_precision.py`
+(longdouble/two-float round-trips), with mpmath (50 digits) as the oracle.
+"""
+
+import mpmath
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import dd as ddm
+
+mpmath.mp.dps = 50
+
+# Magnitude-bounded strategies: error-free transforms legitimately require
+# no overflow/underflow; pint_tpu quantities live in ~[1e-12, 1e12].
+def _mag(lo, hi):
+    return st.one_of(
+        st.just(0.0),
+        st.builds(
+            lambda s, e, m: s * m * 10.0**e,
+            st.sampled_from([-1.0, 1.0]),
+            st.integers(min_value=lo, max_value=hi),
+            st.floats(min_value=1.0, max_value=9.999999),
+        ),
+    )
+
+
+finite = _mag(-8, 15)
+small = _mag(-8, 8)
+
+
+def as_mp(x: ddm.DD):
+    return mpmath.mpf(float(x.hi)) + mpmath.mpf(float(x.lo))
+
+
+def dd_of(a, b):
+    return ddm.from_two(jnp.float64(a), jnp.float64(b))
+
+
+def test_self_check():
+    assert ddm.self_check()
+
+
+@given(finite, finite)
+def test_two_sum_exact(a, b):
+    s, e = ddm.two_sum(jnp.float64(a), jnp.float64(b))
+    assert mpmath.mpf(float(s)) + mpmath.mpf(float(e)) == mpmath.mpf(a) + mpmath.mpf(b)
+
+
+@given(small, small)
+def test_two_prod_exact(a, b):
+    p, e = ddm.two_prod(jnp.float64(a), jnp.float64(b))
+    assert mpmath.mpf(float(p)) + mpmath.mpf(float(e)) == mpmath.mpf(a) * mpmath.mpf(b)
+
+
+@given(finite, st.floats(-1, 1), finite, st.floats(-1, 1))
+@settings(max_examples=200)
+def test_add_accuracy(ah, al, bh, bl):
+    x, y = dd_of(ah, al * 1e-10), dd_of(bh, bl * 1e-10)
+    got = as_mp(ddm.add(x, y))
+    want = as_mp(x) + as_mp(y)
+    tol = mpmath.mpf(2) ** -100 * max(1.0, abs(want))
+    assert abs(got - want) <= tol
+
+
+@given(small, st.floats(-1, 1), small, st.floats(-1, 1))
+@settings(max_examples=200)
+def test_mul_accuracy(ah, al, bh, bl):
+    x, y = dd_of(ah, al * 1e-10), dd_of(bh, bl * 1e-10)
+    got = as_mp(ddm.mul(x, y))
+    want = as_mp(x) * as_mp(y)
+    tol = mpmath.mpf(2) ** -98 * max(1.0, abs(want))
+    assert abs(got - want) <= tol
+
+
+@given(small, small)
+@settings(max_examples=100)
+def test_div_accuracy(a, b):
+    if abs(b) < 1e-3:
+        b = 1e-3
+    x, y = ddm.from_float(jnp.float64(a)), ddm.from_float(jnp.float64(b))
+    got = as_mp(ddm.div(x, y))
+    want = as_mp(x) / as_mp(y)
+    tol = mpmath.mpf(2) ** -98 * max(1.0, abs(want))
+    assert abs(got - want) <= tol
+
+
+def test_phase_precision_spindown_scale():
+    """The whole point: F0*dt at 1e12-cycle scale keeps sub-1e-10 cycle frac."""
+    f0 = 339.31568728824463  # Hz-ish, an MSP
+    dt_hi = 1.0e9  # seconds (≈30 yr)
+    dt = ddm.from_two(jnp.float64(dt_hi), jnp.float64(3.141592653589793e-7))
+    ph = ddm.mul_f(dt, f0)
+    want = (mpmath.mpf(dt_hi) + mpmath.mpf(3.141592653589793e-7)) * mpmath.mpf(f0)
+    got = as_mp(ph)
+    assert abs(got - want) < 1e-12  # cycles
+
+
+def test_horner_vs_mpmath():
+    # phase = F0*dt + F1*dt^2/2 + F2*dt^3/6 with realistic magnitudes
+    f = [0.0, 339.31568728824463, -1.6141639994226764e-15, 1.2e-26]
+    dt = ddm.from_two(jnp.float64(5.4321e8), jnp.float64(-2.5e-8))
+    got = as_mp(ddm.horner(dt, [jnp.float64(c) for c in f]))
+    t = mpmath.mpf(5.4321e8) + mpmath.mpf(-2.5e-8)
+    want = sum(
+        mpmath.mpf(c) * t**k / mpmath.factorial(k) for k, c in enumerate(f)
+    )
+    assert abs(got - want) < 1e-10
+
+
+@given(st.floats(min_value=-1e12, max_value=1e12, allow_nan=False))
+def test_round_nearest(x):
+    d = ddm.from_float(jnp.float64(x))
+    n, r = ddm.round_nearest(d)
+    assert float(n) == float(mpmath.nint(mpmath.mpf(x))) or abs(
+        abs(mpmath.mpf(x) - mpmath.nint(mpmath.mpf(x))) - mpmath.mpf("0.5")
+    ) < 1e-9  # ties may go either way
+    assert abs(float(ddm.to_float(r))) <= 0.5 + 1e-12
+    assert abs((float(n) + float(ddm.to_float(r))) - x) < 1e-3 * max(1, abs(x)) * 1e-9
+
+
+def test_jit_and_vmap():
+    xs = jnp.linspace(-1e6, 1e6, 101)
+    ys = jnp.linspace(1.0, 2.0, 101)
+
+    @jax.jit
+    def f(xs, ys):
+        d = ddm.prod_ff(xs, ys)
+        return ddm.to_float(ddm.add(d, ddm.from_float(1.0)))
+
+    out = f(xs, ys)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xs * ys + 1), rtol=1e-15)
+
+    g = jax.vmap(lambda x: ddm.mul_f(ddm.from_float(x), 3.0).hi)
+    np.testing.assert_allclose(np.asarray(g(xs)), np.asarray(xs) * 3.0)

@@ -1,0 +1,119 @@
+"""Quad-single (4×f32 expansion) arithmetic vs mpmath oracle.
+
+This is the on-device replacement for longdouble phase accumulation; it must
+hold ~90 bits through spindown-scale computations.
+"""
+
+import mpmath
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import qs as qsm
+
+mpmath.mp.dps = 60
+
+
+def as_mp(q):
+    return sum(mpmath.mpf(float(w)) for w in q.words)
+
+
+def test_from_f64_exact():
+    xs = np.array([1.2345678901234567e8, -3.7e-5, 86400.0 * 12345 + 0.123456789])
+    q = qsm.from_f64_host(xs)
+    for i, x in enumerate(xs):
+        got = sum(mpmath.mpf(float(w[i])) for w in q.words)
+        assert got == mpmath.mpf(float(x))
+
+
+# Magnitude contract (see module docstring): words stay well clear of the f32
+# subnormal cutoff.  Phase-scale quantities are ~[1e-12, 1e12].
+def _mag(lo, hi):
+    return st.one_of(
+        st.just(0.0),
+        st.builds(
+            lambda s, e, m: s * m * 10.0**e,
+            st.sampled_from([-1.0, 1.0]),
+            st.integers(min_value=lo, max_value=hi),
+            st.floats(min_value=1.0, max_value=9.999999),
+        ),
+    )
+
+
+@given(_mag(-12, 12), _mag(-12, 12))
+@settings(max_examples=150)
+def test_add_accuracy(a, b):
+    qa, qb = qsm.from_f64_host(a), qsm.from_f64_host(b)
+    got = as_mp(qsm.add(qa, qb))
+    want = mpmath.mpf(a) + mpmath.mpf(b)
+    assert abs(got - want) <= mpmath.mpf(2) ** -85 * max(1.0, abs(want))
+
+
+@given(_mag(-9, 9), _mag(-6, 3))
+@settings(max_examples=150)
+def test_mul_accuracy(a, b):
+    qa, qb = qsm.from_f64_host(a), qsm.from_f64_host(b)
+    got = as_mp(qsm.mul(qa, qb))
+    want = mpmath.mpf(a) * mpmath.mpf(b)
+    assert abs(got - want) <= mpmath.mpf(2) ** -85 * max(1e-20, abs(want))
+
+
+def test_dd_host_roundtrip():
+    hi, lo = 5.4321e8, -2.531e-9
+    q = qsm.from_dd_host(np.float64(hi), np.float64(lo))
+    assert abs(as_mp(q) - (mpmath.mpf(hi) + mpmath.mpf(lo))) < mpmath.mpf(2) ** -60
+
+
+def test_spindown_phase_precision():
+    """F0*dt + F1*dt^2/2 at 30-yr MSP scale must keep <1e-9 cycles."""
+    F0, F1 = 339.31568728824463, -1.6141639994226764e-15
+    dts = np.array([1.0e9, -5.4e8, 8.64e8 + 0.987654321])
+    dt = qsm.from_f64_host(dts)
+    coeffs = [
+        qsm.from_f64_host(np.zeros(3)),
+        qsm.from_f64_host(np.full(3, F0)),
+        qsm.from_f64_host(np.full(3, F1)),
+    ]
+    ph = qsm.horner_taylor(dt, coeffs)
+    for i in range(3):
+        t = mpmath.mpf(float(dts[i]))
+        want = mpmath.mpf(F0) * t + mpmath.mpf(F1) * t**2 / 2
+        got = sum(mpmath.mpf(float(w[i])) for w in ph.words)
+        assert abs(got - want) < 1e-9, (i, got, want)
+
+
+def test_round_nearest_pulse_numbers():
+    vals = np.array([123456789012.25, -9.75, 0.4999, 1e12 - 0.5 + 0.125])
+    q = qsm.from_f64_host(vals)
+    n, frac = qsm.round_nearest(q)
+    f = qsm.to_f64(frac)
+    for i, v in enumerate(vals):
+        want_n = float(mpmath.nint(mpmath.mpf(float(v))))
+        assert float(n[i]) == want_n, (i, float(n[i]), want_n)
+        assert abs(float(f[i]) - (v - want_n)) < 1e-9
+        assert abs(float(f[i])) <= 0.5 + 1e-9
+
+
+def test_jit_phase_pipeline():
+    """The full QS phase pipeline must jit and match the numpy path."""
+    F0 = 641.92822595292  # fastest known MSP-ish
+    dts = np.linspace(-6e8, 6e8, 1001) + 0.123456789
+    dt_np = qsm.from_f64_host(dts)
+    coeff_np = [qsm.from_f64_host(np.zeros_like(dts)), qsm.from_f64_host(np.full_like(dts, F0))]
+    n_np, f_np = qsm.round_nearest(qsm.horner_taylor(dt_np, coeff_np))
+
+    @jax.jit
+    def dev(dt, coeffs):
+        ph = qsm.horner_taylor(dt, coeffs)
+        return qsm.round_nearest(ph)
+
+    dt_j = qsm.QS(*(jnp.asarray(w) for w in dt_np.words))
+    coeff_j = [qsm.QS(*(jnp.asarray(w) for w in c.words)) for c in coeff_np]
+    n_j, f_j = dev(dt_j, coeff_j)
+    np.testing.assert_array_equal(np.asarray(n_j), np.asarray(n_np))
+    np.testing.assert_allclose(
+        np.asarray(qsm.to_f64(f_j)), np.asarray(qsm.to_f64(f_np)), atol=2e-10
+    )

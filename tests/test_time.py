@@ -1,0 +1,111 @@
+"""Tests for MJD two-float times, leap seconds, and scale conversions."""
+
+import mpmath
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+import jax.numpy as jnp
+
+from pint_tpu import dd as ddm
+from pint_tpu import mjd as mjdm
+
+mpmath.mp.dps = 50
+
+
+def test_leap_seconds_table():
+    # spot checks at era boundaries (public IERS facts)
+    assert float(mjdm.tai_minus_utc(41317)) == 10.0
+    assert float(mjdm.tai_minus_utc(50000)) == 29.0  # 1995-10-10
+    assert float(mjdm.tai_minus_utc(51544)) == 32.0  # 2000-01-01
+    assert float(mjdm.tai_minus_utc(57753)) == 36.0  # 2016-12-31
+    assert float(mjdm.tai_minus_utc(57754)) == 37.0  # 2017-01-01
+    assert float(mjdm.tai_minus_utc(60000)) == 37.0  # still 37 today
+
+
+def test_utc_tai_roundtrip():
+    t = mjdm.from_day_frac(np.int64(55555), np.float64(0.75))
+    tai = mjdm.utc_to_tai(t)
+    back = mjdm.tai_to_utc(tai)
+    assert int(back.day) == 55555
+    assert abs(float(back.frac) - 0.75) < 1e-15
+
+
+def test_utc_tai_roundtrip_near_leap():
+    # moments just before/after the 2017-01-01 leap second
+    for frac in [0.9999, 0.99999999, 0.0, 1e-9]:
+        for day in [57753, 57754]:
+            t = mjdm.from_day_frac(np.int64(day), np.float64(frac))
+            back = mjdm.tai_to_utc(mjdm.utc_to_tai(t))
+            dt = ddm.to_float(mjdm.diff_sec(back, t))
+            assert abs(float(dt)) < 1e-9
+
+
+def test_tt_offset():
+    t = mjdm.from_day_frac(np.int64(51544), np.float64(0.5))
+    tt = mjdm.tai_to_tt(t)
+    dt = ddm.to_float(mjdm.diff_sec(tt, t))
+    assert abs(float(dt) - 32.184) < 1e-12
+
+
+@given(
+    st.integers(min_value=42000, max_value=60000),
+    st.floats(min_value=0, max_value=1, exclude_max=True),
+)
+@settings(max_examples=100)
+def test_diff_sec_exact(day, frac):
+    a = mjdm.from_day_frac(np.int64(day), np.float64(frac))
+    b = mjdm.from_day_frac(np.int64(53750), np.float64(0.0))
+    got = mjdm.diff_sec(a, b)
+    want = (mpmath.mpf(day - 53750) + mpmath.mpf(float(a.frac))) * 86400
+    assert abs((mpmath.mpf(float(got.hi)) + mpmath.mpf(float(got.lo))) - want) < 1e-20 * max(
+        1, abs(want)
+    ) + mpmath.mpf(2) ** -80
+
+
+def test_from_string_precision():
+    t = mjdm.from_string("53750.000276921996954")
+    assert int(t.day) == 53750
+    # fraction correct to ~2e-16 day (19 ps)
+    assert abs(float(t.frac) - 0.000276921996954) < 3e-16
+
+
+def test_tdb_minus_tt_sanity():
+    from pint_tpu import tdbseries
+
+    # amplitude and annual periodicity of the leading term
+    for mjd0 in [50000, 53750, 58000]:
+        t = mjdm.from_day_frac(np.int64(mjd0), np.float64(0.0))
+        x = float(tdbseries.tdb_minus_tt(mjdm._tt_julian_millennia(t)))
+        assert abs(x) < 2e-3
+        t2 = mjdm.from_day_frac(np.int64(mjd0 + 365), np.float64(0.2425 * 86400 / 86400))
+        x2 = float(tdbseries.tdb_minus_tt(mjdm._tt_julian_millennia(t2)))
+        # one anomalistic year later the value repeats to ~leading-term accuracy
+        assert abs(x - x2) < 8e-5
+
+    # agreement with the textbook 2-term approximation to ~35 µs
+    for mjd0 in np.linspace(49000, 59000, 23):
+        t = mjdm.from_day_frac(np.int64(mjd0), np.float64(0.0))
+        x = float(tdbseries.tdb_minus_tt(mjdm._tt_julian_millennia(t)))
+        Tc = (mjd0 - 51545.0) / 36525.0
+        g = np.deg2rad(357.53 + 35999.050 * Tc)
+        approx = 0.001657 * np.sin(g + 0.01671 * np.sin(g))
+        assert abs(x - approx) < 3.5e-5
+
+
+def test_tdb_roundtrip():
+    t = mjdm.from_day_frac(np.int64(55000), np.float64(0.3))
+    back = mjdm.tdb_to_tt(mjdm.tt_to_tdb(t))
+    assert abs(float(ddm.to_float(mjdm.diff_sec(back, t)))) < 1e-10
+
+
+def test_phase_type():
+    from pint_tpu import phase as ph
+
+    a = ph.from_float(jnp.float64(1234567.25))
+    b = ph.from_float(jnp.float64(0.5))
+    s = a + b
+    assert float(s.int) == 1234568.0 and abs(float(s.frac) + 0.25) < 1e-15
+    d = a - b
+    assert float(d.quantity) == 1234566.75
