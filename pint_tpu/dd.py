@@ -240,17 +240,16 @@ def scale_pow2(x: DD, k) -> DD:
 
 
 def _xp(x):
-    """numpy-or-jax dispatch for the few non-arithmetic ops (round/floor)."""
-    try:
-        import jax
+    """numpy-or-jax dispatch for the few non-arithmetic ops (round/floor).
 
-        if isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer):
-            import jax.numpy as jnp
+    Same rule as :func:`pint_tpu.utils.get_xp` (kept inline: utils imports
+    would be circular for this foundation module).
+    """
+    if isinstance(x, np.ndarray) or np.isscalar(x):
+        return np
+    import jax.numpy as jnp
 
-            return jnp
-    except Exception:
-        pass
-    return np
+    return jnp
 
 
 def round_nearest(x: DD):

@@ -1,0 +1,564 @@
+"""Solar-system ephemerides: body positions/velocities w.r.t. the SSB.
+
+Equivalent of the reference's `src/pint/solar_system_ephemerides.py` (which
+wraps astropy+jplephem and *downloads* JPL DE kernels).  This environment has
+neither astropy nor jplephem nor network access, so this module provides:
+
+* :class:`SPKEphemeris` — a from-scratch reader for JPL SPK/DAF binary kernels
+  (``.bsp``; DAF file format per NAIF's SPK Required Reading; Chebyshev
+  segment types 2 and 3).  Users drop ``de421.bsp``/``de440.bsp`` into
+  ``$PINT_TPU_EPHEM_DIR`` (or CWD) and get full JPL precision — this replaces
+  the reference's jplephem dependency with native code.
+* :class:`BuiltinEphemeris` — an analytic fallback: heliocentric Keplerian
+  mean elements (JPL "Approximate Positions of the Planets", Standish,
+  valid 1800–2050 AD) + a truncated ELP-2000 lunar theory (Meeus-level,
+  principal terms) + SSB offset from the planetary GM-weighted sum.
+  Accuracy: ~10³–10⁴ km for the Earth (tens of ms of light time) — NOT
+  suitable for precision timing against real data, but fully self-consistent,
+  which is what the simulate→fit test strategy requires (SURVEY.md §4).
+  A loud warning is emitted when it is used.
+
+All returns are ICRS-equatorial, SSB-centered, SI units (m, m/s).
+Host-side numpy (load-time precompute; see SURVEY.md §7).  An on-device
+Chebyshev pack for end-to-end jitted pipelines is provided by
+:meth:`SPKEphemeris.chebyshev_pack`.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import warnings
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu import GM_BODY
+from pint_tpu.utils import PosVel
+
+AU_KM = 149597870.700
+DAY_S = 86400.0
+#: seconds of TDB past J2000 (JD 2451545.0 TDB) per MJD(TDB) day
+_J2000_MJD = 51544.5
+
+# NAIF integer codes
+NAIF = {
+    "ssb": 0,
+    "mercury_bary": 1,
+    "venus_bary": 2,
+    "emb": 3,
+    "mars_bary": 4,
+    "jupiter_bary": 5,
+    "saturn_bary": 6,
+    "uranus_bary": 7,
+    "neptune_bary": 8,
+    "pluto_bary": 9,
+    "sun": 10,
+    "moon": 301,
+    "earth": 399,
+    "mercury": 199,
+    "venus": 299,
+    "mars": 499,
+    "jupiter": 599,
+    "saturn": 699,
+    "uranus": 799,
+    "neptune": 899,
+    "pluto": 999,
+}
+
+# For the giant planets the planet-barycenter offset is far below timing
+# relevance (Shapiro-delay geometry), so barycenter codes substitute.
+_BARY_FALLBACK = {499: 4, 599: 5, 699: 6, 799: 7, 899: 8, 999: 9, 199: 1, 299: 2}
+
+
+def mjd_tdb_to_et(mjd_tdb):
+    """MJD(TDB) -> ET seconds past J2000 TDB."""
+    return (np.asarray(mjd_tdb, np.float64) - _J2000_MJD) * DAY_S
+
+
+class _Segment:
+    __slots__ = (
+        "target",
+        "center",
+        "frame",
+        "dtype",
+        "et_beg",
+        "et_end",
+        "init",
+        "intlen",
+        "rsize",
+        "n",
+        "coeffs",
+    )
+
+    def __init__(self, target, center, frame, dtype, et_beg, et_end, init, intlen, rsize, n, coeffs):
+        self.target = target
+        self.center = center
+        self.frame = frame
+        self.dtype = dtype
+        self.et_beg = et_beg
+        self.et_end = et_end
+        self.init = init
+        self.intlen = intlen
+        self.rsize = rsize
+        self.n = n
+        self.coeffs = coeffs  # (n, ncomp, ncoef) Chebyshev coefficients [km]
+
+    def posvel_km(self, et):
+        """Evaluate (pos[km], vel[km/s]) at ET seconds (vectorized)."""
+        et = np.asarray(et, np.float64)
+        idx = np.floor((et - self.init) / self.intlen).astype(np.int64)
+        idx = np.clip(idx, 0, self.n - 1)
+        mid = self.init + (idx + 0.5) * self.intlen
+        radius = self.intlen / 2.0
+        s = (et - mid) / radius  # in [-1, 1]
+        c = self.coeffs[idx]  # (..., ncomp, ncoef)
+        ncoef = c.shape[-1]
+        # Chebyshev via Clenshaw recurrence, plus derivative recurrence
+        b0 = np.zeros(s.shape + (c.shape[-2],))
+        b1 = np.zeros_like(b0)
+        d0 = np.zeros_like(b0)
+        d1 = np.zeros_like(b0)
+        s2 = (2.0 * s)[..., None]
+        for k in range(ncoef - 1, 0, -1):
+            d0, d1 = s2 * d0 - d1 + 2.0 * b0, d0
+            b0, b1 = s2 * b0 - b1 + c[..., k], b0
+        # p = c0 + s*b1 - b2  =>  p' = b1 + s*b1' - b2'
+        dval = b0 + s[..., None] * d0 - d1
+        val = s[..., None] * b0 - b1 + c[..., 0]
+        if c.shape[-2] >= 6:  # type 3: velocity stored explicitly
+            return val[..., 0:3], val[..., 3:6]
+        return val, dval / radius
+
+
+class SPKEphemeris:
+    """JPL SPK (``.bsp``) kernel reader: DAF format, segment types 2 & 3.
+
+    Format implemented from the public NAIF SPK/DAF specification (the
+    reference instead imports ``jplephem``; cf.
+    `src/pint/solar_system_ephemerides.py:18-45`).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.segments: Dict[Tuple[int, int], list] = {}
+        with open(path, "rb") as f:
+            data = f.read()
+        self._parse(data)
+        self.name = os.path.splitext(os.path.basename(path))[0].lower()
+
+    # -- DAF plumbing ----------------------------------------------------------
+
+    def _parse(self, data: bytes):
+        locidw = data[0:8].decode("ascii", "replace")
+        if not (locidw.startswith("DAF/SPK") or locidw.startswith("NAIF/DAF")):
+            raise ValueError(f"{self.path}: not an SPK kernel (ID word {locidw!r})")
+        locfmt = data[88:96].decode("ascii", "replace")
+        if "LTL" in locfmt:
+            en = "<"
+        elif "BIG" in locfmt:
+            en = ">"
+        else:
+            # pre-FTP-validation files: guess from ND plausibility
+            nd_l = struct.unpack("<i", data[8:12])[0]
+            en = "<" if 0 < nd_l < 124 else ">"
+        nd, ni = struct.unpack(en + "ii", data[8:16])
+        fward, bward, free = struct.unpack(en + "iii", data[76:88])
+        ss = nd + (ni + 1) // 2  # summary size in doubles
+        f64 = np.dtype(en + "f8")
+        i32 = np.dtype(en + "i4")
+        words = np.frombuffer(data, dtype=f64)
+
+        recno = fward
+        while recno > 0:
+            base = (recno - 1) * 128  # word index of record start
+            nxt, _prev, nsum = words[base : base + 3]
+            for k in range(int(nsum)):
+                sbase = base + 3 + k * ss
+                dbl = words[sbase : sbase + nd]
+                ints = np.frombuffer(
+                    words[sbase + nd : sbase + ss].tobytes(), dtype=i32
+                )[:ni]
+                self._load_segment(words, dbl, ints)
+            recno = int(nxt)
+
+    def _load_segment(self, words, dbl, ints):
+        et_beg, et_end = float(dbl[0]), float(dbl[1])
+        target, center, frame, dtype, begin, end = (int(x) for x in ints[:6])
+        if dtype not in (2, 3):
+            return  # only Chebyshev position(/velocity) segments are used by DE
+        seg_words = words[begin - 1 : end]
+        init, intlen, rsize, n = seg_words[-4:]
+        rsize, n = int(rsize), int(n)
+        recs = seg_words[: rsize * n].reshape(n, rsize)
+        ncomp = 3 if dtype == 2 else 6
+        ncoef = (rsize - 2) // ncomp
+        coeffs = recs[:, 2:].reshape(n, ncomp, ncoef)
+        self.segments.setdefault((target, center), []).append(
+            _Segment(
+                target, center, frame, dtype, et_beg, et_end, float(init), float(intlen), rsize, n, coeffs
+            )
+        )
+
+    # -- public API ------------------------------------------------------------
+
+    def _chain(self, code: int):
+        """Chain of segment lists from SSB(0) to `code` (e.g. 399: 0->3->399)."""
+        if code == 0:
+            return []
+        for (tgt, ctr), segs in self.segments.items():
+            if tgt == code:
+                return self._chain(ctr) + [segs]
+        if code in _BARY_FALLBACK:
+            return self._chain(_BARY_FALLBACK[code])
+        raise KeyError(f"body {code} not reachable in {self.path}")
+
+    @staticmethod
+    def _pick(segs, et):
+        """Segment covering all epochs in `et`, else EphemerisError."""
+        from pint_tpu.exceptions import EphemerisError
+
+        lo, hi = float(np.min(et)), float(np.max(et))
+        for seg in segs:
+            if seg.et_beg <= lo and hi <= seg.et_end:
+                return seg
+        spans = [(s.et_beg, s.et_end) for s in segs]
+        raise EphemerisError(
+            f"epochs ET [{lo}, {hi}] s outside kernel segment span(s) {spans} "
+            f"(no extrapolation beyond the .bsp coverage)"
+        )
+
+    def posvel(self, body: str, mjd_tdb) -> PosVel:
+        """(pos [m], vel [m/s]) of `body` w.r.t. SSB, ICRS axes."""
+        code = NAIF[body.lower()]
+        et = mjd_tdb_to_et(mjd_tdb)
+        pos = 0.0
+        vel = 0.0
+        for segs in self._chain(code):
+            p, v = self._pick(segs, et).posvel_km(et)
+            pos = pos + p
+            vel = vel + v
+        return PosVel(np.asarray(pos) * 1e3, np.asarray(vel) * 1e3)
+
+    def chebyshev_pack(self, body: str, mjd_start: float, mjd_end: float):
+        """Extract (init, intlen, coeffs[m]) covering [mjd_start, mjd_end] for
+        on-device evaluation (summed over the SSB chain after re-fitting is
+        NOT done — each chain link is returned separately)."""
+        code = NAIF[body.lower()]
+        out = []
+        e0, e1 = mjd_tdb_to_et(mjd_start), mjd_tdb_to_et(mjd_end)
+        for segs in self._chain(code):
+            seg = self._pick(segs, np.array([e0, e1]))
+            i0 = max(0, int(np.floor((e0 - seg.init) / seg.intlen)))
+            i1 = min(seg.n - 1, int(np.floor((e1 - seg.init) / seg.intlen)))
+            out.append(
+                (
+                    seg.init + i0 * seg.intlen,
+                    seg.intlen,
+                    np.asarray(seg.coeffs[i0 : i1 + 1]) * 1e3,
+                )
+            )
+        return out
+
+
+# --- analytic fallback --------------------------------------------------------
+
+# JPL "Approximate Positions of the Planets" (E.M. Standish) Keplerian mean
+# elements, J2000 ecliptic, valid 1800-2050.  Columns: a [au], e, I [deg],
+# L [deg], long.peri [deg], long.node [deg]; then centennial rates of each.
+_KEPLER_ELEMENTS = {
+    "mercury": (0.38709927, 0.20563593, 7.00497902, 252.25032350, 77.45779628, 48.33076593,
+                0.00000037, 0.00001906, -0.00594749, 149472.67411175, 0.16047689, -0.12534081),
+    "venus": (0.72333566, 0.00677672, 3.39467605, 181.97909950, 131.60246718, 76.67984255,
+              0.00000390, -0.00004107, -0.00078890, 58517.81538729, 0.00268329, -0.27769418),
+    "emb": (1.00000261, 0.01671123, -0.00001531, 100.46457166, 102.93768193, 0.0,
+            0.00000562, -0.00004392, -0.01294668, 35999.37244981, 0.32327364, 0.0),
+    "mars": (1.52371034, 0.09339410, 1.84969142, -4.55343205, -23.94362959, 49.55953891,
+             0.00001847, 0.00007882, -0.00813131, 19140.30268499, 0.44441088, -0.29257343),
+    "jupiter": (5.20288700, 0.04838624, 1.30439695, 34.39644051, 14.72847983, 100.47390909,
+                -0.00011607, -0.00013253, -0.00183714, 3034.74612775, 0.21252668, 0.20469106),
+    "saturn": (9.53667594, 0.05386179, 2.48599187, 49.95424423, 92.59887831, 113.66242448,
+               -0.00125060, -0.00050991, 0.00193609, 1222.49362201, -0.41897216, -0.28867794),
+    "uranus": (19.18916464, 0.04725744, 0.77263783, 313.23810451, 170.95427630, 74.01692503,
+               -0.00196176, -0.00004397, -0.00242939, 428.48202785, 0.40805281, 0.04240589),
+    "neptune": (30.06992276, 0.00859048, 1.77004347, -55.12002969, 44.96476227, 131.78422574,
+                0.00026291, 0.00005105, 0.00035372, 218.45945325, -0.32241464, -0.00508664),
+    "pluto": (39.48211675, 0.24882730, 17.14001206, 238.92903833, 224.06891629, 110.30393684,
+              -0.00031596, 0.00005170, 0.00004818, 145.20780515, -0.04062942, -0.01183482),
+}
+
+#: Earth/Moon mass ratio (DE421 convention)
+EMRAT = 81.30056907419062
+_MOON_FRAC = 1.0 / (1.0 + EMRAT)  # Moon's share of the E-M separation to EMB
+
+#: obliquity used to rotate J2000 ecliptic -> ICRS equatorial [rad]
+_EPS0 = np.deg2rad(84381.406 / 3600.0)
+
+# Truncated ELP-2000/Meeus lunar series.  Args: multiples of (D, M, M', F);
+# dL in 1e-6 deg, dR in 1e-3 km, dB in 1e-6 deg (separate table).
+_MOON_LR = np.array(
+    [
+        # D  M  M'  F     dL        dR
+        [0, 0, 1, 0, 6288774.0, -20905355.0],
+        [2, 0, -1, 0, 1274027.0, -3699111.0],
+        [2, 0, 0, 0, 658314.0, -2955968.0],
+        [0, 0, 2, 0, 213618.0, -569925.0],
+        [0, 1, 0, 0, -185116.0, 48888.0],
+        [0, 0, 0, 2, -114332.0, -3149.0],
+        [2, 0, -2, 0, 58793.0, 246158.0],
+        [2, -1, -1, 0, 57066.0, -152138.0],
+        [2, 0, 1, 0, 53322.0, -170733.0],
+        [2, -1, 0, 0, 45758.0, -204586.0],
+        [0, 1, -1, 0, -40923.0, -129620.0],
+        [1, 0, 0, 0, -34720.0, 108743.0],
+        [0, 1, 1, 0, -30383.0, 104755.0],
+        [2, 0, 0, -2, 15327.0, 10321.0],
+        [0, 0, 1, 2, -12528.0, 0.0],
+        [0, 0, 1, -2, 10980.0, 79661.0],
+        [4, 0, -1, 0, 10675.0, -34782.0],
+        [0, 0, 3, 0, 10034.0, -23210.0],
+        [4, 0, -2, 0, 8548.0, -21636.0],
+        [2, 1, -1, 0, -7888.0, 24208.0],
+        [2, 1, 0, 0, -6766.0, 30824.0],
+        [1, 0, -1, 0, -5163.0, -8379.0],
+        [1, 1, 0, 0, 4987.0, -16675.0],
+        [2, -1, 1, 0, 4036.0, -12831.0],
+    ]
+)
+_MOON_B = np.array(
+    [
+        # D  M  M'  F     dB
+        [0, 0, 0, 1, 5128122.0],
+        [0, 0, 1, 1, 280602.0],
+        [0, 0, 1, -1, 277693.0],
+        [2, 0, 0, -1, 173237.0],
+        [2, 0, -1, 1, 55413.0],
+        [2, 0, -1, -1, 46271.0],
+        [2, 0, 0, 1, 32573.0],
+        [0, 0, 2, 1, 17198.0],
+        [2, 0, 1, -1, 9266.0],
+        [0, 0, 2, -1, 8822.0],
+        [2, -1, 0, -1, 8216.0],
+        [2, 0, -2, -1, 4324.0],
+        [2, 0, 1, 1, 4200.0],
+        [2, 1, 0, -1, -3359.0],
+        [2, -1, -1, 1, 2463.0],
+        [2, -1, 0, 1, 2211.0],
+        [2, -1, -1, -1, 2065.0],
+        [0, 1, -1, -1, -1870.0],
+        [4, 0, -1, -1, 1828.0],
+        [0, 1, 0, 1, -1794.0],
+    ]
+)
+
+
+def _ecl_to_icrs(v):
+    """Rotate J2000-ecliptic vectors to ICRS equatorial."""
+    ce, se = np.cos(_EPS0), np.sin(_EPS0)
+    x, y, z = v[..., 0], v[..., 1], v[..., 2]
+    return np.stack([x, ce * y - se * z, se * y + ce * z], axis=-1)
+
+
+def _kepler_posvel_au(name, t_cy):
+    """Heliocentric J2000-ecliptic (pos [au], vel [au/day]) from mean elements."""
+    a0, e0, i0, L0, w0, O0, da, de, di, dL, dw, dO = _KEPLER_ELEMENTS[name]
+    a = a0 + da * t_cy
+    e = e0 + de * t_cy
+    inc = np.deg2rad(i0 + di * t_cy)
+    L = np.deg2rad(L0 + dL * t_cy)
+    wbar = np.deg2rad(w0 + dw * t_cy)
+    Om = np.deg2rad(O0 + dO * t_cy)
+    w = wbar - Om  # argument of perihelion
+    M = np.remainder(L - wbar + np.pi, 2 * np.pi) - np.pi
+    # Kepler equation, Newton iteration (fixed count; e < 0.25 for all bodies)
+    E = M + e * np.sin(M)
+    for _ in range(6):
+        E = E - (E - e * np.sin(E) - M) / (1.0 - e * np.cos(E))
+    cosE, sinE = np.cos(E), np.sin(E)
+    # perifocal coordinates
+    xp = a * (cosE - e)
+    yp = a * np.sqrt(1 - e * e) * sinE
+    # mean motion [rad/day]
+    n = np.deg2rad(dL) / 36525.0
+    rdot_f = a * n / (1.0 - e * cosE)
+    vxp = -rdot_f * sinE
+    vyp = rdot_f * np.sqrt(1 - e * e) * cosE
+    cw, sw = np.cos(w), np.sin(w)
+    cO, sO = np.cos(Om), np.sin(Om)
+    ci, si = np.cos(inc), np.sin(inc)
+    r11 = cO * cw - sO * sw * ci
+    r12 = -cO * sw - sO * cw * ci
+    r21 = sO * cw + cO * sw * ci
+    r22 = -sO * sw + cO * cw * ci
+    r31 = sw * si
+    r32 = cw * si
+    pos = np.stack([r11 * xp + r12 * yp, r21 * xp + r22 * yp, r31 * xp + r32 * yp], -1)
+    vel = np.stack([r11 * vxp + r12 * vyp, r21 * vxp + r22 * vyp, r31 * vxp + r32 * vyp], -1)
+    return pos, vel
+
+
+def _moon_pos_km(t_cy):
+    """Geocentric Moon position only, ecliptic frame [km]."""
+    t = np.asarray(t_cy, np.float64)
+    deg = np.pi / 180.0
+    Lp = (218.3164477 + 481267.88123421 * t - 0.0015786 * t**2) * deg
+    D = (297.8501921 + 445267.1114034 * t - 0.0018819 * t**2) * deg
+    M = (357.5291092 + 35999.0502909 * t - 0.0001536 * t**2) * deg
+    Mp = (134.9633964 + 477198.8675055 * t + 0.0087414 * t**2) * deg
+    F = (93.2720950 + 483202.0175233 * t - 0.0036539 * t**2) * deg
+    E = 1.0 - 0.002516 * t - 0.0000074 * t**2
+
+    def series(table, trig):
+        args = (
+            table[:, 0] * D[..., None]
+            + table[:, 1] * M[..., None]
+            + table[:, 2] * Mp[..., None]
+            + table[:, 3] * F[..., None]
+        )
+        ecorr = np.where(np.abs(table[:, 1]) > 0, E[..., None] ** np.abs(table[:, 1]), 1.0)
+        return args, ecorr
+
+    argsLR, eLR = series(_MOON_LR, np.sin)
+    dL = np.sum(_MOON_LR[:, 4] * eLR * np.sin(argsLR), axis=-1) * 1e-6 * deg
+    dR = np.sum(_MOON_LR[:, 5] * eLR * np.cos(argsLR), axis=-1) * 1e-3
+    argsB, eB = series(_MOON_B, np.sin)
+    dB = np.sum(_MOON_B[:, 4] * eB * np.sin(argsB), axis=-1) * 1e-6 * deg
+
+    lon = Lp + dL
+    lat = dB
+    r = 385000.56 + dR  # km
+    cl, sl = np.cos(lon), np.sin(lon)
+    cb, sb = np.cos(lat), np.sin(lat)
+    return np.stack([r * cb * cl, r * cb * sl, r * sb], -1)
+
+
+def _moon_geocentric_km(t_cy):
+    """Geocentric Moon, J2000-ish ecliptic frame (pos [km], vel [km/day]).
+
+    Truncated Meeus/ELP series (of-date frame treated as J2000 — the ~1.4°/cy
+    precession of the series' reference frame contributes ≲0.1% of the already
+    approximate fallback; acceptable for the documented accuracy class).
+    Velocity by central difference of the series (smooth analytic function).
+    """
+    t = np.asarray(t_cy, np.float64)
+    pos = _moon_pos_km(t)
+    dt = 1e-7  # centuries ≈ 5.3 min
+    vel = (_moon_pos_km(t + dt) - _moon_pos_km(t - dt)) / (2 * dt * 36525.0)
+    return pos, vel
+
+
+class BuiltinEphemeris:
+    """Analytic fallback ephemeris (see module docstring for accuracy)."""
+
+    name = "builtin_analytic"
+
+    def __init__(self, warn=True):
+        if warn:
+            warnings.warn(
+                "Using the builtin analytic ephemeris (no JPL .bsp kernel "
+                "found).  Earth position errors are ~1e3-1e4 km: fine for "
+                "simulation/self-consistent fitting, NOT for precision "
+                "timing of real data.  Supply a DE kernel via "
+                "$PINT_TPU_EPHEM_DIR for full accuracy.",
+                stacklevel=2,
+            )
+
+    def _helio_all(self, t_cy):
+        out = {}
+        for name in _KEPLER_ELEMENTS:
+            p, v = _kepler_posvel_au(name, t_cy)
+            out[name] = (p, v)
+        return out
+
+    def _ssb_offset(self, helio):
+        """Sun's position w.r.t. SSB [au, au/day] (ecliptic frame)."""
+        gm_tot = GM_BODY["sun"]
+        psum = 0.0
+        vsum = 0.0
+        for name, (p, v) in helio.items():
+            key = "earth" if name == "emb" else name
+            gm = GM_BODY[key] + (GM_BODY["moon"] if name == "emb" else 0.0)
+            gm_tot = gm_tot + gm
+            psum = psum + gm * p
+            vsum = vsum + gm * v
+        return -psum / gm_tot, -vsum / gm_tot
+
+    def posvel(self, body: str, mjd_tdb) -> PosVel:
+        body = body.lower()
+        t = (np.asarray(mjd_tdb, np.float64) - _J2000_MJD) / 36525.0
+        helio = self._helio_all(t)
+        sun_p, sun_v = self._ssb_offset(helio)
+
+        def bary(name):
+            p, v = helio[name]
+            return p + sun_p, v + sun_v
+
+        if body == "ssb":
+            z = np.zeros(np.shape(t) + (3,))
+            return PosVel(z, z.copy())
+        if body == "sun":
+            p, v = sun_p, sun_v
+        elif body in ("earth", "moon", "emb"):
+            emb_p, emb_v = bary("emb")
+            mp_km, mv_kmd = _moon_geocentric_km(t)
+            mp, mv = mp_km / AU_KM, mv_kmd / AU_KM
+            if body == "emb":
+                p, v = emb_p, emb_v
+            elif body == "earth":
+                p, v = emb_p - _MOON_FRAC * mp, emb_v - _MOON_FRAC * mv
+            else:
+                p = emb_p + (1.0 - _MOON_FRAC) * mp
+                v = emb_v + (1.0 - _MOON_FRAC) * mv
+        else:
+            key = body[:-5] if body.endswith("_bary") else body
+            p, v = bary(key)
+        pos_m = _ecl_to_icrs(np.asarray(p)) * AU_KM * 1e3
+        vel_ms = _ecl_to_icrs(np.asarray(v)) * AU_KM * 1e3 / DAY_S
+        return PosVel(pos_m, vel_ms)
+
+
+# --- loader -------------------------------------------------------------------
+
+_EPHEM_CACHE: Dict[str, object] = {}
+
+
+def _search_dirs():
+    dirs = []
+    env = os.environ.get("PINT_TPU_EPHEM_DIR")
+    if env:
+        dirs.append(env)
+    dirs += [os.getcwd(), os.path.join(os.path.dirname(__file__), "data", "ephem")]
+    return dirs
+
+
+def load_ephemeris(name: Optional[str] = "DE421"):
+    """Resolve an ephemeris by name ('DE421'), path, or fallback to builtin.
+
+    Mirrors the reference's resolution order (`solar_system_ephemerides.py`)
+    minus the network download (zero-egress environment).
+    """
+    key = (name or "builtin").lower()
+    if key in _EPHEM_CACHE:
+        return _EPHEM_CACHE[key]
+    eph = None
+    if key not in ("builtin", "builtin_analytic", None):
+        if os.path.isfile(key) or os.path.isfile(str(name)):
+            eph = SPKEphemeris(str(name) if os.path.isfile(str(name)) else key)
+        else:
+            fname = key if key.endswith(".bsp") else key + ".bsp"
+            for d in _search_dirs():
+                p = os.path.join(d, fname)
+                if os.path.isfile(p):
+                    eph = SPKEphemeris(p)
+                    break
+    if eph is None:
+        eph = BuiltinEphemeris(warn=key not in ("builtin", "builtin_analytic"))
+    _EPHEM_CACHE[key] = eph
+    return eph
+
+
+def objPosVel_wrt_SSB(objname: str, mjd_tdb, ephem="DE421") -> PosVel:
+    """Drop-in analogue of the reference's `objPosVel_wrt_SSB`
+    (`src/pint/solar_system_ephemerides.py`): SI units, ICRS, SSB-centered."""
+    eph = ephem if hasattr(ephem, "posvel") else load_ephemeris(ephem)
+    return eph.posvel(objname, mjd_tdb)

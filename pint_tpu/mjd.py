@@ -81,19 +81,21 @@ def from_day_frac(day, frac) -> MJD:
 def from_string(s: str) -> MJD:
     """Host-side exact parse of a decimal MJD string (tim-file precision)."""
     s = s.strip()
-    if "." in s:
-        ip, fp = s.split(".")
+    neg = s.startswith("-")
+    body = s.lstrip("+-")
+    if "." in body:
+        ip, fp = body.split(".")
     else:
-        ip, fp = s, "0"
-    day = int(ip)
-    # build the fraction exactly in extended precision then round once
-    frac = float(int(fp)) / 10.0 ** len(fp) if fp else 0.0
-    # use the decimal module for a correctly-rounded fraction
+        ip, fp = body, ""
+    day = int(ip) if ip else 0
+    # the decimal module gives a correctly-rounded fraction
     from decimal import Decimal
 
     frac = float(Decimal("0." + fp)) if fp else 0.0
-    if s.startswith("-") and day == 0:
-        day, frac = -1, 1.0 - frac
+    if neg:
+        day, frac = (-day, 0.0) if frac == 0.0 else (-day - 1, 1.0 - frac)
+    if frac >= 1.0:  # rounding of 0.999... can land exactly on 1.0
+        day, frac = day + 1, 0.0
     return MJD(np.int64(day), np.float64(frac))
 
 

@@ -1,0 +1,191 @@
+"""Shared numeric helpers (host + device).
+
+Functional equivalent of the grab-bag the reference keeps in
+`src/pint/utils.py` (3559 LoC); only the numeric core lives here — domain
+helpers (DMX ranges, WaveX setup, F-tests) live next to their subsystems.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+
+def taylor_horner(dt, coeffs):
+    """Evaluate sum_k coeffs[k] * dt^k / k! by Horner's rule.
+
+    Equivalent of the reference's `taylor_horner` (`src/pint/utils.py:415`).
+    Works for numpy or jax arrays (pure arithmetic).  For the
+    precision-critical phase path use :func:`pint_tpu.dd.horner` instead.
+    """
+    acc = 0.0 * dt
+    for k in range(len(coeffs) - 1, -1, -1):
+        acc = acc * dt / (k + 1.0) + coeffs[k]
+    # note: the divide-by-(k+1) above distributes the factorials so the final
+    # pass (k=0) divides by 1; expansion check in tests/test_utils.py.
+    return acc
+
+
+def taylor_horner_deriv(dt, coeffs, deriv_order=1):
+    """d^n/dt^n of `taylor_horner` (reference `src/pint/utils.py:445`).
+
+    Since d/dt [c_k dt^k / k!] = c_k dt^(k-1)/(k-1)!, the n-th derivative is
+    simply the same series on the coefficient tail.
+    """
+    return taylor_horner(dt, coeffs[deriv_order:])
+
+
+class PosVel(NamedTuple):
+    """A position+velocity pair (3-vectors or (...,3) arrays), with frame
+    bookkeeping by convention only (both in the same inertial frame).
+
+    Equivalent of the reference's `PosVel` (`src/pint/utils.py:182`), minus
+    astropy units: positions in meters, velocities in m/s unless stated.
+    """
+
+    pos: np.ndarray
+    vel: np.ndarray
+
+    def __add__(self, other):
+        return PosVel(self.pos + other.pos, self.vel + other.vel)
+
+    def __sub__(self, other):
+        return PosVel(self.pos - other.pos, self.vel - other.vel)
+
+    def __neg__(self):
+        return PosVel(-self.pos, -self.vel)
+
+
+def get_xp(x):
+    """The single numpy-vs-jax.numpy dispatch helper for this package.
+
+    numpy arrays and python scalars -> numpy; everything else (jax arrays,
+    tracers inside jit) -> jax.numpy.
+    """
+    if isinstance(x, np.ndarray) or np.isscalar(x):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def normalize_designmatrix(M, params=None):
+    """Scale design-matrix columns to unit norm.
+
+    Equivalent of reference `normalize_designmatrix` (`src/pint/utils.py:2900`):
+    returns (M_normalized, norms).  Columns with zero norm are left unscaled
+    (norm reported as 1) — those are degenerate parameters, flagged by the
+    fitters.  Works on numpy and jax arrays.
+    """
+    xp = get_xp(M)
+    norms = xp.sqrt(xp.sum(M * M, axis=0))
+    safe = xp.where(norms == 0.0, 1.0, norms)
+    return M / safe, safe
+
+
+def sherman_morrison_dot(Ndiag, U, phi, x, y):
+    """x^T C^-1 y and logdet C for C = diag(Ndiag) + phi * U U^T (rank-1 per
+    column of U with equal weight phi).  See reference `utils.py:3047`.
+
+    Here U is (N, k) with *disjoint* unit-block columns (ECORR quantization),
+    so the Sherman-Morrison update per column is exact and independent.
+    Returns (dot, logdet).
+    """
+    xp = _xp(Ndiag)
+    Ninv_x = x / Ndiag
+    Ninv_y = y / Ndiag
+    dot = xp.sum(x * Ninv_y)
+    logdet = xp.sum(xp.log(Ndiag))
+    Utx = U.T @ Ninv_x
+    Uty = U.T @ Ninv_y
+    UtNU = xp.sum((U * U).T / Ndiag, axis=1)
+    denom = 1.0 + phi * UtNU
+    dot = dot - xp.sum(phi * Utx * Uty / denom)
+    logdet = logdet + xp.sum(xp.log(denom))
+    return dot, logdet
+
+
+def woodbury_dot(Ndiag, U, Phidiag, x, y):
+    """x^T C^-1 y and logdet C for C = diag(Ndiag) + U diag(Phidiag) U^T.
+
+    Equivalent of reference `woodbury_dot` (`src/pint/utils.py:3097`).
+    Returns (dot, logdet).  Works for numpy and jax arrays.
+    """
+    xp = _xp(Ndiag)
+    Ninv_x = x / Ndiag
+    Ninv_y = y / Ndiag
+    UtNx = U.T @ Ninv_x
+    UtNy = U.T @ Ninv_y
+    Sigma = (U.T / Ndiag) @ U + _diag(xp, 1.0 / Phidiag)
+    cf = _cho_factor(xp, Sigma)
+    expval = _cho_solve(xp, cf, UtNy)
+    dot = xp.sum(x * Ninv_y) - UtNx @ expval
+    logdet = (
+        xp.sum(xp.log(Ndiag))
+        + xp.sum(xp.log(Phidiag))
+        + 2.0 * xp.sum(xp.log(_diag_of(xp, cf)))
+    )
+    return dot, logdet
+
+
+_xp = get_xp
+
+
+def _diag(xp, v):
+    return xp.diag(v)
+
+
+def _cho_factor(xp, A):
+    if xp is np:
+        return np.linalg.cholesky(A)
+    import jax.numpy as jnp
+
+    return jnp.linalg.cholesky(A)
+
+
+def _cho_solve(xp, L, b):
+    if xp is np:
+        import scipy.linalg as sl
+
+        y = sl.solve_triangular(L, b, lower=True)
+        return sl.solve_triangular(L.T, y, lower=False)
+    import jax.scipy.linalg as jsl
+
+    y = jsl.solve_triangular(L, b, lower=True)
+    return jsl.solve_triangular(L.T, y, lower=False)
+
+
+def _diag_of(xp, L):
+    return xp.diagonal(L)
+
+
+def interval_hash(lo: float, hi: float) -> int:
+    """Stable hash for (mjd-range) mask caching."""
+    return hash((round(float(lo), 9), round(float(hi), 9)))
+
+
+def split_prefixed_name(name: str):
+    """Split 'F12' -> ('F', 12), 'DMX_0003' -> ('DMX_', 3).
+
+    Equivalent of reference `split_prefixed_name` (`src/pint/utils.py:500`).
+    Raises ValueError when there is no trailing integer index.
+    """
+    i = len(name)
+    while i > 0 and name[i - 1].isdigit():
+        i -= 1
+    if i == len(name):
+        raise ValueError(f"{name!r} has no numeric suffix")
+    return name[:i], int(name[i:])
+
+
+def open_or_use(path_or_file, mode="r"):
+    """Context manager accepting either a path or an open file object."""
+    import contextlib
+    import io
+    import os
+
+    if isinstance(path_or_file, (str, bytes, os.PathLike)):
+        return open(path_or_file, mode)
+    return contextlib.nullcontext(path_or_file)
