@@ -1,0 +1,146 @@
+"""Device-resident TOA data: a frozen struct-of-arrays pytree.
+
+The reference keeps TOAs in an ``astropy.table.Table`` with per-row object
+columns (`/root/reference/src/pint/toa.py:1184,1228-1283`).  That layout is
+hostile to XLA: ragged flags, python objects, longdouble columns.  Here the
+TOA data that the *compute core* needs is a frozen pytree of dense f64/i64
+arrays, staged to HBM once per dataset and closed over by jitted residual /
+design-matrix / fit kernels.
+
+Everything host-side (flags, observatory names, selection, merging, clock
+bookkeeping) lives in :mod:`pint_tpu.toa`; this module is the device contract.
+
+Unit conventions (documented once, used everywhere):
+
+* times: TDB MJD as ``(day:int64, frac:float64)`` two-part values with
+  ``|frac| <= 0.5`` — the double-double expansion of the absolute MJD.
+* positions: light-seconds; velocities: dimensionless (v/c).
+* frequencies: MHz (inf = infinite frequency / barycentered data).
+* uncertainties: microseconds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TOABatch(NamedTuple):
+    """Struct-of-arrays TOA data for the jitted compute core.
+
+    Replaces the table columns built by the reference's
+    ``TOAs.compute_TDBs`` / ``compute_posvels``
+    (`/root/reference/src/pint/toa.py:2262,2334`).
+    """
+
+    #: TDB epoch at the observatory, integer MJD part, shape (N,)
+    tdb_day: jnp.ndarray
+    #: TDB epoch fractional MJD part (|frac| <= 0.5), shape (N,)
+    tdb_frac: jnp.ndarray
+    #: TOA uncertainty [us], shape (N,)
+    error_us: jnp.ndarray
+    #: observing frequency [MHz] (inf for barycentric/infinite), shape (N,)
+    freq_mhz: jnp.ndarray
+    #: observatory position wrt SSB [light-s], shape (N, 3)
+    ssb_obs_pos_ls: jnp.ndarray
+    #: observatory velocity wrt SSB [v/c, dimensionless], shape (N, 3)
+    ssb_obs_vel_c: jnp.ndarray
+    #: Sun position wrt observatory [light-s], shape (N, 3)
+    obs_sun_pos_ls: jnp.ndarray
+    #: tracked absolute pulse numbers (nan where absent), shape (N,)
+    pulse_number: jnp.ndarray
+    #: planet positions wrt observatory [light-s], each shape (N, 3);
+    #: keys among {"jupiter","saturn","venus","uranus","neptune","mercury","mars","moon"}
+    obs_planet_pos_ls: Dict[str, jnp.ndarray]
+
+    @property
+    def ntoas(self) -> int:
+        return self.tdb_day.shape[0]
+
+    def __len__(self) -> int:  # pragma: no cover - convenience
+        return self.ntoas
+
+    @property
+    def tdbld(self) -> jnp.ndarray:
+        """Lossy float64 TDB MJD (for quantities insensitive to ns)."""
+        return self.tdb_day + self.tdb_frac
+
+    def select(self, mask) -> "TOABatch":
+        """Row-subset along the TOA axis (host-side convenience)."""
+        mask = np.asarray(mask)
+        return TOABatch(
+            tdb_day=self.tdb_day[mask],
+            tdb_frac=self.tdb_frac[mask],
+            error_us=self.error_us[mask],
+            freq_mhz=self.freq_mhz[mask],
+            ssb_obs_pos_ls=self.ssb_obs_pos_ls[mask],
+            ssb_obs_vel_c=self.ssb_obs_vel_c[mask],
+            obs_sun_pos_ls=self.obs_sun_pos_ls[mask],
+            pulse_number=self.pulse_number[mask],
+            obs_planet_pos_ls={k: v[mask] for k, v in self.obs_planet_pos_ls.items()},
+        )
+
+
+def make_batch(
+    tdb_day,
+    tdb_frac,
+    error_us,
+    freq_mhz,
+    ssb_obs_pos_ls=None,
+    ssb_obs_vel_c=None,
+    obs_sun_pos_ls=None,
+    pulse_number=None,
+    obs_planet_pos_ls: Optional[Dict[str, np.ndarray]] = None,
+) -> TOABatch:
+    """Build a TOABatch, filling absent geometry with zeros.
+
+    Zero geometry corresponds to data already at the solar-system barycenter
+    (the reference's ``@``/``bat`` observatory,
+    `/root/reference/src/pint/observatory/special_locations.py:71`).
+    """
+    tdb_day = jnp.asarray(tdb_day, dtype=jnp.int64)
+    tdb_frac = jnp.asarray(tdb_frac, dtype=jnp.float64)
+    n = tdb_day.shape[0]
+    z3 = jnp.zeros((n, 3), dtype=jnp.float64)
+
+    def _arr(x, default):
+        return default if x is None else jnp.asarray(x, dtype=jnp.float64)
+
+    return TOABatch(
+        tdb_day=tdb_day,
+        tdb_frac=tdb_frac,
+        error_us=jnp.asarray(error_us, dtype=jnp.float64),
+        freq_mhz=jnp.asarray(freq_mhz, dtype=jnp.float64),
+        ssb_obs_pos_ls=_arr(ssb_obs_pos_ls, z3),
+        ssb_obs_vel_c=_arr(ssb_obs_vel_c, z3),
+        obs_sun_pos_ls=_arr(obs_sun_pos_ls, z3),
+        pulse_number=_arr(pulse_number, jnp.full((n,), jnp.nan)),
+        obs_planet_pos_ls=(
+            {}
+            if obs_planet_pos_ls is None
+            else {k: jnp.asarray(v, dtype=jnp.float64) for k, v in obs_planet_pos_ls.items()}
+        ),
+    )
+
+
+def concatenate(batches) -> TOABatch:
+    """Concatenate batches along the TOA axis (planet dicts must agree)."""
+    batches = list(batches)
+    keys = set(batches[0].obs_planet_pos_ls)
+    for b in batches[1:]:
+        if set(b.obs_planet_pos_ls) != keys:
+            raise ValueError("cannot concatenate TOABatches with differing planet sets")
+    cat = jnp.concatenate
+    return TOABatch(
+        tdb_day=cat([b.tdb_day for b in batches]),
+        tdb_frac=cat([b.tdb_frac for b in batches]),
+        error_us=cat([b.error_us for b in batches]),
+        freq_mhz=cat([b.freq_mhz for b in batches]),
+        ssb_obs_pos_ls=cat([b.ssb_obs_pos_ls for b in batches]),
+        ssb_obs_vel_c=cat([b.ssb_obs_vel_c for b in batches]),
+        obs_sun_pos_ls=cat([b.obs_sun_pos_ls for b in batches]),
+        pulse_number=cat([b.pulse_number for b in batches]),
+        obs_planet_pos_ls={k: cat([b.obs_planet_pos_ls[k] for b in batches]) for k in keys},
+    )

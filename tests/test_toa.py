@@ -1,0 +1,217 @@
+"""TOA layer tests: tim parsing, inline commands, pipeline, batch export.
+
+Mirrors the reference's test strategy for its TOA layer
+(`/root/reference/tests/test_toa_reader.py` etc.) without copying its data:
+synthetic tim text here, plus golden checks against reference datafiles read
+in place from /root/reference when present.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pint_tpu import mjd as mjdmod
+from pint_tpu.exceptions import TimFileError
+from pint_tpu.toa import (
+    TOAs,
+    get_TOAs,
+    get_TOAs_array,
+    merge_TOAs,
+    read_tim,
+    write_tim,
+)
+
+REFDATA = "/root/reference/tests/datafile"
+needs_refdata = pytest.mark.skipif(
+    not os.path.isdir(REFDATA), reason="reference datafiles not mounted"
+)
+
+TIM = """FORMAT 1
+fake.ff 1400.000000 55000.0000000000000 1.000 gbt -be GUPPI
+fake.ff 1400.000000 55001.1234567890123 2.000 ao -be PUPPI -jump 1
+fake.ff 428.000000 55002.5000000000000 3.000 @
+"""
+
+
+def _lines(s):
+    return s.splitlines(keepends=True)
+
+
+class TestParsing:
+    def test_tempo2_basic(self):
+        toas, cmds = read_tim(_lines(TIM))
+        assert len(toas) == 3
+        assert toas[0].obs == "gbt"
+        assert toas[0].flags["be"] == "GUPPI"
+        assert toas[1].obs == "arecibo"
+        assert toas[2].obs == "barycenter"
+        assert np.isclose(toas[1].error_us, 2.0)
+        # exact two-part epoch parse
+        assert toas[1].mjd.day == 55001
+        assert abs(float(toas[1].mjd.frac) - 0.1234567890123) < 1e-16
+
+    def test_infinite_freq(self):
+        toas, _ = read_tim(_lines("FORMAT 1\naa 0.0 55000.0 1.0 gbt\n"))
+        assert np.isinf(toas[0].freq_mhz)
+
+    def test_bad_flags_raise(self):
+        with pytest.raises(TimFileError):
+            read_tim(_lines("FORMAT 1\naa 1400 55000.0 1.0 gbt -lonely\n"))
+
+    def test_comments_skipped(self):
+        s = "FORMAT 1\n# comment\nC also comment\naa 1400 55000.0 1.0 gbt\n"
+        toas, _ = read_tim(_lines(s))
+        assert len(toas) == 1
+
+    def test_princeton_format(self):
+        # Princeton: obs char, freq cols 16-24, TOA cols 25-44, err 45-53
+        line = ("1 fake         " + " 1400.000" + "55000.1234567890123 "
+                + "     3.00" + "\n")
+        toas, _ = read_tim(_lines(line))
+        assert toas[0].obs == "gbt"
+        assert toas[0].mjd.day == 55000
+        assert abs(float(toas[0].mjd.frac) - 0.1234567890123) < 1e-16
+        assert toas[0].error_us == 3.0
+
+
+class TestCommands:
+    def test_efac_equad(self):
+        s = "FORMAT 1\nEFAC 2.0\nEQUAD 3.0\naa 1400 55000.0 4.0 gbt\n"
+        toas, _ = read_tim(_lines(s))
+        assert np.isclose(toas[0].error_us, np.hypot(8.0, 3.0))
+
+    def test_emin_filters(self):
+        s = "FORMAT 1\nEMIN 2.0\naa 1400 55000.0 1.0 gbt\nbb 1400 55001.0 3.0 gbt\n"
+        toas, _ = read_tim(_lines(s))
+        assert len(toas) == 1 and toas[0].flags["name"] == "bb"
+
+    def test_skip_noskip(self):
+        s = ("FORMAT 1\naa 1400 55000.0 1.0 gbt\nSKIP\nbb 1400 55001.0 1.0 gbt\n"
+             "NOSKIP\ncc 1400 55002.0 1.0 gbt\n")
+        toas, _ = read_tim(_lines(s))
+        assert [t.flags["name"] for t in toas] == ["aa", "cc"]
+
+    def test_end(self):
+        s = "FORMAT 1\naa 1400 55000.0 1.0 gbt\nEND\nbb 1400 55001.0 1.0 gbt\n"
+        toas, _ = read_tim(_lines(s))
+        assert len(toas) == 1
+
+    def test_time_offset_flagged_then_applied_with_clock(self):
+        s = "FORMAT 1\nTIME 1.5\naa 1400 55000.0 1.0 gbt\nTIME -1.5\nbb 1400 55000.0 1.0 gbt\n"
+        toas, _ = read_tim(_lines(s))
+        # parse only records the flag (raw MJD unchanged, like the reference)
+        assert toas[0].flags["to"] == "1.5"
+        assert float(toas[0].mjd.frac) == 0.0
+        assert "to" not in toas[1].flags
+        # the offset lands during clock correction
+        t = TOAs(toas)
+        t.apply_clock_corrections()
+        assert abs(float(t.utc.frac[0]) - 1.5 / 86400.0) < 1e-15
+        assert float(t.utc.frac[1]) == 0.0
+        assert t.flags[0]["clkcorr"] == "1.5"
+        # and write_tim round-trips back to the raw epoch + flag
+        lst = t.to_list()
+        assert float(lst[0].mjd.frac) == 0.0 and lst[0].flags["to"] == "1.5"
+        assert "clkcorr" not in lst[0].flags
+
+    def test_jump_brackets(self):
+        s = ("FORMAT 1\nJUMP\naa 1400 55000.0 1.0 gbt\nJUMP\n"
+             "bb 1400 55001.0 1.0 gbt\nJUMP\ncc 1400 55002.0 1.0 gbt\nJUMP\n")
+        toas, _ = read_tim(_lines(s))
+        assert toas[0].flags["tim_jump"] == "1"
+        assert "tim_jump" not in toas[1].flags
+        assert toas[2].flags["tim_jump"] == "2"
+
+    def test_phase_flag(self):
+        s = "FORMAT 1\nPHASE 1\naa 1400 55000.0 1.0 gbt\nPHASE -1\nbb 1400 55001.0 1.0 gbt\n"
+        toas, _ = read_tim(_lines(s))
+        assert toas[0].flags["phase"] == "1"
+        assert "phase" not in toas[1].flags
+
+    def test_include(self, tmp_path):
+        inc = tmp_path / "inc.tim"
+        inc.write_text("FORMAT 1\nbb 1400 55001.0 1.0 gbt\n")
+        main = tmp_path / "main.tim"
+        main.write_text(f"FORMAT 1\naa 1400 55000.0 1.0 gbt\nINCLUDE inc.tim\n")
+        toas, _ = read_tim(str(main))
+        assert len(toas) == 2
+
+
+class TestTOAsObject:
+    def _toas(self):
+        return TOAs(read_tim(_lines(TIM))[0])
+
+    def test_columns(self):
+        t = self._toas()
+        assert t.ntoas == 3
+        assert set(t.observatories) == {"gbt", "arecibo", "barycenter"}
+        assert t.first_MJD == 55000.0
+
+    def test_select(self):
+        t = self._toas()
+        sub = t.select(t.obs == "gbt")
+        assert sub.ntoas == 1 and sub.flags[0]["be"] == "GUPPI"
+        assert sub.index.tolist() == [0]
+
+    def test_pipeline_and_batch(self):
+        t = self._toas()
+        t.apply_clock_corrections()
+        t.compute_TDBs(ephem="builtin")
+        t.compute_posvels(ephem="builtin", planets=True)
+        b = t.to_batch()
+        assert b.ntoas == 3
+        # TDB-UTC = (TAI-UTC) + 32.184 + (TDB-TT); 34 leap seconds at MJD 55000
+        dt = (b.tdb_day + b.tdb_frac - t.utc.mjd_float) * 86400.0
+        expected = mjdmod.tai_minus_utc(t.utc.day) + 32.184
+        assert np.all(np.abs(np.asarray(dt) - expected) < 0.01)
+        # barycentric TOA has zero geometry; site TOAs ~1 AU = ~499 ls
+        r = np.linalg.norm(np.asarray(b.ssb_obs_pos_ls), axis=1)
+        assert r[2] == 0.0
+        assert 480 < r[0] < 520
+        # sun is ~1 AU from the observatory
+        rs = np.linalg.norm(np.asarray(b.obs_sun_pos_ls), axis=1)
+        assert 480 < rs[0] < 520
+        assert set(b.obs_planet_pos_ls) == {"jupiter", "saturn", "venus",
+                                            "uranus", "neptune"}
+        # frac centered
+        assert np.all(np.abs(np.asarray(b.tdb_frac)) <= 0.5)
+
+    def test_roundtrip_write(self, tmp_path):
+        t = self._toas()
+        p = tmp_path / "out.tim"
+        write_tim(str(p), t)
+        t2 = TOAs(read_tim(str(p))[0])
+        assert t2.ntoas == t.ntoas
+        np.testing.assert_array_equal(t2.utc.day, t.utc.day)
+        np.testing.assert_allclose(t2.utc.frac, t.utc.frac, atol=1e-16, rtol=0)
+        np.testing.assert_allclose(t2.error_us, t.error_us)
+
+    def test_merge(self):
+        t = self._toas()
+        m = merge_TOAs([t, t])
+        assert m.ntoas == 6
+
+    def test_get_toas_array(self):
+        t = get_TOAs_array(np.array([55000.0, 55100.5]), obs="gbt",
+                           errors_us=1.0, freqs_mhz=1400.0, ephem="builtin")
+        assert t.ntoas == 2
+        assert t.ssb_obs_pos is not None
+
+
+@needs_refdata
+class TestReferenceData:
+    def test_ngc6440e(self):
+        t = get_TOAs(os.path.join(REFDATA, "NGC6440E.tim"), ephem="builtin")
+        assert t.ntoas == 62
+        assert t.observatories == {"gbt"}
+        assert 53478 < t.first_MJD < 53479
+
+    def test_b1855_9yv1(self):
+        t = get_TOAs(os.path.join(REFDATA, "B1855+09_NANOGrav_9yv1.tim"),
+                     ephem="builtin")
+        assert t.ntoas == 4005
+        # NANOGrav data carries rich flags
+        assert "fe" in t.flags[0]
+        b = t.to_batch()
+        assert b.ntoas == 4005
