@@ -59,6 +59,18 @@ DMconst = 1.0 / 2.41e-4  # s MHz^2 cm^3 / pc
 GMsun = 1.32712440018e20  # m^3/s^2
 Tsun = GMsun / c**3  # 4.92549094765e-06 s
 
+#: planet masses in time units GM/c^3 [s], from the IAU 2009 system mass
+#: ratios (same convention as reference `src/pint/__init__.py:81-88`);
+#: Tearth includes the Moon.
+Tmercury = Tsun / 6023600.0
+Tvenus = Tsun / 408523.71
+Tearth = Tsun / 328900.56
+Tmars = Tsun / 3098708.0
+Tjupiter = Tsun / 1047.3486
+Tsaturn = Tsun / 3497.898
+Turanus = Tsun / 22902.98
+Tneptune = Tsun / 19412.24
+
 # Planetary GM values [m^3/s^2] (IAU/DE421-era values, as used for Shapiro
 # delays; reference `src/pint/__init__.py:92-106` uses the same bodies).
 GM_BODY = {

@@ -1,0 +1,47 @@
+"""Timing-model framework: parameters, components, model builder.
+
+The domain model follows the reference (`/root/reference/src/pint/models/`):
+a :class:`~pint_tpu.models.timing_model.TimingModel` is an ordered set of
+registered *components*, each owning typed *parameters*; models are built
+from ``.par`` files by parameter-ownership.  The compute representation is
+new: every component is a pure function of ``(params-pytree, TOABatch)``
+compiled by jit, and design matrices come from autodiff instead of the
+reference's hand-written derivative registry.
+"""
+
+from pint_tpu.models.parameter import (  # noqa: F401
+    AngleParam,
+    BoolParam,
+    FloatParam,
+    IntParam,
+    MaskParam,
+    MJDParam,
+    PairParam,
+    Param,
+    StrParam,
+    funcParameter,
+    maskParameter,
+    prefixParameter,
+)
+from pint_tpu.models.timing_model import (  # noqa: F401
+    Component,
+    DelayComponent,
+    PhaseComponent,
+    TimingModel,
+)
+
+# importing the component modules populates the registry
+from pint_tpu.models import (  # noqa: F401  isort:skip
+    absolute_phase,
+    astrometry,
+    dispersion,
+    jump,
+    phase_offset,
+    solar_system_shapiro,
+    spindown,
+)
+from pint_tpu.models.model_builder import (  # noqa: F401  isort:skip
+    get_model,
+    get_model_and_toas,
+    parse_parfile,
+)

@@ -1,0 +1,208 @@
+"""Astrometry: Roemer delay, parallax, proper motion.
+
+Reference: `Astrometry`/`AstrometryEquatorial`/`AstrometryEcliptic`
+(`/root/reference/src/pint/models/astrometry.py:56,406,942`).  The delay is
+
+    Δ = -r_obs · L̂(t)  +  (|r_perp|² / 2L)        [s]
+
+with r_obs the SSB→observatory vector in light-seconds,
+L̂(t) the unit vector to the pulsar propagated linearly by proper motion from
+POSEPOCH (the reference's optimized path linearizes identically,
+`astrometry.py:636-676`), and L = 1 kpc / PX[mas] the pulsar distance
+(`solar_system_geometric_delay`, `astrometry.py:264`).
+
+f64 is sufficient throughout: the worst term is ~500 s needing ~ps accuracy,
+within even TPU's 48-bit emulated f64.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from pint_tpu.models.parameter import AngleParam, FloatParam, MJDParam
+from pint_tpu.models.timing_model import DelayComponent, pv
+from pint_tpu.toabatch import TOABatch
+
+SECS_PER_DAY = 86400.0
+#: mas/yr -> rad/s
+MASYR_TO_RADS = (math.pi / (180.0 * 3600.0 * 1000.0)) / (365.25 * 86400.0)
+#: mas -> rad
+MAS_TO_RAD = math.pi / (180.0 * 3600.0 * 1000.0)
+#: 1 kpc in light-seconds
+KPC_LS = 3.0856775814913673e19 / 299792458.0
+#: IAU 2006 (IERS2010) mean obliquity of the ecliptic at J2000 [rad]
+OBLIQUITY_IERS2010 = 84381.406 * math.pi / (180.0 * 3600.0)
+_OBLIQUITY = {
+    "IERS2010": OBLIQUITY_IERS2010,
+    "IERS2003": 84381.4059 * math.pi / (180.0 * 3600.0),
+    "DE405": 84381.412 * math.pi / (180.0 * 3600.0),
+    "DE404": 84381.4227 * math.pi / (180.0 * 3600.0),
+}
+
+
+def _epoch_dt_yr(p, batch: TOABatch, epoch_name: str):
+    """(t - epoch) in julian years, f64 (proper-motion precision is ample)."""
+    day0 = p["const"][epoch_name][0] + p["const"][epoch_name][1] \
+        + p["delta"].get(epoch_name, 0.0)
+    return (batch.tdb_day + batch.tdb_frac - day0) / 365.25
+
+
+class Astrometry(DelayComponent):
+    """Shared Roemer/parallax machinery; subclasses provide L̂(t)."""
+
+    category = "astrometry"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParam("POSEPOCH",
+                                description="Epoch of the pulsar position"))
+        self.add_param(FloatParam("PX", value=0.0, units="mas",
+                                  description="Parallax"))
+
+    def psr_dir(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        """Unit vector SSB→pulsar at each TOA, shape (N, 3)."""
+        raise NotImplementedError
+
+    def pos_epoch_name(self) -> str:
+        if self.POSEPOCH.value is not None:
+            return "POSEPOCH"
+        if self._parent is not None and "PEPOCH" in self._parent \
+                and self._parent.PEPOCH.value is not None:
+            return "PEPOCH"
+        return ""
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        L_hat = self.psr_dir(p, batch)
+        r = batch.ssb_obs_pos_ls
+        re_dot_L = jnp.sum(r * L_hat, axis=1)
+        out = -re_dot_L
+        px = pv(p, "PX")
+        re_sqr = jnp.sum(r * r, axis=1)
+        # guard the 0/0 at exactly-barycentric TOAs
+        safe = jnp.where(re_sqr > 0.0, re_sqr, 1.0)
+        px_term = 0.5 * (re_sqr * px / KPC_LS) * (1.0 - re_dot_L**2 / safe)
+        return out + jnp.where(re_sqr > 0.0, px_term, 0.0)
+
+    # shared helper: linear proper-motion propagation of a unit vector
+    @staticmethod
+    def _propagate(n0, e_lon, e_lat, pm_lon, pm_lat, dt_yr):
+        dn = (e_lon * pm_lon[..., None] + e_lat * pm_lat[..., None])
+        n = n0 + dn * dt_yr[:, None]
+        return n / jnp.linalg.norm(n, axis=1, keepdims=True)
+
+
+class AstrometryEquatorial(Astrometry):
+    """ICRS RAJ/DECJ astrometry (reference `astrometry.py:406`)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(AngleParam("RAJ", units="H:M:S",
+                                  description="Right ascension (J2000)",
+                                  aliases=["RA"]))
+        self.add_param(AngleParam("DECJ", units="D:M:S",
+                                  description="Declination (J2000)",
+                                  aliases=["DEC"]))
+        self.add_param(FloatParam("PMRA", value=0.0, units="mas/yr",
+                                  par2dev=1.0,
+                                  description="Proper motion in RA*cos(DEC)"))
+        self.add_param(FloatParam("PMDEC", value=0.0, units="mas/yr",
+                                  par2dev=1.0,
+                                  description="Proper motion in DEC"))
+
+    def validate(self):
+        self.require("RAJ", "DECJ")
+
+    def psr_dir(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        a = pv(p, "RAJ")
+        d = pv(p, "DECJ")
+        sa, ca = jnp.sin(a), jnp.cos(a)
+        sd, cd = jnp.sin(d), jnp.cos(d)
+        n0 = jnp.stack(jnp.broadcast_arrays(cd * ca, cd * sa, sd), axis=-1)
+        n0 = jnp.broadcast_to(n0, (batch.ntoas, 3))
+        ep = self.pos_epoch_name()
+        if not ep:
+            return n0
+        # local east/north unit vectors; PM in rad/yr (PMRA already *cosδ)
+        e_ra = jnp.broadcast_to(
+            jnp.stack(jnp.broadcast_arrays(-sa, ca, jnp.zeros_like(sa)),
+                      axis=-1), (batch.ntoas, 3))
+        e_dec = jnp.broadcast_to(
+            jnp.stack(jnp.broadcast_arrays(-sd * ca, -sd * sa, cd), axis=-1),
+            (batch.ntoas, 3))
+        pm_ra = pv(p, "PMRA") * MAS_TO_RAD
+        pm_dec = pv(p, "PMDEC") * MAS_TO_RAD
+        dt_yr = _epoch_dt_yr(p, batch, ep)
+        return self._propagate(n0, e_ra, e_dec,
+                               jnp.broadcast_to(pm_ra, (batch.ntoas,)),
+                               jnp.broadcast_to(pm_dec, (batch.ntoas,)), dt_yr)
+
+
+class AstrometryEcliptic(Astrometry):
+    """Ecliptic-coordinate astrometry (ELONG/ELAT; reference
+    `astrometry.py:942`).  The ecliptic→ICRS transform is a rotation by the
+    mean obliquity about the x-axis; the convention is selected by ECL
+    (default IERS2010, from the reference's `ecliptic.dat`)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(AngleParam("ELONG", units="deg",
+                                  description="Ecliptic longitude",
+                                  aliases=["LAMBDA"]))
+        self.add_param(AngleParam("ELAT", units="deg",
+                                  description="Ecliptic latitude",
+                                  aliases=["BETA"]))
+        self.add_param(FloatParam("PMELONG", value=0.0, units="mas/yr",
+                                  description="PM in ecliptic longitude*cos(lat)",
+                                  aliases=["PMLAMBDA"]))
+        self.add_param(FloatParam("PMELAT", value=0.0, units="mas/yr",
+                                  description="PM in ecliptic latitude",
+                                  aliases=["PMBETA"]))
+
+    def validate(self):
+        self.require("ELONG", "ELAT")
+
+    def obliquity(self) -> float:
+        ecl = "IERS2010"
+        if self._parent is not None and self._parent.ECL.value:
+            ecl = self._parent.ECL.value
+        try:
+            return _OBLIQUITY[ecl]
+        except KeyError:
+            raise ValueError(f"unknown ecliptic convention ECL={ecl}")
+
+    def psr_dir(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        lon = pv(p, "ELONG")
+        lat = pv(p, "ELAT")
+        sl, cl = jnp.sin(lon), jnp.cos(lon)
+        sb, cb = jnp.sin(lat), jnp.cos(lat)
+        n0 = jnp.stack(jnp.broadcast_arrays(cb * cl, cb * sl, sb), axis=-1)
+        e_lon = jnp.stack(jnp.broadcast_arrays(-sl, cl, jnp.zeros_like(sl)),
+                          axis=-1)
+        e_lat = jnp.stack(jnp.broadcast_arrays(-sb * cl, -sb * sl, cb),
+                          axis=-1)
+        n0 = jnp.broadcast_to(n0, (batch.ntoas, 3))
+        ep = self.pos_epoch_name()
+        if ep:
+            pm_lon = pv(p, "PMELONG") * MAS_TO_RAD
+            pm_lat = pv(p, "PMELAT") * MAS_TO_RAD
+            dt_yr = _epoch_dt_yr(p, batch, ep)
+            n = self._propagate(
+                n0, jnp.broadcast_to(e_lon, (batch.ntoas, 3)),
+                jnp.broadcast_to(e_lat, (batch.ntoas, 3)),
+                jnp.broadcast_to(pm_lon, (batch.ntoas,)),
+                jnp.broadcast_to(pm_lat, (batch.ntoas,)), dt_yr)
+        else:
+            n = n0
+        # rotate ecliptic -> equatorial ICRS: R_x(-obliquity)
+        eps = self.obliquity()
+        ce, se = math.cos(eps), math.sin(eps)
+        x = n[:, 0]
+        y = n[:, 1] * ce - n[:, 2] * se
+        z = n[:, 1] * se + n[:, 2] * ce
+        return jnp.stack([x, y, z], axis=-1)

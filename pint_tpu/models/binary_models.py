@@ -1,0 +1,22 @@
+"""Binary-model registry: BINARY par value -> component class name.
+
+Filled in by the binary component modules (ELL1/BT/DD families; reference
+`/root/reference/src/pint/models/pulsar_binary.py:36` and
+`binary_*.py`).
+"""
+
+from __future__ import annotations
+
+from pint_tpu.exceptions import UnknownBinaryModel
+
+#: BINARY value (upper) -> registered component class name
+BINARY_COMPONENTS = {}
+
+
+def component_for(binary: str) -> str:
+    try:
+        return BINARY_COMPONENTS[binary.upper()]
+    except KeyError:
+        raise UnknownBinaryModel(
+            f"binary model {binary!r} is not implemented "
+            f"(available: {sorted(BINARY_COMPONENTS)})")

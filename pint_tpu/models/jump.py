@@ -1,0 +1,101 @@
+"""Phase and delay jumps over TOA subsets (JUMP mask parameters).
+
+Reference: `DelayJump`/`PhaseJump` (`/root/reference/src/pint/models/jump.py:11,78`).
+PhaseJump (the registered default) adds ``+JUMPn * F0`` cycles to the selected
+TOAs; DelayJump subtracts the value as a delay.  Selections are host-computed
+boolean masks in the pytree, so the device side is one dense masked sum.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import qs
+from pint_tpu.models.parameter import MaskParam
+from pint_tpu.models.timing_model import (
+    DelayComponent,
+    PhaseComponent,
+    pv,
+)
+from pint_tpu.toabatch import TOABatch
+
+
+class PhaseJump(PhaseComponent):
+    register = True
+    category = "phase_jump"
+
+    def __init__(self):
+        super().__init__()
+
+    def add_jump(self, index=None, key=None, key_value=(), value=0.0,
+                 frozen=True) -> MaskParam:
+        if index is None:
+            index = 1 + max([p.index or 0 for p in self.params.values()],
+                            default=0)
+        p = MaskParam("JUMP", index=index, key=key, key_value=key_value,
+                      value=value, frozen=frozen, units="s")
+        return self.add_param(p)
+
+    @property
+    def jumps(self):
+        return [p for p in self.params.values() if isinstance(p, MaskParam)]
+
+    def mask_families(self):
+        return ["JUMP"]
+
+    def make_param(self, name):
+        from pint_tpu.models.parameter import split_prefix
+
+        if name == "JUMP":
+            idx = 1 + max([par.index or 0 for par in self.params.values()],
+                          default=0)
+            return MaskParam("JUMP", index=idx, units="s")
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == "JUMP":
+            return MaskParam("JUMP", index=index, units="s")
+        return None
+
+    def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
+        total = jnp.zeros(batch.ntoas)
+        f0 = pv(p, "F0")
+        for jp in self.jumps:
+            m = p["mask"].get(jp.mask_pytree_name)
+            if m is None:  # mask set not built for this batch (e.g. TZR)
+                continue
+            total = total + pv(p, jp.name) * f0 * m
+        return qs.from_f64_device(total)
+
+
+class DelayJump(DelayComponent):
+    """Registered off by default, as in the reference (`jump.py:25`)."""
+
+    register = False
+    category = "jump_delay"
+
+    def __init__(self):
+        super().__init__()
+
+    def add_jump(self, index=None, key=None, key_value=(), value=0.0,
+                 frozen=True) -> MaskParam:
+        if index is None:
+            index = 1 + max([p.index or 0 for p in self.params.values()],
+                            default=0)
+        p = MaskParam("JUMP", index=index, key=key, key_value=key_value,
+                      value=value, frozen=frozen, units="s")
+        return self.add_param(p)
+
+    @property
+    def jumps(self):
+        return [p for p in self.params.values() if isinstance(p, MaskParam)]
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        total = jnp.zeros(batch.ntoas)
+        for jp in self.jumps:
+            m = p["mask"].get(jp.mask_pytree_name)
+            if m is None:
+                continue
+            total = total - pv(p, jp.name) * m
+        return total

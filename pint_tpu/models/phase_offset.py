@@ -1,0 +1,32 @@
+"""Explicit overall phase offset (PHOFF).
+
+Reference: `PhaseOffset` (`/root/reference/src/pint/models/phase_offset.py:10`):
+physical TOAs get ``-PHOFF`` cycles, the TZR TOA gets none (otherwise the
+offset would cancel in the TZR subtraction).  When PHOFF is present and free,
+residual mean-subtraction is disabled (see pint_tpu.residuals).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import qs
+from pint_tpu.models.parameter import FloatParam
+from pint_tpu.models.timing_model import PhaseComponent, pv
+from pint_tpu.toabatch import TOABatch
+
+
+class PhaseOffset(PhaseComponent):
+    register = True
+    category = "phase_offset"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(FloatParam("PHOFF", value=0.0, units="",
+                                  description="Overall phase offset"))
+
+    def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
+        if is_tzr:
+            return qs.zeros_like(jnp.zeros(batch.ntoas, jnp.float32))
+        val = -pv(p, "PHOFF") * jnp.ones(batch.ntoas)
+        return qs.from_f64_device(val)

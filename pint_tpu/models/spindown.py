@@ -1,0 +1,129 @@
+"""Spindown: Taylor-series pulse phase from F0, F1, ... Fn.
+
+Reference: `Spindown` (`/root/reference/src/pint/models/spindown.py:21`),
+which evaluates `taylor_horner` on longdouble barycentric time.  Here the
+reference values of (PEPOCH, F0..Fn) reach the device as exact quad-single
+words and the big Taylor sum runs in QS (~90 bits); the differentiable
+fit offsets contribute through a plain-f64 Taylor term that is exact at
+offset scales.  phase = QS(Σ F_k dt^{k+1}/(k+1)!) + f64(Σ δF_k dt^{k+1}/(k+1)!).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from pint_tpu import qs
+from pint_tpu.models.parameter import (
+    FloatParam,
+    MJDParam,
+    prefixParameter,
+    split_prefix,
+)
+from pint_tpu.models.timing_model import PhaseComponent, mjd_parts
+from pint_tpu.toabatch import TOABatch
+from pint_tpu.utils import taylor_horner
+
+SECS_PER_DAY = 86400.0
+
+
+def dt_seconds_qs(p: dict, batch: TOABatch, delay, epoch_name: str):
+    """(t_TDB - epoch - delay) in seconds, as (QS, f64) views.
+
+    The QS path: integer-day difference (exact in f32: |Δday| < 2^24) +
+    exact frac words - epoch frac words - delay, all error-free; the f64
+    view is the collapse for delay-level consumers.
+    """
+    day0, frac0_qs, ddays = mjd_parts(p, epoch_name)
+    dday = (batch.tdb_day.astype(jnp.float64) - day0).astype(jnp.float32)
+    w = batch.tdb_frac_w
+    dt_days = qs.QS(dday, w[:, 0], w[:, 1], jnp.zeros_like(dday))
+    dt_days = qs.add(dt_days, qs.QS(w[:, 2], *[jnp.zeros_like(dday)] * 3))
+    dt_days = qs.sub(dt_days, qs.QS(*[jnp.broadcast_to(x, dday.shape)
+                                      for x in frac0_qs.words]))
+    dt_sec = qs.mul_w(dt_days, jnp.float32(SECS_PER_DAY))
+    # delay [s] (f64, ≤ ~1e3 s) and the epoch fit-offset [days] enter at
+    # f64 precision, ample at their scales
+    shift = -delay - ddays * SECS_PER_DAY
+    dt_sec = qs.add(dt_sec, qs.from_f64_device(shift))
+    return dt_sec, qs.to_f64(dt_sec)
+
+
+class Spindown(PhaseComponent):
+    """Pulsar spin-down polynomial phase."""
+
+    register = True
+    category = "spindown"
+
+    def __init__(self, max_order: int = 12):
+        super().__init__()
+        self.add_param(MJDParam("PEPOCH",
+                                description="Epoch of spin measurements"))
+        self.add_param(prefixParameter("float", "F0", units="Hz",
+                                       description_template=lambda i:
+                                       f"Spin frequency derivative {i}" if i
+                                       else "Spin frequency",
+                                       long_double=True))
+        self._max_order = max_order
+
+    def setup(self):
+        # nothing to precompute; F-family discovered via prefix_params
+        pass
+
+    def validate(self):
+        self.require("F0")
+        fs = self.f_names()
+        # contiguous F0..Fn required (reference validates the same way)
+        for i, n in enumerate(fs):
+            if n != f"F{i}":
+                raise ValueError(f"non-contiguous spin sequence at {n}")
+        if self.PEPOCH.value is None and len(fs) > 1:
+            raise ValueError("PEPOCH is required when fitting derivatives")
+
+    def f_names(self) -> List[str]:
+        return [p.name for p in self.prefix_params("F")]
+
+    def qs_param_names(self):
+        return self.f_names()
+
+    def add_f_term(self, index: int, value=0.0, frozen=True):
+        return self.add_param(
+            prefixParameter("float", f"F{index}",
+                            units=f"Hz/s^{index}" if index else "Hz",
+                            value=value, frozen=frozen, long_double=True))
+
+    def make_param(self, name):
+        prefix, index = split_prefix(name)
+        if prefix == "F" and index <= self._max_order:
+            return prefixParameter("float", name,
+                                   units=f"Hz/s^{index}" if index else "Hz",
+                                   long_double=True)
+        return None
+
+    def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
+        from pint_tpu.models.timing_model import dv, pqs
+
+        names = self.f_names()
+        if self.PEPOCH.value is not None:
+            dt_qs, dt64 = dt_seconds_qs(p, batch, delay, "PEPOCH")
+        else:
+            # no epoch: time measured from MJD given by the data itself is
+            # not meaningful for higher derivatives; validate() forbids it
+            day0 = batch.tdb_day[0].astype(jnp.float64)
+            dday = (batch.tdb_day.astype(jnp.float64) - day0).astype(jnp.float32)
+            w = batch.tdb_frac_w
+            dt_days = qs.QS(dday, w[:, 0], w[:, 1], w[:, 2])
+            dt_qs = qs.mul_w(dt_days, jnp.float32(SECS_PER_DAY))
+            dt_qs = qs.add(dt_qs, qs.from_f64_device(-delay))
+            dt64 = qs.to_f64(dt_qs)
+
+        zero32 = jnp.zeros_like(dt_qs.w0)
+        coeffs_qs = [qs.zeros_like(zero32)] + [
+            qs.QS(*[jnp.broadcast_to(x, zero32.shape)
+                    for x in pqs(p, n).words]) for n in names]
+        ph = qs.horner_taylor(dt_qs, coeffs_qs)
+        # differentiable correction from the fit offsets, exact at f64
+        dph = taylor_horner(dt64, [jnp.float64(0.0)] +
+                            [dv(p, n) for n in names])
+        return qs.add(ph, qs.from_f64_device(dph))

@@ -1,0 +1,581 @@
+"""TimingModel and the component framework.
+
+The domain model mirrors the reference (`TimingModel`,
+`/root/reference/src/pint/models/timing_model.py:161`; `Component` registry,
+ibid:3613-4024): a model is an ordered collection of registered components —
+each a DelayComponent (seconds) or PhaseComponent (cycles) owning typed
+parameters — plus a handful of top-level metadata parameters.
+
+The compute representation is TPU-native and new:
+
+* Every component implements a **pure function** ``delay(p, batch)`` /
+  ``phase(p, batch, delay)`` over a params pytree ``p`` (device values +
+  host-computed mask arrays) and a :class:`~pint_tpu.toabatch.TOABatch`.
+  No mutation, no data-dependent python control flow: the whole composition
+  jit-compiles to one XLA program.
+* Absolute phase is accumulated in **double-double** (:mod:`pint_tpu.dd`) —
+  ~1e11 cycles with sub-1e-9-cycle accuracy — replacing the reference's
+  ``np.longdouble``.
+* The design matrix is **forward-mode autodiff** (`jax.jacfwd`) of the
+  residual function over the free-parameter vector, replacing the reference's
+  hand-written analytic-derivative registry (`d_phase_d_param`,
+  `/root/reference/src/pint/models/timing_model.py:2157`) — those analytic
+  forms survive only as test oracles.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import dd as ddm
+from pint_tpu.dd import DD
+from pint_tpu.exceptions import (
+    AliasConflict,
+    MissingParameter,
+    PrefixError,
+    TimingModelError,
+    UnknownParameter,
+)
+from pint_tpu.models.parameter import (
+    FloatParam,
+    MaskParam,
+    MJDParam,
+    Param,
+    StrParam,
+    make_prefixed_name,
+    split_prefix,
+)
+from pint_tpu.toabatch import TOABatch
+
+__all__ = ["Component", "DelayComponent", "PhaseComponent", "TimingModel",
+           "DEFAULT_ORDER", "PhaseCalc"]
+
+#: evaluation order of delay/phase contributions, by component category
+#: (matches the reference's DEFAULT_ORDER,
+#: `/root/reference/src/pint/models/timing_model.py:119`)
+DEFAULT_ORDER = [
+    "astrometry",
+    "jump_delay",
+    "troposphere",
+    "solar_system_shapiro",
+    "solar_wind",
+    "dispersion_constant",
+    "dispersion_dmx",
+    "dispersion_jump",
+    "chromatic",
+    "pulsar_system",
+    "frequency_dependent",
+    "absolute_phase",
+    "spindown",
+    "glitch",
+    "piecewise_spindown",
+    "phase_jump",
+    "wave",
+    "wavex",
+    "ifunc",
+    "phase_offset",
+]
+
+
+def pv(p: dict, name: str):
+    """Current f64 device value of a parameter: reference + offset."""
+    return p["const"][name] + p["delta"].get(name, 0.0)
+
+
+def dv(p: dict, name: str):
+    """Just the (traced, differentiable) offset of a parameter."""
+    return p["delta"].get(name, jnp.float64(0.0))
+
+
+def pqs(p: dict, name: str):
+    """Reference value as a QS (exact, non-differentiated)."""
+    from pint_tpu import qs
+
+    w = p["const"][name + "__qs"]
+    return qs.QS(w[..., 0], w[..., 1], w[..., 2], w[..., 3])
+
+
+def mjd_parts(p: dict, name: str):
+    """(day:f64, frac_qs:QS, delta_days:f64) of an MJD parameter."""
+    from pint_tpu import qs
+
+    c = p["const"][name]
+    w = p["const"][name + "__fracqs"]
+    return (c[0], qs.QS(w[..., 0], w[..., 1], w[..., 2], w[..., 3]),
+            dv(p, name))
+
+
+def mask_of(p: dict, param: MaskParam):
+    return p["mask"][param.mask_pytree_name]
+
+
+class Component:
+    """Base component: owns parameters, auto-registers subclasses.
+
+    Registration mirrors the reference's ``ModelMeta``
+    (`/root/reference/src/pint/models/timing_model.py:3613`) via
+    ``__init_subclass__``.
+    """
+
+    #: subclass name -> class, for every class with ``register = True``
+    component_types: Dict[str, type] = {}
+    register = False
+    category = ""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.__dict__.get("register", cls.register):
+            Component.component_types[cls.__name__] = cls
+
+    def __init__(self):
+        self.params: Dict[str, Param] = {}
+        self._parent: Optional["TimingModel"] = None
+
+    # -- parameter management --------------------------------------------
+    def add_param(self, p: Param):
+        self.params[p.name] = p
+        return p
+
+    def remove_param(self, name: str):
+        del self.params[name]
+
+    def __getattr__(self, name):
+        params = self.__dict__.get("params")
+        if params is not None and name in params:
+            return params[name]
+        raise AttributeError(
+            f"{type(self).__name__} has no attribute/parameter {name!r}")
+
+    @property
+    def free_params_component(self) -> List[str]:
+        return [p.name for p in self.params.values() if not p.frozen]
+
+    def prefix_params(self, prefix: str) -> List[Param]:
+        """All params of a prefix family, sorted by index."""
+        out = [p for p in self.params.values() if p.prefix == prefix]
+        return sorted(out, key=lambda p: (p.index is None, p.index))
+
+    # -- lifecycle --------------------------------------------------------
+    def setup(self):
+        """Post-parse hook (build prefix lists etc.)."""
+
+    def validate(self):
+        """Raise on inconsistent parameters."""
+
+    def require(self, *names):
+        for n in names:
+            p = self.params.get(n)
+            if p is None or p.value is None:
+                raise MissingParameter(
+                    f"{type(self).__name__} requires parameter {n}")
+
+    # -- device-side ------------------------------------------------------
+    def device_entries(self) -> Dict[str, np.ndarray]:
+        """This component's contributions to the params pytree."""
+        out = {}
+        for p in self.params.values():
+            if p.on_device and p.value is not None:
+                out[p.name] = p.device_value
+        return out
+
+    def mask_entries(self, toas) -> Dict[str, np.ndarray]:
+        """Host-computed TOA-mask arrays for this component's MaskParams."""
+        out = {}
+        for p in self.params.values():
+            if isinstance(p, MaskParam) and p.value is not None:
+                out[p.mask_pytree_name] = p.select_mask(toas).astype(np.float64)
+        return out
+
+    def qs_param_names(self) -> List[str]:
+        """Parameters whose reference values must reach the device in exact
+        quad-single words (phase-level precision).  Default: none."""
+        return []
+
+
+class DelayComponent(Component):
+    """A time-delay contribution [seconds]."""
+
+    def delay(self, p: dict, batch: TOABatch, delay: jnp.ndarray) -> jnp.ndarray:
+        """Return this component's delay [s] given the accumulated delay so
+        far (used e.g. by binary models to evaluate at the barycentered
+        epoch)."""
+        raise NotImplementedError
+
+
+class PhaseComponent(Component):
+    """A pulse-phase contribution [cycles], returned as a quad-single
+    (:class:`pint_tpu.qs.QS`) so absolute phase keeps ~90 bits on device."""
+
+    def phase(self, p: dict, batch: TOABatch, delay: jnp.ndarray,
+              is_tzr: bool = False):
+        """``is_tzr`` is a *static* flag: True when evaluating the TZR
+        reference TOA (PhaseOffset contributes nothing there)."""
+        raise NotImplementedError
+
+
+class PhaseCalc:
+    """The jit-facing pure functions of a frozen model structure.
+
+    Bound methods of this object close over *static* model structure
+    (component list, which parameters exist, bool/str configuration) while
+    all *numeric* state flows through the params pytree — so jit caches one
+    XLA program per model structure, reusable across fits.
+    """
+
+    def __init__(self, delay_components: Sequence[DelayComponent],
+                 phase_components: Sequence[PhaseComponent]):
+        self.delay_components = list(delay_components)
+        self.phase_components = list(phase_components)
+
+    def delay(self, p: dict, batch: TOABatch,
+              upto: Optional[str] = None) -> jnp.ndarray:
+        """Total delay [s], accumulated in the reference's evaluation order
+        (`TimingModel.delay`, `/root/reference/src/pint/models/timing_model.py:1634`).
+        ``upto``: stop before the named component category (exclusive), for
+        'barycentering' partial delays."""
+        d = jnp.zeros(batch.ntoas)
+        for comp in self.delay_components:
+            if upto is not None and comp.category == upto:
+                break
+            d = d + comp.delay(p, batch, d)
+        return d
+
+    def phase(self, p: dict, batch: TOABatch,
+              tzr_batch: Optional[TOABatch] = None, is_tzr: bool = False):
+        """Total absolute phase [cycles] as a quad-single; if ``tzr_batch``
+        is given, the phase at the TZR TOA is subtracted (reference
+        `/root/reference/src/pint/models/timing_model.py:1669-1701`)."""
+        from pint_tpu import qs
+
+        delay = self.delay(p, batch)
+        total = qs.zeros_like(jnp.zeros(batch.ntoas, jnp.float32))
+        for comp in self.phase_components:
+            total = qs.add(total, comp.phase(p, batch, delay, is_tzr=is_tzr))
+        if tzr_batch is not None:
+            # the TZR TOA carries its own (1-row) mask arrays
+            p_tzr = {"const": p["const"], "delta": p["delta"],
+                     "mask": p.get("tzr_mask", {})}
+            tzr = self.phase(p_tzr, tzr_batch, None, is_tzr=True)
+            total = qs.sub(total, qs.QS(*[jnp.broadcast_to(w, total.w0.shape)
+                                          for w in tzr.words]))
+        return total
+
+
+class TimingModel:
+    """A timing model: components + top-level metadata parameters.
+
+    Attribute access forwards to parameters (``model.F0`` is the Param;
+    ``model.F0.value`` its par-units value), as in the reference
+    (`/root/reference/src/pint/models/timing_model.py:564`).
+    """
+
+    def __init__(self, name: str = "", components: Sequence[Component] = ()):
+        self.name = name
+        self.components: Dict[str, Component] = {}
+        self.top_params: Dict[str, Param] = {}
+        for p in _top_level_params():
+            self.top_params[p.name] = p
+        for c in components:
+            self.add_component(c, setup=False)
+        self.tzr_batch: Optional[TOABatch] = None
+        self.meta: Dict[str, str] = {}
+
+    # -- structure --------------------------------------------------------
+    def add_component(self, comp: Component, setup=True, validate=False):
+        name = type(comp).__name__
+        if name in self.components:
+            raise TimingModelError(f"component {name} already present")
+        comp._parent = self
+        self.components[name] = comp
+        self._sort_components()
+        if setup:
+            comp.setup()
+        if validate:
+            comp.validate()
+
+    def remove_component(self, name: str):
+        self.components.pop(name)._parent = None
+
+    def _sort_components(self):
+        def key(item):
+            cat = item[1].category
+            return DEFAULT_ORDER.index(cat) if cat in DEFAULT_ORDER else \
+                len(DEFAULT_ORDER)
+
+        self.components = dict(sorted(self.components.items(), key=key))
+
+    @property
+    def delay_components(self) -> List[DelayComponent]:
+        return [c for c in self.components.values()
+                if isinstance(c, DelayComponent)]
+
+    @property
+    def phase_components(self) -> List[PhaseComponent]:
+        return [c for c in self.components.values()
+                if isinstance(c, PhaseComponent)]
+
+    def setup(self):
+        for c in self.components.values():
+            c.setup()
+
+    def validate(self):
+        for c in self.components.values():
+            c.validate()
+
+    # -- parameter access -------------------------------------------------
+    def __getattr__(self, name):
+        tp = self.__dict__.get("top_params")
+        if tp and name in tp:
+            return tp[name]
+        comps = self.__dict__.get("components")
+        if comps:
+            for c in comps.values():
+                if name in c.params:
+                    return c.params[name]
+        raise AttributeError(f"timing model has no parameter {name!r}")
+
+    def __getitem__(self, name) -> Param:
+        try:
+            return getattr(self, name)
+        except AttributeError:
+            raise UnknownParameter(name)
+
+    def __contains__(self, name) -> bool:
+        try:
+            self[name]
+            return True
+        except UnknownParameter:
+            return False
+
+    def param_component(self, name: str) -> Optional[str]:
+        for cname, c in self.components.items():
+            if name in c.params:
+                return cname
+        return None
+
+    @property
+    def params(self) -> List[str]:
+        out = list(self.top_params)
+        for c in self.components.values():
+            out.extend(c.params)
+        return out
+
+    @property
+    def free_params(self) -> List[str]:
+        """Unfrozen *device-representable* parameters, in model order."""
+        out = []
+        for c in self.components.values():
+            for p in c.params.values():
+                if not p.frozen and p.on_device and p.value is not None:
+                    out.append(p.name)
+        return out
+
+    @free_params.setter
+    def free_params(self, names):
+        names = set(names)
+        for c in self.components.values():
+            for p in c.params.values():
+                if p.on_device:
+                    p.frozen = p.name not in names
+        missing = names - set(self.params)
+        if missing:
+            raise UnknownParameter(f"cannot free unknown parameters {missing}")
+
+    def get_params_dict(self, which="free") -> Dict[str, Param]:
+        names = self.free_params if which == "free" else self.params
+        return {n: self[n] for n in names}
+
+    # -- device pytree ----------------------------------------------------
+    #
+    # Precision architecture (load-bearing; see pint_tpu.qs): the pytree has
+    # three groups —
+    #
+    #   p["const"]: host-prepared reference values.  Plain float64 for
+    #       delay-level parameters (48-bit TPU f64 emulation is ample for
+    #       delays); exact quad-single f32 word arrays ``<name>__qs`` for
+    #       phase-level parameters (F0..Fn and epochs), built on HOST IEEE
+    #       floats.  MJD params appear as [day, frac] plus ``<name>__fracqs``
+    #       words.
+    #   p["delta"]: float64 *offsets from the reference values* in device
+    #       units, one per on-device parameter, all zero as built.  These are
+    #       the only leaves the fitters differentiate / move.  Offsets stay
+    #       small (they are fit corrections), so plain f64 carries them at
+    #       full accuracy even on TPU; the host re-applies them to the exact
+    #       parameter values between iterations (apply_deltas).
+    #   p["mask"]: host-computed per-TOA selection arrays for MaskParams.
+    #
+    # This linearization-point design is what lets one jitted XLA program
+    # serve every Gauss-Newton iteration with no recompilation and no
+    # precision loss.
+    def build_pdict(self, toas=None, tzr_toas=None) -> dict:
+        from pint_tpu import qs
+
+        const: Dict[str, np.ndarray] = {}
+        delta: Dict[str, np.ndarray] = {}
+        mask: Dict[str, np.ndarray] = {}
+        tzr_mask: Dict[str, np.ndarray] = {}
+        for c in self.components.values():
+            qs_names = set(c.qs_param_names())
+            for par in c.params.values():
+                if not (par.on_device and par.value is not None):
+                    continue
+                dv = par.device_value
+                const[par.name] = dv
+                if isinstance(par, MJDParam):
+                    w = qs.from_f64_host(np.float64(dv[1]))
+                    const[par.name + "__fracqs"] = np.stack(
+                        [np.float32(x) for x in w.words])
+                    delta[par.name] = np.float64(0.0)  # days
+                else:
+                    if par.name in qs_names:
+                        w = qs.from_f64_host(np.float64(dv))
+                        const[par.name + "__qs"] = np.stack(
+                            [np.float32(x) for x in w.words])
+                    delta[par.name] = np.zeros_like(np.asarray(dv, np.float64))
+            if toas is not None:
+                mask.update(c.mask_entries(toas))
+            if tzr_toas is not None:
+                tzr_mask.update(c.mask_entries(tzr_toas))
+        return {"const": const, "delta": delta, "mask": mask,
+                "tzr_mask": tzr_mask}
+
+    def apply_deltas(self, p: dict):
+        """Fold the (post-fit) offsets back into the host parameters and
+        zero them.  Host f64 arithmetic is exact at offset scales."""
+        for c in self.components.values():
+            for par in c.params.values():
+                if not (par.on_device and par.name in p["delta"]):
+                    continue
+                d = np.asarray(p["delta"][par.name], np.float64)
+                if not np.any(d):
+                    continue
+                if isinstance(par, MJDParam):
+                    dv = par.device_value
+                    par.set_device_value([dv[0], dv[1] + float(d)])
+                else:
+                    par.set_device_value(np.asarray(par.device_value) + d)
+                p["delta"][par.name] = np.zeros_like(d)
+
+    # free-vector <-> delta mapping (device units; offsets from const).
+    def x0(self, p: dict) -> jnp.ndarray:
+        return jnp.array([jnp.asarray(p["delta"][n], jnp.float64)
+                          for n in self.free_params])
+
+    def with_x(self, p: dict, x) -> dict:
+        delta = dict(p["delta"])
+        for i, n in enumerate(self.free_params):
+            delta[n] = x[i]
+        out = dict(p)
+        out["delta"] = delta
+        return out
+
+    def fit_units(self) -> List[float]:
+        """d(device)/d(par-file unit) per free param — for reporting
+        uncertainties and matching reference design-matrix units."""
+        out = []
+        for n in self.free_params:
+            par = self[n]
+            if isinstance(par, MJDParam):
+                out.append(1.0)  # fraction-of-day: par unit is days
+            else:
+                out.append(par.par2dev)
+        return out
+
+    # -- physics ----------------------------------------------------------
+    @property
+    def calc(self) -> PhaseCalc:
+        return PhaseCalc(self.delay_components, self.phase_components)
+
+    def delay(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        return self.calc.delay(p, batch)
+
+    def phase(self, p: dict, batch: TOABatch, abs_phase=True) -> DD:
+        tzr = self.tzr_batch if abs_phase else None
+        return self.calc.phase(p, batch, tzr)
+
+    @property
+    def F0_value(self) -> float:
+        return float(self.F0.value)
+
+    # -- TZR --------------------------------------------------------------
+    def attach_tzr(self, toas=None):
+        """Materialize the TZR reference TOA batch (host precompute); see
+        :mod:`pint_tpu.models.absolute_phase`."""
+        ab = self.components.get("AbsPhase")
+        if ab is None:
+            self.tzr_batch = None
+        else:
+            self.tzr_batch = ab.make_tzr_batch(
+                ephem=self.EPHEM.value or "DE421",
+                planets=bool(self.PLANET_SHAPIRO.value)
+                if "PLANET_SHAPIRO" in self else False,
+                toas=toas)
+        return self.tzr_batch
+
+    # -- par output -------------------------------------------------------
+    def as_parfile(self, comment: Optional[str] = None) -> str:
+        lines = []
+        if comment:
+            for ln in comment.splitlines():
+                lines.append(f"# {ln}\n")
+        for p in self.top_params.values():
+            lines.append(p.as_parfile_line())
+        for c in self.components.values():
+            for p in c.params.values():
+                lines.append(p.as_parfile_line())
+        return "".join(lines)
+
+    def write_parfile(self, path, **kw):
+        with open(path, "w") as f:
+            f.write(self.as_parfile(**kw))
+
+    def compare(self, other: "TimingModel") -> str:
+        """Quick textual model diff (reference `TimingModel.compare`,
+        `/root/reference/src/pint/models/timing_model.py:2521`)."""
+        rows = [f"{'PARAM':12s} {'THIS':>25s} {'OTHER':>25s}"]
+        names = dict.fromkeys(list(self.params) + list(other.params))
+        for n in names:
+            a = self[n].value if n in self else None
+            b = other[n].value if n in other else None
+            if a is None and b is None:
+                continue
+            av = self[n].value_as_string() if a is not None else "--"
+            bv = other[n].value_as_string() if b is not None else "--"
+            if av != bv:
+                rows.append(f"{n:12s} {av:>25s} {bv:>25s}")
+        return "\n".join(rows)
+
+    def __repr__(self):  # pragma: no cover
+        return (f"TimingModel({self.PSR.value or self.name}: "
+                f"{', '.join(self.components)})")
+
+
+def _top_level_params() -> List[Param]:
+    """Model-level metadata parameters (reference keeps these on TimingModel
+    itself, `/root/reference/src/pint/models/timing_model.py:263-402`)."""
+    return [
+        StrParam("PSR", description="Source name", aliases=["PSRJ", "PSRB"]),
+        StrParam("EPHEM", description="Solar-system ephemeris"),
+        StrParam("CLOCK", description="Timescale realization, e.g. TT(BIPM2021)",
+                 aliases=["CLK"]),
+        StrParam("UNITS", description="Units (TDB/TCB)"),
+        StrParam("TIMEEPH", description="Time ephemeris (FB90/IF99)"),
+        StrParam("T2CMETHOD", description="terrestrial-celestial method"),
+        StrParam("BINARY", description="Binary model name"),
+        StrParam("DILATEFREQ", description="tempo compat flag"),
+        StrParam("INFO", description="info string"),
+        StrParam("ECL", description="Ecliptic obliquity convention"),
+        StrParam("DMDATA", description="wideband DM data in use",
+                 aliases=[]),
+        StrParam("TRES", description="tempo residual RMS record"),
+        StrParam("MODE", description="tempo MODE record"),
+        StrParam("NTOA", description="number-of-TOAs record"),
+        StrParam("CHI2", description="fit chi2 record"),
+        StrParam("CHI2R", description="reduced chi2 record"),
+        StrParam("START", description="data span start"),
+        StrParam("FINISH", description="data span end"),
+    ]

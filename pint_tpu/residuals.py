@@ -1,0 +1,167 @@
+"""Phase/time residuals.
+
+Reference: `Residuals` (`/root/reference/src/pint/residuals.py:43`):
+residual = model phase - observed phase, with either "nearest"-integer
+tracking (each TOA assigned to the nearest predicted pulse) or explicit
+pulse-number tracking, then optional weighted-mean (or PHOFF) subtraction.
+
+Device split: the heavy part (`raw_phase_resids`) is a pure jittable function
+of (pdict, batch); the `Residuals` class is a thin host wrapper holding the
+compiled function, following the architecture in
+`pint_tpu/models/timing_model.py`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import qs
+from pint_tpu.models.timing_model import TimingModel, pv
+from pint_tpu.toabatch import TOABatch
+
+__all__ = ["Residuals", "raw_phase_resids", "build_resid_fn"]
+
+
+def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
+                     tzr_batch: Optional[TOABatch], track_mode: str,
+                     subtract_mean: bool, use_weights: bool) -> jnp.ndarray:
+    """Phase residuals [cycles, f64], jit-pure.
+
+    ``track_mode``: "nearest" drops the integer pulse number per TOA
+    (non-differentiable; the rounding is excluded from gradients);
+    "use_pulse_numbers" subtracts the batch's tracked pulse_number column
+    (reference `calc_phase_resids`, `/root/reference/src/pint/residuals.py:334-446`).
+    """
+    ph = model_calc.phase(p, batch, tzr_batch)
+    # phase-flag offsets from the tim file ride in pulse_number handling in
+    # the reference; here "nearest" removes any integer anyway.
+    if track_mode == "use_pulse_numbers":
+        pn = batch.pulse_number
+        pn = jnp.where(jnp.isnan(pn), 0.0, pn)
+        resid = ph
+        # subtract the (integer-valued, f64) pulse numbers exactly:
+        # feed them in as graded f32 words
+        w0 = pn.astype(jnp.float32)
+        r1 = pn - w0.astype(jnp.float64)
+        w1 = r1.astype(jnp.float32)
+        w2 = (r1 - w1.astype(jnp.float64)).astype(jnp.float32)
+        resid = qs.sub(resid, qs.from_words(w0, w1, w2))
+        out = qs.to_f64(resid)
+    elif track_mode == "nearest":
+        # jnp.round inside has zero derivative, so the fractional part's
+        # gradient is exactly d(phase)/d(params) — the non-differentiable
+        # integer assignment stays out of grad paths (SURVEY §7 hard-part 5)
+        _, frac = qs.round_nearest(ph)
+        out = qs.to_f64(frac)
+    else:
+        raise ValueError(f"unknown track_mode {track_mode!r}")
+    if subtract_mean:
+        if use_weights:
+            w = 1.0 / (batch.error_us ** 2)
+            out = out - jnp.sum(out * w) / jnp.sum(w)
+        else:
+            out = out - jnp.mean(out)
+    return out
+
+
+def build_resid_fn(model: TimingModel, batch: TOABatch,
+                   track_mode: str, subtract_mean: bool, use_weights: bool):
+    """A jitted ``(pdict) -> phase residuals [cycles]`` closure over the
+    static model structure and TOA data."""
+    calc = model.calc
+    tzr = model.tzr_batch
+
+    @jax.jit
+    def fn(p):
+        return raw_phase_resids(calc, p, batch, tzr, track_mode,
+                                subtract_mean, use_weights)
+
+    return fn
+
+
+class Residuals:
+    """Host-side residuals wrapper (reference `Residuals`,
+    `/root/reference/src/pint/residuals.py:43`)."""
+
+    def __init__(self, toas, model: TimingModel, track_mode: Optional[str] = None,
+                 subtract_mean: bool = True, use_weighted_mean: bool = True):
+        self.toas = toas
+        self.model = model
+        if track_mode is None:
+            tm = getattr(model, "TRACK", None)
+            track_mode = "use_pulse_numbers" if (
+                tm is not None and tm.value == "-2"
+                and toas.get_pulse_numbers() is not None) else "nearest"
+        if track_mode == "use_pulse_numbers" and \
+                toas.get_pulse_numbers() is None:
+            raise ValueError("track_mode use_pulse_numbers needs pulse numbers")
+        self.track_mode = track_mode
+        # PHOFF replaces mean subtraction (reference residuals.py:432-446)
+        has_phoff = "PhaseOffset" in model.components
+        self.subtract_mean = subtract_mean and not has_phoff
+        self.use_weighted_mean = use_weighted_mean
+        self.batch = toas.to_batch()
+        if model.tzr_batch is None and "AbsPhase" in model.components:
+            model.attach_tzr(toas)
+        self._fn = build_resid_fn(model, self.batch, self.track_mode,
+                                  self.subtract_mean, self.use_weighted_mean)
+        self.pdict = model.build_pdict(
+            toas, tzr_toas=model.components["AbsPhase"].make_tzr_toas(
+                ephem=model.EPHEM.value or "DE421")
+            if "AbsPhase" in model.components else None)
+        self._phase_resids: Optional[np.ndarray] = None
+
+    # -- computed quantities ---------------------------------------------
+    @property
+    def phase_resids(self) -> np.ndarray:
+        """Residuals in cycles."""
+        if self._phase_resids is None:
+            self._phase_resids = np.asarray(self._fn(self.pdict))
+        return self._phase_resids
+
+    @property
+    def time_resids(self) -> np.ndarray:
+        """Residuals in seconds."""
+        return self.phase_resids / float(self.model.F0.value)
+
+    def update(self):
+        """Re-evaluate after model changes."""
+        self.pdict = self.model.build_pdict(
+            self.toas,
+            tzr_toas=self.model.components["AbsPhase"].make_tzr_toas(
+                ephem=self.model.EPHEM.value or "DE421")
+            if "AbsPhase" in self.model.components else None)
+        self._phase_resids = None
+
+    def rms_weighted(self) -> float:
+        w = 1.0 / (self.toas.error_us * 1e-6) ** 2
+        r = self.time_resids
+        mean = np.sum(r * w) / np.sum(w)
+        return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))
+
+    def calc_chi2(self) -> float:
+        """Weighted chi2 against the scaled TOA uncertainties (white-noise
+        path; correlated-noise chi2 arrives with the GLS layer)."""
+        sigma_s = self.get_data_error() * 1e-6
+        return float(np.sum((self.time_resids / sigma_s) ** 2))
+
+    def get_data_error(self) -> np.ndarray:
+        """Scaled uncertainties [us] (EFAC/EQUAD once noise models exist)."""
+        scaled = getattr(self.model, "scaled_toa_uncertainty", None)
+        if scaled is not None:
+            return np.asarray(scaled(self.pdict, self.batch))
+        return self.toas.error_us
+
+    @property
+    def dof(self) -> int:
+        return self.toas.ntoas - len(self.model.free_params) - \
+            int(self.subtract_mean)
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.calc_chi2() / self.dof

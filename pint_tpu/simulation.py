@@ -1,0 +1,106 @@
+"""Simulation of fake TOAs ("zima"): the framework's no-hardware test
+backbone, as in the reference (`/root/reference/src/pint/simulation.py`).
+
+`make_fake_toas_uniform` synthesizes arrival times from a model by the
+reference's `zero_residuals` iteration (`simulation.py:30`): start from a
+uniform grid, evaluate model residuals with "nearest" tracking and no mean
+subtraction, shift the TOAs by -residual, repeat until |residual| < tol —
+the resulting arrival times are exactly on integer model phases.  Optional
+white measurement noise is then added.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu import mjd as mjdmod
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.residuals import build_resid_fn
+from pint_tpu.toa import TOAs, get_TOAs_array
+
+__all__ = ["zero_residuals", "make_fake_toas_uniform", "make_fake_toas_fromtim",
+           "update_fake_toa_errors"]
+
+
+def zero_residuals(toas: TOAs, model: TimingModel, maxiter: int = 10,
+                   tol_us: float = 1e-4) -> TOAs:
+    """Iteratively shift TOAs onto integer model phases (reference
+    `zero_residuals`, `/root/reference/src/pint/simulation.py:30`)."""
+    f0 = float(model.F0.value)
+    if model.tzr_batch is None and "AbsPhase" in model.components:
+        model.attach_tzr(toas)
+    for it in range(maxiter):
+        batch = toas.to_batch()
+        fn = build_resid_fn(model, batch, "nearest", False, False)
+        p = model.build_pdict(
+            toas, tzr_toas=model.components["AbsPhase"].make_tzr_toas(
+                ephem=model.EPHEM.value or "DE421")
+            if "AbsPhase" in model.components else None)
+        r_sec = np.asarray(fn(p)) / f0
+        if np.max(np.abs(r_sec)) < tol_us * 1e-6:
+            return toas
+        toas.utc = mjdmod.add_sec(toas.utc, -r_sec)
+        toas.compute_TDBs(ephem=toas.ephem)
+        toas.compute_posvels(ephem=toas.ephem, planets=toas.planets)
+    raise RuntimeError(
+        f"zero_residuals did not converge below {tol_us} us in {maxiter} "
+        f"iterations (last max {np.max(np.abs(r_sec))*1e6:.3g} us)")
+
+
+def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int,
+                           model: TimingModel, obs: str = "gbt",
+                           error_us: float = 1.0, freq_mhz=1400.0,
+                           fuzz_days: float = 0.0,
+                           add_noise: bool = False,
+                           ephem: Optional[str] = None,
+                           planets: Optional[bool] = None,
+                           seed: Optional[int] = None) -> TOAs:
+    """Uniformly spaced synthetic TOAs that the model predicts perfectly
+    (reference `make_fake_toas_uniform`,
+    `/root/reference/src/pint/simulation.py:208`)."""
+    rng = np.random.default_rng(seed)
+    times = np.linspace(startMJD, endMJD, ntoas)
+    if fuzz_days:
+        times = times + rng.uniform(-fuzz_days, fuzz_days, ntoas)
+    ephem = ephem or (model.EPHEM.value or "DE421")
+    if planets is None:
+        planets = bool(model.PLANET_SHAPIRO.value) \
+            if "PLANET_SHAPIRO" in model else False
+    freqs = np.broadcast_to(np.asarray(freq_mhz, np.float64), (ntoas,))
+    toas = get_TOAs_array(times, obs=obs, errors_us=error_us,
+                          freqs_mhz=freqs, ephem=ephem, planets=planets)
+    toas = zero_residuals(toas, model)
+    if add_noise:
+        noise = rng.standard_normal(ntoas) * toas.error_us * 1e-6
+        toas.utc = mjdmod.add_sec(toas.utc, noise)
+        toas.compute_TDBs(ephem=ephem)
+        toas.compute_posvels(ephem=ephem, planets=planets)
+    for f in toas.flags:
+        f.setdefault("simulated", "1")
+    return toas
+
+
+def make_fake_toas_fromtim(timfile, model: TimingModel,
+                           add_noise: bool = False,
+                           seed: Optional[int] = None) -> TOAs:
+    """Replace the TOAs of an existing tim file with model-perfect ones
+    (reference `make_fake_toas_fromtim`, `simulation.py:477`)."""
+    from pint_tpu.toa import get_TOAs
+
+    rng = np.random.default_rng(seed)
+    toas = get_TOAs(timfile, model=model)
+    toas = zero_residuals(toas, model)
+    if add_noise:
+        noise = rng.standard_normal(toas.ntoas) * toas.error_us * 1e-6
+        toas.utc = mjdmod.add_sec(toas.utc, noise)
+        toas.compute_TDBs(ephem=toas.ephem)
+        toas.compute_posvels(ephem=toas.ephem, planets=toas.planets)
+    return toas
+
+
+def update_fake_toa_errors(toas: TOAs, error_us) -> TOAs:
+    toas.error_us = np.broadcast_to(np.asarray(error_us, np.float64),
+                                    (toas.ntoas,)).copy()
+    return toas

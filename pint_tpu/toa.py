@@ -446,8 +446,16 @@ class TOAs:
         """UTC → TDB at each TOA (geocentric FB90 series; the topocentric
         term, ~2 us amplitude but smooth, is included via the observatory
         position when posvels are available later — cf. reference
-        `/root/reference/src/pint/toa.py:2262`)."""
-        self.tdb = mjdmod.utc_to_tdb(self.utc)
+        `/root/reference/src/pint/toa.py:2262`).
+
+        Barycentric ('@'/'bat') TOAs are *already* TDB by convention
+        (reference `special_locations.py:71` sets timescale tdb) and pass
+        through unchanged.
+        """
+        tdb = mjdmod.utc_to_tdb(self.utc)
+        bary = np.array([get_observatory(o).is_barycenter for o in self.obs])
+        self.tdb = MJD(np.where(bary, self.utc.day, tdb.day),
+                       np.where(bary, self.utc.frac, tdb.frac))
         self.ephem = self.ephem or ephem
 
     def compute_posvels(self, ephem: Optional[str] = "DE421", planets=False):
@@ -564,6 +572,8 @@ def get_TOAs_array(times, obs="bary", errors_us=1.0, freqs_mhz=np.inf,
     """
     if not isinstance(times, MJD):
         times = mjdmod.from_mjd_float(np.atleast_1d(np.asarray(times, np.float64)))
+    else:
+        times = MJD(np.atleast_1d(times.day), np.atleast_1d(times.frac))
     n = times.day.shape[0]
     errors_us = np.broadcast_to(np.asarray(errors_us, np.float64), (n,))
     freqs_mhz = np.broadcast_to(np.asarray(freqs_mhz, np.float64), (n,))

@@ -27,6 +27,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def split_f64_words(x: np.ndarray, nwords: int = 3) -> np.ndarray:
+    """Exact host-side split of true-IEEE float64 values into ``nwords``
+    non-overlapping float32 words (last axis).  sum(words) == x to 24*nwords
+    bits."""
+    x = np.asarray(x, np.float64)
+    out = np.zeros(x.shape + (nwords,), np.float32)
+    r = x.copy()
+    for k in range(nwords):
+        w = r.astype(np.float32)
+        out[..., k] = w
+        r = r - w.astype(np.float64)
+    return out
+
+
 class TOABatch(NamedTuple):
     """Struct-of-arrays TOA data for the jitted compute core.
 
@@ -39,6 +53,11 @@ class TOABatch(NamedTuple):
     tdb_day: jnp.ndarray
     #: TDB epoch fractional MJD part (|frac| <= 0.5), shape (N,)
     tdb_frac: jnp.ndarray
+    #: exact 3-word float32 decomposition of tdb_frac (w0+w1+w2 == frac to
+    #: 2^-72), shape (N, 3).  Host-precomputed because on-device f64→f32
+    #: splitting cannot be trusted under TPU's emulated (~48-bit) float64;
+    #: the quad-single phase kernels (pint_tpu.qs) consume these words.
+    tdb_frac_w: jnp.ndarray
     #: TOA uncertainty [us], shape (N,)
     error_us: jnp.ndarray
     #: observing frequency [MHz] (inf for barycentric/infinite), shape (N,)
@@ -73,6 +92,7 @@ class TOABatch(NamedTuple):
         return TOABatch(
             tdb_day=self.tdb_day[mask],
             tdb_frac=self.tdb_frac[mask],
+            tdb_frac_w=self.tdb_frac_w[mask],
             error_us=self.error_us[mask],
             freq_mhz=self.freq_mhz[mask],
             ssb_obs_pos_ls=self.ssb_obs_pos_ls[mask],
@@ -100,8 +120,9 @@ def make_batch(
     (the reference's ``@``/``bat`` observatory,
     `/root/reference/src/pint/observatory/special_locations.py:71`).
     """
+    frac64 = np.asarray(tdb_frac, np.float64)
     tdb_day = jnp.asarray(tdb_day, dtype=jnp.int64)
-    tdb_frac = jnp.asarray(tdb_frac, dtype=jnp.float64)
+    tdb_frac = jnp.asarray(frac64, dtype=jnp.float64)
     n = tdb_day.shape[0]
     z3 = jnp.zeros((n, 3), dtype=jnp.float64)
 
@@ -111,6 +132,7 @@ def make_batch(
     return TOABatch(
         tdb_day=tdb_day,
         tdb_frac=tdb_frac,
+        tdb_frac_w=jnp.asarray(split_f64_words(frac64), dtype=jnp.float32),
         error_us=jnp.asarray(error_us, dtype=jnp.float64),
         freq_mhz=jnp.asarray(freq_mhz, dtype=jnp.float64),
         ssb_obs_pos_ls=_arr(ssb_obs_pos_ls, z3),
@@ -136,6 +158,7 @@ def concatenate(batches) -> TOABatch:
     return TOABatch(
         tdb_day=cat([b.tdb_day for b in batches]),
         tdb_frac=cat([b.tdb_frac for b in batches]),
+        tdb_frac_w=cat([b.tdb_frac_w for b in batches]),
         error_us=cat([b.error_us for b in batches]),
         freq_mhz=cat([b.freq_mhz for b in batches]),
         ssb_obs_pos_ls=cat([b.ssb_obs_pos_ls for b in batches]),
