@@ -191,8 +191,7 @@ class ModelBuilder:
             comp.setup()
 
         model.setup()
-        for comp in model.components.values():
-            comp.validate()
+        model.validate()
         return model
 
     def _instantiate_member(self, comp: Component, canon: str) -> Param:

@@ -323,6 +323,14 @@ class TimingModel:
             c.setup()
 
     def validate(self):
+        # F0-only models may omit PEPOCH; but TZR-referenced absolute phase
+        # must not mix two implicit origins (data batch vs 1-row TZR batch),
+        # so anchor the spin epoch at TZRMJD in that case.
+        sd = self.components.get("Spindown")
+        if (sd is not None and sd.PEPOCH.value is None
+                and "AbsPhase" in self.components
+                and self.TZRMJD.value is not None):
+            sd.PEPOCH.value = self.TZRMJD.value
         for c in self.components.values():
             c.validate()
 
@@ -501,7 +509,25 @@ class TimingModel:
     def F0_value(self) -> float:
         return float(self.F0.value)
 
+    @property
+    def planets_flag(self) -> bool:
+        """PLANET_SHAPIRO as a plain bool — the single source of truth for
+        every TZR-pipeline cache key (host TOA preparation needs planet
+        geometry iff planetary Shapiro is on)."""
+        return bool(self.PLANET_SHAPIRO.value) \
+            if "PLANET_SHAPIRO" in self else False
+
     # -- TZR --------------------------------------------------------------
+    def make_tzr_toas_or_none(self):
+        """The prepared 1-row TZR host TOAs (for build_pdict's tzr mask
+        entries), or None when the model has no AbsPhase.  Single place that
+        fixes the make_tzr_toas cache key (ephem + planets)."""
+        ab = self.components.get("AbsPhase")
+        if ab is None:
+            return None
+        return ab.make_tzr_toas(ephem=self.EPHEM.value or "DE421",
+                                planets=self.planets_flag)
+
     def attach_tzr(self, toas=None):
         """Materialize the TZR reference TOA batch (host precompute); see
         :mod:`pint_tpu.models.absolute_phase`."""
@@ -511,8 +537,7 @@ class TimingModel:
         else:
             self.tzr_batch = ab.make_tzr_batch(
                 ephem=self.EPHEM.value or "DE421",
-                planets=bool(self.PLANET_SHAPIRO.value)
-                if "PLANET_SHAPIRO" in self else False,
+                planets=self.planets_flag,
                 toas=toas)
         return self.tzr_batch
 
@@ -571,6 +596,8 @@ def _top_level_params() -> List[Param]:
         StrParam("ECL", description="Ecliptic obliquity convention"),
         StrParam("DMDATA", description="wideband DM data in use",
                  aliases=[]),
+        StrParam("TRACK", description="tempo2 phase-tracking mode "
+                 "(-2 enables pulse-number tracking)"),
         StrParam("TRES", description="tempo residual RMS record"),
         StrParam("MODE", description="tempo MODE record"),
         StrParam("NTOA", description="number-of-TOAs record"),

@@ -111,9 +111,7 @@ class Residuals:
         self._fn = build_resid_fn(model, self.batch, self.track_mode,
                                   self.subtract_mean, self.use_weighted_mean)
         self.pdict = model.build_pdict(
-            toas, tzr_toas=model.components["AbsPhase"].make_tzr_toas(
-                ephem=model.EPHEM.value or "DE421")
-            if "AbsPhase" in model.components else None)
+            toas, tzr_toas=model.make_tzr_toas_or_none())
         self._phase_resids: Optional[np.ndarray] = None
 
     # -- computed quantities ---------------------------------------------
@@ -132,10 +130,7 @@ class Residuals:
     def update(self):
         """Re-evaluate after model changes."""
         self.pdict = self.model.build_pdict(
-            self.toas,
-            tzr_toas=self.model.components["AbsPhase"].make_tzr_toas(
-                ephem=self.model.EPHEM.value or "DE421")
-            if "AbsPhase" in self.model.components else None)
+            self.toas, tzr_toas=self.model.make_tzr_toas_or_none())
         self._phase_resids = None
 
     def rms_weighted(self) -> float:

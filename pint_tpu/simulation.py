@@ -34,10 +34,7 @@ def zero_residuals(toas: TOAs, model: TimingModel, maxiter: int = 10,
     for it in range(maxiter):
         batch = toas.to_batch()
         fn = build_resid_fn(model, batch, "nearest", False, False)
-        p = model.build_pdict(
-            toas, tzr_toas=model.components["AbsPhase"].make_tzr_toas(
-                ephem=model.EPHEM.value or "DE421")
-            if "AbsPhase" in model.components else None)
+        p = model.build_pdict(toas, tzr_toas=model.make_tzr_toas_or_none())
         r_sec = np.asarray(fn(p)) / f0
         if np.max(np.abs(r_sec)) < tol_us * 1e-6:
             return toas

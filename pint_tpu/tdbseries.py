@@ -177,7 +177,7 @@ def tdb_minus_tt(t_millennia) -> np.ndarray:
     return total
 
 
-def tdb_minus_tt_topo(t_millennia, obs_pos_m, earth_vel_m_s) -> np.ndarray:
+def tdb_minus_tt_topo(obs_pos_m, earth_vel_m_s) -> np.ndarray:
     """Topocentric correction to TDB-TT: (v_earth · r_obs)/c² [s].
 
     ``obs_pos_m``: observatory position wrt geocenter (GCRS) [m];

@@ -29,6 +29,7 @@ import numpy as np
 
 from pint_tpu import c as C_LIGHT
 from pint_tpu import mjd as mjdmod
+from pint_tpu import tdbseries
 from pint_tpu.exceptions import TimFileError
 from pint_tpu.mjd import MJD
 from pint_tpu.observatory import get_observatory
@@ -406,6 +407,7 @@ class TOAs:
         out.index = self.index[mask]
         out.tdb = None if self.tdb is None else MJD(self.tdb.day[mask],
                                                     self.tdb.frac[mask])
+        out._tdb_topo_applied = getattr(self, "_tdb_topo_applied", False)
         for col in ("ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
             v = getattr(self, col)
             setattr(out, col, None if v is None else v[mask])
@@ -444,8 +446,8 @@ class TOAs:
 
     def compute_TDBs(self, ephem: Optional[str] = "DE421", method="default"):
         """UTC → TDB at each TOA (geocentric FB90 series; the topocentric
-        term, ~2 us amplitude but smooth, is included via the observatory
-        position when posvels are available later — cf. reference
+        term, ~2 us diurnal amplitude, is applied in :meth:`compute_posvels`
+        once the observatory geometry is available — cf. reference
         `/root/reference/src/pint/toa.py:2262`).
 
         Barycentric ('@'/'bat') TOAs are *already* TDB by convention
@@ -456,6 +458,7 @@ class TOAs:
         bary = np.array([get_observatory(o).is_barycenter for o in self.obs])
         self.tdb = MJD(np.where(bary, self.utc.day, tdb.day),
                        np.where(bary, self.utc.frac, tdb.frac))
+        self._tdb_topo_applied = False
         self.ephem = self.ephem or ephem
 
     def compute_posvels(self, ephem: Optional[str] = "DE421", planets=False):
@@ -479,6 +482,7 @@ class TOAs:
         self.obs_sun_pos = np.zeros((n, 3))
         wanted = PLANETS if planets else ()
         self.obs_planet_pos = {p: np.zeros((n, 3)) for p in wanted}
+        tdb_topo = np.zeros(n)
 
         for obsname in self.observatories:
             sel = np.flatnonzero(self.obs == obsname)
@@ -493,6 +497,11 @@ class TOAs:
                 else:
                     geo = site.posvel_gcrs(tt.mjd_float[sel])
                     ssb_obs = PosVel(earth.pos + geo.pos, earth.vel + geo.vel)
+                    # topocentric TDB-TT term (v_earth·r_obs)/c², ~2 us
+                    # diurnal (tdbseries.py:180); the FB90 series applied in
+                    # compute_TDBs is geocentric only
+                    tdb_topo[sel] = tdbseries.tdb_minus_tt_topo(
+                        geo.pos, earth.vel)
             self.ssb_obs_pos[sel] = ssb_obs.pos
             self.ssb_obs_vel[sel] = ssb_obs.vel
             sun = eph.posvel("sun", t_sel)
@@ -500,6 +509,10 @@ class TOAs:
             for p in wanted:
                 body = eph.posvel(p, t_sel)
                 self.obs_planet_pos[p][sel] = body.pos - ssb_obs.pos
+
+        if not getattr(self, "_tdb_topo_applied", False) and np.any(tdb_topo):
+            self.tdb = mjdmod.add_sec(self.tdb, tdb_topo)
+            self._tdb_topo_applied = True
 
     # -- export -------------------------------------------------------------
     def to_batch(self) -> TOABatch:

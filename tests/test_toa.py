@@ -161,10 +161,12 @@ class TestTOAsObject:
         t.compute_posvels(ephem="builtin", planets=True)
         b = t.to_batch()
         assert b.ntoas == 3
-        # TDB-UTC = (TAI-UTC) + 32.184 + (TDB-TT); 34 leap seconds at MJD 55000
-        dt = (b.tdb_day + b.tdb_frac - t.utc.mjd_float) * 86400.0
+        # TDB-UTC = (TAI-UTC) + 32.184 + (TDB-TT); 34 leap seconds at MJD 55000.
+        # Row 2 is a barycentric '@' TOA: already TDB, passes through unchanged.
+        dt = np.asarray((b.tdb_day + b.tdb_frac - t.utc.mjd_float) * 86400.0)
         expected = mjdmod.tai_minus_utc(t.utc.day) + 32.184
-        assert np.all(np.abs(np.asarray(dt) - expected) < 0.01)
+        assert np.all(np.abs(dt[:2] - expected[:2]) < 0.01)
+        assert abs(dt[2]) < 1e-9
         # barycentric TOA has zero geometry; site TOAs ~1 AU = ~499 ls
         r = np.linalg.norm(np.asarray(b.ssb_obs_pos_ls), axis=1)
         assert r[2] == 0.0
