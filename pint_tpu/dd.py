@@ -53,19 +53,41 @@ def _split_const(a):
     return _SPLIT_F64
 
 
+def _guard(*words):
+    """Pin EFT result words against value-changing compiler rewrites.
+
+    XLA's HLO simplification pipeline rewrites floating-point graphs under
+    the assumption that 1-ulp rounding differences don't matter (e.g. it
+    sinks broadcasts through elementwise chains and re-derives scalar
+    clones).  Error-free transforms are exactly the code for which that
+    assumption is false: a 1-ulp change in the primary word without the
+    matching compensation word corrupts the low-order words entirely —
+    observed as ~1e-7-relative phase errors on the CPU backend (jit vs
+    eager).  An ``optimization_barrier`` on every EFT output pair makes the
+    transform opaque to the simplifier while remaining transparent to
+    autodiff and batching.  Host numpy paths need no guard.
+    """
+    if isinstance(words[0], np.ndarray) or np.isscalar(words[0]):
+        return words if len(words) > 1 else words[0]
+    import jax
+
+    out = jax.lax.optimization_barrier(words)
+    return out if len(words) > 1 else out[0]
+
+
 def two_sum(a, b):
     """Error-free sum: returns (s, e) with s = fl(a+b) and a+b = s+e exactly."""
     s = a + b
     bb = s - a
     e = (a - (s - bb)) + (b - bb)
-    return s, e
+    return _guard(s, e)
 
 
 def quick_two_sum(a, b):
     """Error-free sum assuming |a| >= |b|: (s, e) with a+b = s+e exactly."""
     s = a + b
     e = b - (s - a)
-    return s, e
+    return _guard(s, e)
 
 
 def split(a):
@@ -73,7 +95,7 @@ def split(a):
     t = _split_const(a) * a
     hi = t - (t - a)
     lo = a - hi
-    return hi, lo
+    return _guard(hi, lo)
 
 
 def two_prod(a, b):
@@ -82,7 +104,7 @@ def two_prod(a, b):
     ahi, alo = split(a)
     bhi, blo = split(b)
     e = ((ahi * bhi - p) + ahi * blo + alo * bhi) + alo * blo
-    return p, e
+    return _guard(p, e)
 
 
 class DD(NamedTuple):

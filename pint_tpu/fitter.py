@@ -1,0 +1,356 @@
+"""Fitters: weighted least squares on device, Gauss-Newton with autodiff.
+
+Reference: `WLSFitter` / `DownhillWLSFitter` and the `fit_wls_svd` kernel
+(`/root/reference/src/pint/fitter.py:1703,1268,2551`), where >80% of wall
+clock is hand-written design-matrix assembly in Python longdouble
+(`profiling/README.txt:62-71`).  Here the whole Gauss-Newton iteration is a
+single jitted XLA program:
+
+* residuals come from the jit-pure phase pipeline
+  (:func:`pint_tpu.residuals.raw_phase_resids`);
+* the design matrix is **forward-mode autodiff** (`jax.jacfwd`) of the
+  residual function over the free-parameter offset vector — replacing the
+  reference's `d_phase_d_param` registry
+  (`/root/reference/src/pint/models/timing_model.py:2157-2326`);
+* the solve is whiten → column-normalize → SVD → threshold, exactly the
+  reference's numerical recipe (`fit_wls_svd`, `fitter.py:2551`;
+  `normalize_designmatrix`, `utils.py:2900`), in f64 on device.
+
+Because the step function is pure in the params pytree, grids and ensembles
+batch with `jax.vmap` and shard with `shard_map` — the TPU replacement for
+the reference's per-point process pool (`gridutils.py:322`).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
+from pint_tpu.models.timing_model import TimingModel, pv
+from pint_tpu.residuals import Residuals, raw_phase_resids
+from pint_tpu.toabatch import TOABatch
+from pint_tpu.utils import normalize_designmatrix
+
+__all__ = ["Fitter", "WLSFitter", "DownhillWLSFitter", "fit_wls_svd",
+           "build_wls_step"]
+
+
+def fit_wls_svd(M, r_sec, sigma_sec, threshold: Optional[float] = None):
+    """One linear WLS solve (reference `fit_wls_svd`,
+    `/root/reference/src/pint/fitter.py:2551`): whiten → column-normalize →
+    SVD → threshold.  Jit-pure.
+
+    M: (N, P) design matrix = -d(resid_sec)/d(param); r_sec: (N,) residuals
+    [s]; sigma_sec: (N,) uncertainties [s].  Returns
+    ``(dpars, Sigma_n, norms, n_bad)``: the parameter step, the covariance
+    of the *normalized* parameters, the column norms, and the number of
+    singular values dropped by the degeneracy threshold.  The true
+    covariance is ``Sigma_n / outer(norms, norms)`` — deliberately left to
+    the (true-IEEE f64) host: TPU's emulated f64 carries only the f32
+    exponent range (~1e±38), and both ``norms**2`` for stiff columns like
+    F1 (~1e43) and the resulting variances (~1e-42) fall outside it.  For
+    the same reason column scaling happens in two range-safe stages
+    (max-abs, then the norm of an O(1) matrix) instead of one
+    sum-of-squares.
+    """
+    Mw = M / sigma_sec[:, None]
+    rw = r_sec / sigma_sec
+    cmax = jnp.max(jnp.abs(Mw), axis=0)
+    cmax = jnp.where(cmax == 0.0, 1.0, cmax)
+    Mc = Mw / cmax
+    Mn, nc = normalize_designmatrix(Mc)
+    norms = cmax * nc
+    U, S, Vt = jnp.linalg.svd(Mn, full_matrices=False)
+    if threshold is None:
+        threshold = jnp.finfo(jnp.float64).eps * max(M.shape)
+    bad = S <= threshold * S[0]
+    Sinv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, S))
+    dpars = (Vt.T @ (Sinv * (U.T @ rw))) / norms
+    Sigma_n = (Vt.T * Sinv**2) @ Vt
+    return dpars, Sigma_n, norms, jnp.sum(bad)
+
+
+def build_resid_sec_fn(model: TimingModel, batch: TOABatch,
+                       fit_params: Sequence[str], track_mode: str):
+    """``(x, p) -> time residuals [s]`` (jit-pure, not mean-subtracted):
+    the function whose jacobian is the design matrix."""
+    calc = model.calc
+    names = list(fit_params)
+
+    def resid_sec(x, p):
+        p2 = model.with_x(p, x, names)
+        r_cyc = raw_phase_resids(calc, p2, batch, track_mode,
+                                 subtract_mean=False, use_weights=False)
+        return r_cyc / pv(p2, "F0")
+
+    return resid_sec
+
+
+def build_wls_step(model: TimingModel, batch: TOABatch,
+                   fit_params: Sequence[str], track_mode: str,
+                   threshold: Optional[float] = None,
+                   include_offset: bool = True):
+    """The jitted Gauss-Newton step ``(x, p) -> dict`` for a frozen model
+    structure.
+
+    ``x`` is the free-parameter offset vector (device units, offsets from
+    the pytree's reference values); ``p`` the params pytree.  The returned
+    dict holds ``dx`` (the step, offset column already dropped), ``chi2``
+    (at x, using the best-fit offset), ``Sigma`` (parameter covariance),
+    ``resid_sec`` and ``n_bad``.
+
+    An explicit phase-offset column is appended unless the model carries a
+    free PHOFF (reference prepends an "Offset" column the same way,
+    `/root/reference/src/pint/models/timing_model.py:2326`).
+    """
+    names = list(fit_params)
+    resid_sec = build_resid_sec_fn(model, batch, names, track_mode)
+
+    @jax.jit
+    def step(x, p):
+        r = resid_sec(x, p)
+        J = jax.jacfwd(resid_sec)(x, p)
+        M = -J
+        if include_offset:
+            M = jnp.concatenate([M, -jnp.ones((M.shape[0], 1))], axis=1)
+        sigma = model.scaled_toa_uncertainty(p, batch) * 1e-6
+        dpars, Sigma_n, norms, n_bad = fit_wls_svd(M, r, sigma, threshold)
+        # chi2 at x with the offset profiled out (the linear best fit of a
+        # pure offset to the current residuals)
+        if include_offset:
+            w = 1.0 / sigma**2
+            off = jnp.sum(r * w) / jnp.sum(w)
+        else:
+            off = 0.0
+        chi2 = jnp.sum(((r - off) / sigma) ** 2)
+        npar = len(names)
+        return {"dx": dpars[:npar], "offset": off, "chi2": chi2,
+                "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
+                "resid_sec": r, "n_bad": n_bad}
+
+    return step
+
+
+def denormalize_covariance(Sigma_n, norms) -> np.ndarray:
+    """Host-side (true IEEE f64) covariance denormalization; see
+    `fit_wls_svd` for why this cannot run on TPU."""
+    norms = np.asarray(norms, np.float64)
+    return np.asarray(Sigma_n, np.float64) / np.outer(norms, norms)
+
+
+class FitSummary(NamedTuple):
+    chi2: float
+    dof: int
+    iterations: int
+    converged: bool
+
+
+class Fitter:
+    """Base fitter (reference `Fitter`, `/root/reference/src/pint/fitter.py:116`).
+
+    Holds (toas, model, resids); concrete subclasses implement
+    ``fit_toas``.  After a fit, parameter values and uncertainties are
+    written back into the model, ``parameter_covariance_matrix`` /
+    ``parameter_correlation_matrix`` hold the scaled covariance, and
+    ``resids`` reflects the post-fit model.
+    """
+
+    def __init__(self, toas, model: TimingModel,
+                 track_mode: Optional[str] = None,
+                 residuals: Optional[Residuals] = None):
+        self.toas = toas
+        self.model = model
+        self.resids = residuals if residuals is not None else \
+            Residuals(toas, model, track_mode=track_mode)
+        self.track_mode = self.resids.track_mode
+        self.fitresult: Optional[FitSummary] = None
+        self.parameter_covariance_matrix: Optional[np.ndarray] = None
+        self.covariance_params: List[str] = []
+
+    # -- fittable parameters ---------------------------------------------
+    @property
+    def fit_params(self) -> List[str]:
+        """Free parameters this (linear) fitter moves: all free device
+        params except noise-component ones (white-noise parameters are fit
+        by maximum likelihood in the downhill fitters, as in the reference
+        `fitter.py:1040`)."""
+        noise_comps = {type(c).__name__ for c in self.model.noise_components}
+        out = []
+        skipped = []
+        for n in self.model.free_params:
+            if self.model.param_component(n) in noise_comps:
+                skipped.append(n)
+            else:
+                out.append(n)
+        if skipped:
+            warnings.warn(
+                f"free noise parameters {skipped} are not fit by "
+                f"{type(self).__name__}; freeze them or use a downhill "
+                "fitter with noise fitting")
+        return out
+
+    def get_designmatrix(self):
+        """(M, names): the design matrix at the current parameter values,
+        M[:,i] = -d(resid_sec)/d(param_i) in device units (reference
+        `designmatrix`, `/root/reference/src/pint/models/timing_model.py:2326`,
+        there computed from the hand-written derivative registry; here one
+        `jax.jacfwd` of the residual function)."""
+        names = self.fit_params
+        rf = build_resid_sec_fn(self.model, self.resids.batch, names,
+                                self.track_mode)
+        p = self.resids.pdict
+        x = self.model.x0(p, names)
+        M = -np.asarray(jax.jit(jax.jacfwd(rf))(x, p))
+        return M, names
+
+    # -- reporting --------------------------------------------------------
+    @property
+    def parameter_correlation_matrix(self) -> Optional[np.ndarray]:
+        C = self.parameter_covariance_matrix
+        if C is None:
+            return None
+        s = np.sqrt(np.diag(C))
+        return C / np.outer(s, s)
+
+    def update_model(self):
+        """Record fit provenance into the model (START/FINISH/NTOA/CHI2/
+        TRES), as the reference does post-fit (`fitter.py:~640`)."""
+        m, r = self.model, self.resids
+        mjds = np.asarray(r.batch.tdbld)
+        m.START.value = f"{mjds.min():.4f}"
+        m.FINISH.value = f"{mjds.max():.4f}"
+        m.NTOA.value = str(self.toas.ntoas)
+        chi2 = r.calc_chi2()
+        m.CHI2.value = f"{chi2:.4f}"
+        m.CHI2R.value = f"{chi2 / r.dof:.6f}"
+        m.TRES.value = f"{r.rms_weighted() * 1e6:.4f}"
+
+    def get_summary(self) -> str:
+        r = self.resids
+        lines = [
+            f"Fitted model using {type(self).__name__} with "
+            f"{len(self.fit_params)} free parameters, {self.toas.ntoas} TOAs",
+            f"Post-fit chi2 = {r.calc_chi2():.4f}  dof = {r.dof}  "
+            f"reduced chi2 = {r.reduced_chi2:.4f}",
+            f"Post-fit weighted RMS = {r.rms_weighted() * 1e6:.4f} us",
+            "",
+            f"{'PARAM':12s} {'VALUE':>25s} {'UNCERTAINTY':>15s}",
+        ]
+        for n in self.fit_params:
+            par = self.model[n]
+            unc = "" if par.uncertainty is None else \
+                f"{par.uncertainty:.3g}"
+            lines.append(f"{n:12s} {par.value_as_string():>25s} {unc:>15s}")
+        return "\n".join(lines)
+
+    def print_summary(self):  # pragma: no cover - console convenience
+        print(self.get_summary())
+
+    def fit_toas(self, maxiter: int = 2, **kw) -> float:
+        raise NotImplementedError
+
+    def _finalize(self, p: dict, x: np.ndarray, Sigma: np.ndarray,
+                  names: List[str]):
+        """Write the solution back into host parameters + uncertainties."""
+        m = self.model
+        p2 = m.with_x(p, jnp.asarray(x), names)
+        m.apply_deltas(p2)
+        for i, n in enumerate(names):
+            m[n].set_device_uncertainty(float(np.sqrt(Sigma[i, i])))
+        self.parameter_covariance_matrix = np.asarray(Sigma)
+        self.covariance_params = list(names)
+        self.resids.update()
+        self.update_model()
+
+
+class WLSFitter(Fitter):
+    """Iterated linear WLS (reference `WLSFitter`,
+    `/root/reference/src/pint/fitter.py:1703`): each iteration solves the
+    linearized problem by SVD and applies the full step."""
+
+    def fit_toas(self, maxiter: int = 2, threshold: Optional[float] = None,
+                 tol_chi2: float = 1e-8) -> float:
+        m = self.model
+        names = self.fit_params
+        p = self.resids.pdict
+        batch = self.resids.batch
+        include_offset = "PhaseOffset" not in m.components
+        step = build_wls_step(m, batch, names, self.track_mode,
+                              threshold=threshold,
+                              include_offset=include_offset)
+        x = np.zeros(len(names))
+        for it in range(maxiter):
+            out = step(jnp.asarray(x), p)
+            if int(out["n_bad"]):
+                warnings.warn(
+                    f"{int(out['n_bad'])} degenerate parameter "
+                    "combination(s) dropped by SVD threshold",
+                    DegeneracyWarning)
+            x = x + np.asarray(out["dx"])
+        # final chi2 at the converged x
+        final = step(jnp.asarray(x), p)
+        Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
+        self._finalize(p, x, Sigma, names)
+        self.fitresult = FitSummary(float(final["chi2"]), self.resids.dof,
+                                    maxiter, True)
+        return float(final["chi2"])
+
+
+class DownhillWLSFitter(Fitter):
+    """Gauss-Newton with backtracking line search (reference
+    `DownhillFitter`/`DownhillWLSFitter`,
+    `/root/reference/src/pint/fitter.py:915,1268`): a proposed step is
+    halved (lambda = 1, 1/2, 1/4, ...) until chi2 decreases; convergence
+    when the step's predicted chi2 improvement is below tolerance."""
+
+    def fit_toas(self, maxiter: int = 20, threshold: Optional[float] = None,
+                 min_lambda: float = 1e-3, required_chi2_decrease: float = 1e-2,
+                 max_chi2_increase: float = 1e-2) -> float:
+        m = self.model
+        names = self.fit_params
+        p = self.resids.pdict
+        batch = self.resids.batch
+        include_offset = "PhaseOffset" not in m.components
+        step = build_wls_step(m, batch, names, self.track_mode,
+                              threshold=threshold,
+                              include_offset=include_offset)
+        x = np.zeros(len(names))
+        out = step(jnp.asarray(x), p)
+        chi2 = float(out["chi2"])
+        converged = False
+        exception = None
+        it = -1
+        for it in range(maxiter):
+            dx = np.asarray(out["dx"])
+            lam = 1.0
+            while True:
+                trial = step(jnp.asarray(x + lam * dx), p)
+                trial_chi2 = float(trial["chi2"])
+                if trial_chi2 <= chi2 + max_chi2_increase:
+                    break
+                lam *= 0.5
+                if lam < min_lambda:
+                    exception = ConvergenceFailure(
+                        f"step rejected down to lambda={lam:.2g} "
+                        f"(chi2 {chi2:.4f} -> {trial_chi2:.4f})")
+                    break
+            if exception is not None:
+                break
+            x = x + lam * dx
+            improvement = chi2 - trial_chi2
+            chi2 = trial_chi2
+            out = trial
+            if lam == 1.0 and improvement < required_chi2_decrease:
+                converged = True
+                break
+        self._finalize(p, x, denormalize_covariance(out["Sigma_n"],
+                                                    out["norms"]), names)
+        self.fitresult = FitSummary(chi2, self.resids.dof, it + 1, converged)
+        if exception is not None and not converged:
+            warnings.warn(str(exception))
+        return chi2

@@ -36,6 +36,7 @@ from pint_tpu.models import (  # noqa: F401  isort:skip
     astrometry,
     dispersion,
     jump,
+    noise_model,
     phase_offset,
     solar_system_shapiro,
     spindown,

@@ -1,0 +1,132 @@
+"""White-noise rescaling and (later in this module) correlated-noise bases.
+
+Reference: `ScaleToaError` (`/root/reference/src/pint/models/noise_model.py:79`)
+rescales TOA uncertainties as
+
+    sigma' = EFAC * sqrt(sigma^2 + EQUAD^2)
+
+over mask-selected TOA subsets (per backend/telescope), with TNEQ the
+tempo2-convention log10(EQUAD/s).  Correlated components (`EcorrNoise`,
+`PLRedNoise`, ... reference `noise_model.py:367,1004`) instead expose a
+basis matrix + prior weights consumed by the GLS fitter; they are built in
+this module too so the whole noise subsystem lives in one place, as in the
+reference.
+
+Device representation: masks are host-precomputed per-TOA {0,1} arrays in
+``p["mask"]``; the scaling itself is a short chain of fused elementwise ops,
+jit-compiled into the residual/chi2/fit kernels.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.parameter import MaskParam, split_prefix
+from pint_tpu.models.timing_model import Component, pv
+from pint_tpu.toabatch import TOABatch
+
+
+class NoiseComponent(Component):
+    """Base for noise components.
+
+    ``introduces_correlated_errors`` mirrors the reference flag
+    (`/root/reference/src/pint/models/noise_model.py:47-60`): False for pure
+    sigma-rescaling (EFAC/EQUAD), True for basis components (ECORR, red
+    noise) that the GLS fitter must marginalize over.
+    """
+
+    introduces_correlated_errors = False
+    is_noise = True
+    category = "noise"
+
+    def scaled_sigma_us(self, p: dict, batch: TOABatch,
+                        sigma_us: jnp.ndarray) -> jnp.ndarray:
+        """Transform per-TOA uncertainties [us]; identity by default."""
+        return sigma_us
+
+    # correlated components override these (GLS basis protocol):
+    def noise_basis(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        """Basis matrix U, shape (ntoas, k)."""
+        raise NotImplementedError
+
+    def noise_weights(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        """Prior variance per basis column, shape (k,)."""
+        raise NotImplementedError
+
+    def basis_width(self, batch) -> int:
+        """Static column count of this component's basis (host-side)."""
+        raise NotImplementedError
+
+
+class ScaleToaError(NoiseComponent):
+    """EFAC/EQUAD/TNEQ white-noise rescaling (reference
+    `/root/reference/src/pint/models/noise_model.py:79-263`)."""
+
+    register = True
+    category = "scale_toa_error"
+
+    def mask_families(self) -> List[str]:
+        return ["EFAC", "EQUAD", "TNEQ", "T2EFAC", "T2EQUAD"]
+
+    def _family(self, stem: str) -> List[MaskParam]:
+        return self.prefix_params(stem)
+
+    def _next_index(self, stem: str) -> int:
+        return 1 + max([par.index or 0 for par in self._family(stem)],
+                       default=0)
+
+    def make_param(self, name: str):
+        # tempo2 spellings map onto the canonical families
+        name = {"T2EFAC": "EFAC", "T2EQUAD": "EQUAD"}.get(name, name)
+        if name in ("EFAC", "EQUAD", "TNEQ"):
+            stem, index = name, self._next_index(name)
+        else:
+            try:
+                stem, index = split_prefix(name)
+            except ValueError:
+                return None
+            stem = {"T2EFAC": "EFAC", "T2EQUAD": "EQUAD"}.get(stem, stem)
+        if stem == "EFAC":
+            return MaskParam("EFAC", index=index, units="",
+                             description="error scale factor")
+        if stem == "EQUAD":
+            return MaskParam("EQUAD", index=index, units="us",
+                             description="error added in quadrature")
+        if stem == "TNEQ":
+            return MaskParam("TNEQ", index=index, units="log10(s)",
+                             description="tempo2 EQUAD, log10 seconds")
+        return None
+
+    def add_noise_param(self, stem: str, key=None, key_value=(),
+                        value=None, index=None, frozen=True) -> MaskParam:
+        par = self.make_param(f"{stem}{index}" if index else stem)
+        par.key, par.key_value = key, list(key_value)
+        par.value, par.frozen = value, frozen
+        return self.add_param(par)
+
+    def scaled_sigma_us(self, p: dict, batch: TOABatch,
+                        sigma_us: jnp.ndarray) -> jnp.ndarray:
+        var = sigma_us ** 2
+        quad = jnp.zeros_like(var)
+        for par in self._family("EQUAD"):
+            m = p["mask"].get(par.mask_pytree_name)
+            if m is None:
+                continue
+            quad = quad + m * pv(p, par.name) ** 2
+        for par in self._family("TNEQ"):
+            m = p["mask"].get(par.mask_pytree_name)
+            if m is None:
+                continue
+            eq_us = 10.0 ** pv(p, par.name) * 1e6
+            quad = quad + m * eq_us ** 2
+        var = var + quad
+        scale = jnp.ones_like(var)
+        for par in self._family("EFAC"):
+            m = p["mask"].get(par.mask_pytree_name)
+            if m is None:
+                continue
+            scale = scale * (1.0 + m * (pv(p, par.name) - 1.0))
+        return scale * jnp.sqrt(var)

@@ -245,23 +245,32 @@ class PhaseCalc:
         return d
 
     def phase(self, p: dict, batch: TOABatch,
-              tzr_batch: Optional[TOABatch] = None, is_tzr: bool = False):
-        """Total absolute phase [cycles] as a quad-single; if ``tzr_batch``
-        is given, the phase at the TZR TOA is subtracted (reference
-        `/root/reference/src/pint/models/timing_model.py:1669-1701`)."""
+              subtract_tzr: bool = True, is_tzr: bool = False):
+        """Total absolute phase [cycles] as a quad-single.
+
+        The TZR reference phase (reference
+        `/root/reference/src/pint/models/timing_model.py:1669-1701`) is NOT
+        recomputed in-graph: it rides in the pytree as the host-precomputed
+        words ``p["const"]["__tzrphase__"]`` (built by
+        ``TimingModel.build_pdict``) and is subtracted as data.  Two reasons:
+        (a) it matches the reference's design-matrix semantics — the
+        reference's ``d_phase_d_param`` registry also excludes the TZR
+        term, relying on the fitted offset column; and (b) a second
+        (1-row) phase pipeline fused into the same XLA program was observed
+        to make the CPU backend's simplifier corrupt the quad-single
+        error-free transforms (scalar-cloning rewrites), a miscompile this
+        sidesteps by construction."""
         from pint_tpu import qs
 
         delay = self.delay(p, batch)
         total = qs.zeros_like(jnp.zeros(batch.ntoas, jnp.float32))
         for comp in self.phase_components:
             total = qs.add(total, comp.phase(p, batch, delay, is_tzr=is_tzr))
-        if tzr_batch is not None:
-            # the TZR TOA carries its own (1-row) mask arrays
-            p_tzr = {"const": p["const"], "delta": p["delta"],
-                     "mask": p.get("tzr_mask", {})}
-            tzr = self.phase(p_tzr, tzr_batch, None, is_tzr=True)
-            total = qs.sub(total, qs.QS(*[jnp.broadcast_to(w, total.w0.shape)
-                                          for w in tzr.words]))
+        tw = p["const"].get("__tzrphase__") if subtract_tzr else None
+        if tw is not None:
+            total = qs.sub(total, qs.QS(*[
+                jnp.broadcast_to(tw[..., k], total.w0.shape)
+                for k in range(4)]))
         return total
 
 
@@ -448,8 +457,17 @@ class TimingModel:
                 mask.update(c.mask_entries(toas))
             if tzr_toas is not None:
                 tzr_mask.update(c.mask_entries(tzr_toas))
-        return {"const": const, "delta": delta, "mask": mask,
-                "tzr_mask": tzr_mask}
+        p = {"const": const, "delta": delta, "mask": mask}
+        if self.tzr_batch is not None and "AbsPhase" in self.components:
+            # host-side (eager, exact) evaluation of the TZR reference
+            # phase at the pytree's reference parameter values; see
+            # PhaseCalc.phase for why this stays out of the jitted graph
+            p_tzr = {"const": const, "delta": delta, "mask": tzr_mask}
+            ph = self.calc.phase(p_tzr, self.tzr_batch, subtract_tzr=False,
+                                 is_tzr=True)
+            const["__tzrphase__"] = np.stack(
+                [np.asarray(w, np.float32)[0] for w in ph.words])
+        return p
 
     def apply_deltas(self, p: dict):
         """Fold the (post-fit) offsets back into the host parameters and
@@ -469,29 +487,51 @@ class TimingModel:
                 p["delta"][par.name] = np.zeros_like(d)
 
     # free-vector <-> delta mapping (device units; offsets from const).
-    def x0(self, p: dict) -> jnp.ndarray:
+    def x0(self, p: dict, names: Optional[Sequence[str]] = None) -> jnp.ndarray:
+        names = self.free_params if names is None else names
         return jnp.array([jnp.asarray(p["delta"][n], jnp.float64)
-                          for n in self.free_params])
+                          for n in names])
 
-    def with_x(self, p: dict, x) -> dict:
+    def with_x(self, p: dict, x, names: Optional[Sequence[str]] = None) -> dict:
+        names = self.free_params if names is None else names
         delta = dict(p["delta"])
-        for i, n in enumerate(self.free_params):
+        for i, n in enumerate(names):
             delta[n] = x[i]
         out = dict(p)
         out["delta"] = delta
         return out
 
-    def fit_units(self) -> List[float]:
+    def fit_units(self, names: Optional[Sequence[str]] = None) -> List[float]:
         """d(device)/d(par-file unit) per free param — for reporting
         uncertainties and matching reference design-matrix units."""
         out = []
-        for n in self.free_params:
+        for n in (self.free_params if names is None else names):
             par = self[n]
             if isinstance(par, MJDParam):
                 out.append(1.0)  # fraction-of-day: par unit is days
             else:
                 out.append(par.par2dev)
         return out
+
+    # -- noise -------------------------------------------------------------
+    @property
+    def noise_components(self):
+        return [c for c in self.components.values()
+                if getattr(c, "is_noise", False)]
+
+    @property
+    def has_correlated_errors(self) -> bool:
+        return any(c.introduces_correlated_errors
+                   for c in self.noise_components)
+
+    def scaled_toa_uncertainty(self, p: dict, batch: TOABatch):
+        """Per-TOA uncertainties [us] after white-noise rescaling
+        (EFAC/EQUAD; reference ``scaled_toa_uncertainty``,
+        `/root/reference/src/pint/models/noise_model.py:79`).  Jit-pure."""
+        sigma = batch.error_us
+        for c in self.noise_components:
+            sigma = c.scaled_sigma_us(p, batch, sigma)
+        return sigma
 
     # -- physics ----------------------------------------------------------
     @property
@@ -501,9 +541,8 @@ class TimingModel:
     def delay(self, p: dict, batch: TOABatch) -> jnp.ndarray:
         return self.calc.delay(p, batch)
 
-    def phase(self, p: dict, batch: TOABatch, abs_phase=True) -> DD:
-        tzr = self.tzr_batch if abs_phase else None
-        return self.calc.phase(p, batch, tzr)
+    def phase(self, p: dict, batch: TOABatch, abs_phase=True):
+        return self.calc.phase(p, batch, subtract_tzr=abs_phase)
 
     @property
     def F0_value(self) -> float:

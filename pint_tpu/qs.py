@@ -134,11 +134,15 @@ def from_f64_device(x) -> QS:
     """
     import jax.numpy as jnp
 
+    from pint_tpu.dd import _guard
+
     w0 = x.astype(jnp.float32)
     r = x - w0.astype(x.dtype)
     w1 = r.astype(jnp.float32)
     r2 = r - w1.astype(x.dtype)
     w2 = r2.astype(jnp.float32)
+    # the f64→f32 down-split is itself an EFT-style sandwich; pin it
+    w0, w1, w2 = _guard(w0, w1, w2)
     return _renorm([w0, w1, w2, jnp.zeros_like(w2)])
 
 

@@ -28,16 +28,18 @@ __all__ = ["Residuals", "raw_phase_resids", "build_resid_fn"]
 
 
 def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
-                     tzr_batch: Optional[TOABatch], track_mode: str,
-                     subtract_mean: bool, use_weights: bool) -> jnp.ndarray:
+                     track_mode: str, subtract_mean: bool,
+                     use_weights: bool, sigma_us=None) -> jnp.ndarray:
     """Phase residuals [cycles, f64], jit-pure.
 
     ``track_mode``: "nearest" drops the integer pulse number per TOA
     (non-differentiable; the rounding is excluded from gradients);
     "use_pulse_numbers" subtracts the batch's tracked pulse_number column
     (reference `calc_phase_resids`, `/root/reference/src/pint/residuals.py:334-446`).
+    The TZR reference phase is subtracted as pytree data
+    (``p["const"]["__tzrphase__"]``; see ``PhaseCalc.phase``).
     """
-    ph = model_calc.phase(p, batch, tzr_batch)
+    ph = model_calc.phase(p, batch)
     # phase-flag offsets from the tim file ride in pulse_number handling in
     # the reference; here "nearest" removes any integer anyway.
     if track_mode == "use_pulse_numbers":
@@ -62,7 +64,11 @@ def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
         raise ValueError(f"unknown track_mode {track_mode!r}")
     if subtract_mean:
         if use_weights:
-            w = 1.0 / (batch.error_us ** 2)
+            # weights use the EFAC/EQUAD-scaled uncertainties so the
+            # subtracted mean minimizes the same chi2 that calc_chi2
+            # reports (reference residuals.py:442 uses get_data_error)
+            s = batch.error_us if sigma_us is None else sigma_us
+            w = 1.0 / (s ** 2)
             out = out - jnp.sum(out * w) / jnp.sum(w)
         else:
             out = out - jnp.mean(out)
@@ -74,12 +80,13 @@ def build_resid_fn(model: TimingModel, batch: TOABatch,
     """A jitted ``(pdict) -> phase residuals [cycles]`` closure over the
     static model structure and TOA data."""
     calc = model.calc
-    tzr = model.tzr_batch
+    noise = bool(model.noise_components)
 
     @jax.jit
     def fn(p):
-        return raw_phase_resids(calc, p, batch, tzr, track_mode,
-                                subtract_mean, use_weights)
+        sigma = model.scaled_toa_uncertainty(p, batch) if noise else None
+        return raw_phase_resids(calc, p, batch, track_mode,
+                                subtract_mean, use_weights, sigma_us=sigma)
 
     return fn
 
@@ -134,7 +141,7 @@ class Residuals:
         self._phase_resids = None
 
     def rms_weighted(self) -> float:
-        w = 1.0 / (self.toas.error_us * 1e-6) ** 2
+        w = 1.0 / (self.get_data_error() * 1e-6) ** 2
         r = self.time_resids
         mean = np.sum(r * w) / np.sum(w)
         return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))

@@ -1,0 +1,101 @@
+"""White-noise (EFAC/EQUAD) tests.
+
+Mirrors the reference's `tests/test_white_noise.py` strategy: analytic
+expectations for the scaled uncertainties over mask-selected subsets.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR_BASE = """
+PSR FAKE
+F0 61.485476554
+PEPOCH 53750
+TZRMJD 53750.1
+TZRFRQ 1400
+TZRSITE @
+"""
+
+
+def _toas(model, n=20):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return make_fake_toas_uniform(
+            53650, 53850, n, model, obs="@", error_us=2.0,
+            freq_mhz=np.where(np.arange(n) % 2 == 0, 1400.0, 800.0))
+
+
+def test_efac_equad_scaling():
+    par = PAR_BASE + "EFAC freq 1000 2000 1.5\nEQUAD freq 0 1000 3.0\n"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(par.strip().splitlines())
+    assert "ScaleToaError" in m.components
+    toas = _toas(m)
+    r = Residuals(toas, m)
+    sig = r.get_data_error()
+    freqs = np.asarray(toas.freq_mhz)
+    hi = freqs >= 1000
+    np.testing.assert_allclose(sig[hi], 1.5 * 2.0, rtol=1e-12)
+    np.testing.assert_allclose(sig[~hi], np.sqrt(2.0**2 + 3.0**2),
+                               rtol=1e-12)
+
+
+def test_tneq_is_log10_seconds():
+    # TNEQ -5 => EQUAD = 1e-5 s = 10 us
+    par = PAR_BASE + "TNEQ freq 0 3000 -5\n"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(par.strip().splitlines())
+    toas = _toas(m)
+    sig = Residuals(toas, m).get_data_error()
+    np.testing.assert_allclose(sig, np.sqrt(2.0**2 + 10.0**2), rtol=1e-12)
+
+
+def test_t2_spellings_alias():
+    par = PAR_BASE + "T2EFAC freq 0 3000 1.3\nT2EQUAD freq 0 3000 1.0\n"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(par.strip().splitlines())
+    st = m.components["ScaleToaError"]
+    assert "EFAC1" in st.params and "EQUAD1" in st.params
+    toas = _toas(m)
+    sig = Residuals(toas, m).get_data_error()
+    np.testing.assert_allclose(sig, 1.3 * np.sqrt(4.0 + 1.0), rtol=1e-12)
+
+
+def test_chi2_uses_scaled_errors():
+    par_plain = PAR_BASE
+    par_noise = PAR_BASE + "EFAC freq 0 3000 2.0\n"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m0 = get_model(par_plain.strip().splitlines())
+        m1 = get_model(par_noise.strip().splitlines())
+    toas = _toas(m0)
+    c0 = Residuals(toas, m0).calc_chi2()
+    c1 = Residuals(toas, m1).calc_chi2()
+    # doubling all sigmas quarters chi2
+    assert c1 == pytest.approx(c0 / 4.0, rel=1e-9)
+
+
+def test_multiple_efacs_roundtrip_parfile():
+    par = PAR_BASE + ("EFAC freq 0 1000 1.1\n"
+                      "EFAC freq 1000 2000 1.2\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(par.strip().splitlines())
+    st = m.components["ScaleToaError"]
+    assert {p.name for p in st._family("EFAC")} == {"EFAC1", "EFAC2"}
+    out = m.as_parfile()
+    assert "EFAC freq" in out
+    # reparse round-trips the values
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m2 = get_model(out.splitlines())
+    assert m2.EFAC1.value == 1.1 and m2.EFAC2.value == 1.2
