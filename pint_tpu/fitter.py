@@ -284,6 +284,7 @@ class WLSFitter(Fitter):
                               threshold=threshold,
                               include_offset=include_offset)
         x = np.zeros(len(names))
+        prev_chi2 = None
         for it in range(maxiter):
             out = step(jnp.asarray(x), p)
             if int(out["n_bad"]):
@@ -292,6 +293,10 @@ class WLSFitter(Fitter):
                     "combination(s) dropped by SVD threshold",
                     DegeneracyWarning)
             x = x + np.asarray(out["dx"])
+            chi2 = float(out["chi2"])
+            if prev_chi2 is not None and abs(prev_chi2 - chi2) < tol_chi2:
+                break
+            prev_chi2 = chi2
         # final chi2 at the converged x
         final = step(jnp.asarray(x), p)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])

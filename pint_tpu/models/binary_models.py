@@ -1,8 +1,8 @@
 """Binary-model registry: BINARY par value -> component class name.
 
-Filled in by the binary component modules (ELL1/BT/DD families; reference
-`/root/reference/src/pint/models/pulsar_binary.py:36` and
-`binary_*.py`).
+Reference: the binary-model dispatch in `ModelBuilder.choose_model`
+(`/root/reference/src/pint/models/model_builder.py:969` +
+`pulsar_binary.py:36`).
 """
 
 from __future__ import annotations
@@ -10,7 +10,11 @@ from __future__ import annotations
 from pint_tpu.exceptions import UnknownBinaryModel
 
 #: BINARY value (upper) -> registered component class name
-BINARY_COMPONENTS = {}
+BINARY_COMPONENTS = {
+    "ELL1": "BinaryELL1",
+    "ELL1H": "BinaryELL1H",
+    "ELL1K": "BinaryELL1k",
+}
 
 
 def component_for(binary: str) -> str:

@@ -148,6 +148,41 @@ class ModelBuilder:
                     selected[candidates[0]].append((canon, fields))
                 else:
                     deferred.append((candidates, canon, fields))
+        # the BINARY value selects its component BEFORE the shared-param
+        # pass: binary parameters (PB/A1/...) are owned by every binary
+        # model class and resolve onto the selected one
+        binary = pars.get("BINARY", [[None, None]])[0][1]
+        stray_binaries = [c for c in selected
+                          if self.all.components[c].category
+                          == "pulsar_system"]
+        if binary is not None:
+            from pint_tpu.models import binary_models
+
+            chosen = binary_models.component_for(binary)
+            # a leftover parameter unique to a different binary model must
+            # not co-select a second binary component (it would make every
+            # shared binary param "ambiguous")
+            for c in stray_binaries:
+                if c != chosen:
+                    dropped = [canon for canon, _ in selected.pop(c)]
+                    warnings.warn(
+                        f"par file declares BINARY {binary} but contains "
+                        f"{dropped} belonging to {c}; those lines are "
+                        "ignored")
+            selected.setdefault(chosen, [])
+        else:
+            # orbital parameters without a BINARY line: shared binary
+            # params are all-deferred (every binary class owns them),
+            # unique ones land in stray_binaries — either way, error out
+            # rather than silently dropping the orbit
+            binary_only = [canon for cands, canon, _ in deferred
+                           if all(self.all.components[c].category
+                                  == "pulsar_system" for c in cands)]
+            if stray_binaries or binary_only:
+                raise TimingModelError(
+                    f"binary parameters {binary_only or stray_binaries} "
+                    "present but the par file has no BINARY line")
+
         for candidates, canon, fields in deferred:
             hits = [c for c in candidates if c in selected]
             if len(hits) == 1:
@@ -159,12 +194,6 @@ class ModelBuilder:
             else:
                 raise TimingModelError(
                     f"{canon} is ambiguous among selected components {hits}")
-
-        binary = pars.get("BINARY", [[None, None]])[0][1]
-        if binary is not None:
-            from pint_tpu.models import binary_models
-
-            selected.setdefault(binary_models.component_for(binary), [])
 
         if any(self.all.components[c].category == "astrometry"
                for c in selected):

@@ -102,7 +102,10 @@ class ScaleToaError(NoiseComponent):
 
     def add_noise_param(self, stem: str, key=None, key_value=(),
                         value=None, index=None, frozen=True) -> MaskParam:
-        par = self.make_param(f"{stem}{index}" if index else stem)
+        """Programmatic construction of an EFAC/EQUAD/TNEQ member."""
+        par = self.make_param(stem if index is None else f"{stem}{index}")
+        if par is None:
+            raise ValueError(f"unknown white-noise family {stem!r}")
         par.key, par.key_value = key, list(key_value)
         par.value, par.frozen = value, frozen
         return self.add_param(par)

@@ -130,17 +130,43 @@ class Param:
 
 class FloatParam(Param):
     """A real-valued physical parameter (reference ``floatParameter``,
-    `/root/reference/src/pint/models/parameter.py:623`)."""
+    `/root/reference/src/pint/models/parameter.py:623`).
+
+    ``unit_scale``: tempo-convention implicit 1e-12 scaling (PBDOT, XDOT,
+    EDOT...): par files write either the physical value (~1e-12) or the
+    value in units of 1e-12; magnitudes above ``scale_threshold`` are
+    multiplied by ``scale_factor`` (reference `parameter.py:623`,
+    `pulsar_binary.py:110-113`)."""
 
     kind = "float"
     on_device = True
 
-    def __init__(self, name, value=None, units="", long_double=False, **kw):
+    def __init__(self, name, value=None, units="", long_double=False,
+                 unit_scale=False, scale_factor=1e-12, scale_threshold=1e-7,
+                 **kw):
         # long_double is accepted for signature parity; device math is dd/f64
         super().__init__(name, value=value, units=units, **kw)
+        self.unit_scale = unit_scale
+        self.scale_factor = scale_factor
+        self.scale_threshold = scale_threshold
+        self._scaled_on_parse = False
 
     def set_from_string(self, s: str):
-        self.value = parse_number(s)
+        v = parse_number(s)
+        self._scaled_on_parse = self.unit_scale and \
+            abs(v) > self.scale_threshold
+        if self._scaled_on_parse:
+            v *= self.scale_factor
+        self.value = v
+
+    def from_parfile_line(self, fields: List[str]):
+        super().from_parfile_line(fields)
+        # the uncertainty is thresholded on its own magnitude (a par file
+        # may give an explicit 1e-12-scale value with a bare-convention
+        # uncertainty, reference `parameter.py` _set_uncertainty)
+        if self.unit_scale and self.uncertainty is not None and \
+                abs(self.uncertainty) > self.scale_threshold:
+            self.uncertainty *= self.scale_factor
 
     def value_as_string(self) -> str:
         return _fmt(self.value)
@@ -476,6 +502,12 @@ class funcParameter(Param):
     def value(self, v):
         if v is not None:
             raise AttributeError(f"{self.name} is derived and read-only")
+
+    def set_from_string(self, s: str):
+        raise ValueError(
+            f"{self.name} is a derived (read-only) parameter of this model"
+            + (f", computed from {self.source_params}; set those instead"
+               if self.source_params else ""))
 
     def as_parfile_line(self) -> str:
         return ""

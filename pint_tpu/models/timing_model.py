@@ -300,6 +300,10 @@ class TimingModel:
             raise TimingModelError(f"component {name} already present")
         comp._parent = self
         self.components[name] = comp
+        from pint_tpu.models.parameter import funcParameter
+        for par in comp.params.values():
+            if isinstance(par, funcParameter):
+                par.bind(self)
         self._sort_components()
         if setup:
             comp.setup()

@@ -1,0 +1,232 @@
+"""ELL1 binary-family tests.
+
+Strategy (mirrors reference `tests/test_ELL1.py` etc. without its data):
+validate the harmonic expansion against an independent exact-Kepler
+numerical oracle, check the dPhi-derivative table against autodiff, and
+simulate -> perturb -> fit round-trips recovering the orbital elements.
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from scipy.optimize import brentq
+
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.models.binary_ell1 import roemer_series
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR FAKEBIN
+RAJ 07:40:45.79
+DECJ 66:20:33.5
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+BINARY ELL1
+PB 4.76694461 1
+A1 3.9775561 1
+TASC 55000.3 1
+EPS1 -5.7e-6 1
+EPS2 -1.89e-5 1
+M2 0.25
+SINI 0.99
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+BINARY_FIT = ["PB", "A1", "TASC", "EPS1", "EPS2"]
+ALL_FIT = ["F0", "F1", "DM"] + BINARY_FIT
+
+
+def _model(par=PAR):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(par.strip().splitlines())
+
+
+def exact_roemer(phi, e1, e2):
+    """Exact elliptical-orbit Roemer delay per a1 (BT-style), solved
+    numerically per point — the independent oracle for the ELL1 expansion.
+    The ELL1 convention drops the unobservable constant -3/2*eps1 (Lange
+    et al. 2001), so compare after removing it."""
+    e = np.hypot(e1, e2)
+    om = np.arctan2(e1, e2)
+    out = np.empty_like(phi)
+    for i, P in enumerate(phi):
+        M = P - om
+        E = brentq(lambda E: E - e * np.sin(E) - M, M - 1, M + 1,
+                   xtol=1e-15)
+        out[i] = (np.sin(om) * (np.cos(E) - e)
+                  + np.sqrt(1 - e * e) * np.cos(om) * np.sin(E))
+    # the exact delay carries a constant -3/2*eps1 that ELL1 drops
+    return out + 1.5 * e1
+
+
+class TestRoemerExpansion:
+    @pytest.mark.parametrize("e1,e2", [
+        (1e-4, 5e-5), (1e-3, -2e-3), (5e-3, 8e-3), (0.0, 0.01),
+        (-3e-3, 1e-3)])
+    def test_matches_exact_kepler_to_e4(self, e1, e2):
+        phi = np.linspace(0, 2 * np.pi, 197)
+        ours = np.asarray(roemer_series(phi, e1, e2, 0))
+        oracle = exact_roemer(phi, e1, e2)
+        e = np.hypot(e1, e2)
+        assert np.max(np.abs(ours - oracle)) < 5 * e**4 + 1e-12
+
+    def test_dphi_orders_match_autodiff(self):
+        e1, e2 = 3e-4, -7e-4
+        phi = np.linspace(0, 2 * np.pi, 33)
+        g1 = jax.vmap(jax.grad(lambda P: roemer_series(P, e1, e2, 0)))(phi)
+        g2 = jax.vmap(jax.grad(jax.grad(
+            lambda P: roemer_series(P, e1, e2, 0))))(phi)
+        np.testing.assert_allclose(np.asarray(roemer_series(phi, e1, e2, 1)),
+                                   np.asarray(g1), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(roemer_series(phi, e1, e2, 2)),
+                                   np.asarray(g2), atol=1e-12)
+
+
+class TestModelBuild:
+    def test_builder_selects_ell1(self):
+        m = _model()
+        assert "BinaryELL1" in m.components
+        assert m.PB.value == pytest.approx(4.76694461)
+        assert m.ECC.value == pytest.approx(np.hypot(5.7e-6, 1.89e-5))
+        # OM derived from the eps pair
+        assert m.OM.value == pytest.approx(
+            np.degrees(np.arctan2(-5.7e-6, -1.89e-5)) % 360)
+
+    def test_unknown_binary_raises(self):
+        from pint_tpu.exceptions import UnknownBinaryModel
+
+        with pytest.raises(UnknownBinaryModel):
+            _model(PAR.replace("BINARY ELL1", "BINARY NOSUCH"))
+
+    def test_unit_scale_pbdot(self):
+        m = _model(PAR + "PBDOT -3.8\n")  # tempo 1e-12 convention
+        assert m.PBDOT.value == pytest.approx(-3.8e-12)
+        m2 = _model(PAR + "PBDOT -3.8e-12\n")  # explicit
+        assert m2.PBDOT.value == pytest.approx(-3.8e-12)
+        # explicit value + bare-convention uncertainty: each thresholded
+        # on its own magnitude
+        m3 = _model(PAR + "PBDOT -3.8e-12 1 0.2\n")
+        assert m3.PBDOT.value == pytest.approx(-3.8e-12)
+        assert m3.PBDOT.uncertainty == pytest.approx(0.2e-12)
+
+    def test_ecc_line_gives_helpful_error(self):
+        """ECC/OM are derived for ELL1; a par file setting them (e.g.
+        converted from DD) must fail with a pointer to EPS1/EPS2."""
+        with pytest.raises(ValueError, match="EPS1"):
+            _model(PAR + "ECC 1.4e-6\n")
+
+    def test_fb_gap_rejected(self):
+        par = PAR.replace("PB 4.76694461 1", "FB0 2.43e-6") + "FB2 1e-28\n"
+        with pytest.raises(ValueError, match="FB"):
+            _model(par)
+
+    def test_stray_other_binary_param_ignored(self):
+        """A leftover H3 with BINARY ELL1 must not co-select ELL1H."""
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = _model(PAR + "H3 1.1e-7\n")
+        assert "BinaryELL1" in m.components
+        assert "BinaryELL1H" not in m.components
+
+    def test_binary_params_without_binary_line(self):
+        from pint_tpu.exceptions import TimingModelError
+
+        par = PAR.replace("BINARY ELL1\n", "")
+        with pytest.raises(TimingModelError, match="BINARY"):
+            _model(par)
+
+    def test_ell1h_and_ell1k_build(self):
+        parh = PAR.replace("BINARY ELL1", "BINARY ELL1H").replace(
+            "M2 0.25", "H3 1.1e-7").replace("SINI 0.99", "STIGMA 0.8")
+        mh = _model(parh)
+        assert "BinaryELL1H" in mh.components
+        park = PAR.replace("BINARY ELL1", "BINARY ELL1k") + \
+            "OMDOT 10.0\nLNEDOT 0.0\n"
+        mk = _model(park)
+        assert "BinaryELL1k" in mk.components
+
+
+class TestShapiro:
+    def test_m2_sini_amplitude(self):
+        """Shapiro delay peak-to-peak ~ -2 T_sun M2 ln((1-s)/(1+s))."""
+        m = _model()
+        comp = m.components["BinaryELL1"]
+        import jax.numpy as jnp
+
+        p = m.build_pdict()
+        phi = jnp.array([np.pi / 2, 3 * np.pi / 2])  # conjunction/opposition
+        d = np.asarray(comp.shapiro_delay(p, phi))
+        Tsun = 4.925490947641267e-06
+        expect_pp = 2 * Tsun * 0.25 * (np.log(1 + 0.99) - np.log(1 - 0.99))
+        assert d[0] - d[1] == pytest.approx(expect_pp, rel=1e-10)
+
+    def test_ell1h_exact_vs_harmonic_sum(self):
+        """For moderate stigma the NHARMS sum converges to the exact form
+        (both Freire & Wex 2010); cross-validates the two code paths."""
+        parh = PAR.replace("BINARY ELL1", "BINARY ELL1H").replace(
+            "M2 0.25", "H3 1.1e-7").replace("SINI 0.99", "STIGMA 0.3")
+        mh = _model(parh)
+        comph = mh.components["BinaryELL1H"]
+        import jax.numpy as jnp
+
+        ph = mh.build_pdict()
+        phi = jnp.linspace(0, 2 * np.pi, 100)
+        exact = np.asarray(comph.shapiro_delay(ph, phi))
+        # harmonic path: same H3, stigma via H4 = stigma*H3, many harmonics
+        parh2 = parh.replace("STIGMA 0.3", "H4 0.33e-7") \
+            .replace("H3 1.1e-7", "H3 1.1e-7\nNHARMS 30")
+        mh2 = _model(parh2)
+        comp2 = mh2.components["BinaryELL1H"]
+        p2 = mh2.build_pdict()
+        harm = np.asarray(comp2.shapiro_delay(p2, phi))
+        # they differ by constant + first two harmonics (absorbed in fit);
+        # project both onto harmonics >= 3
+        def high_harm(y):
+            n = len(y)
+            f = np.fft.rfft(y - y.mean())
+            f[:3] = 0
+            return np.fft.irfft(f, n)
+        np.testing.assert_allclose(high_harm(exact), high_harm(harm),
+                                   atol=5e-12)
+
+
+class TestFitRoundtrip:
+    def test_recover_orbit(self):
+        m = _model()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(
+                54900, 55100, 250, m, obs="gbt", error_us=1.0,
+                freq_mhz=np.tile([1400.0, 800.0], 125),
+                add_noise=True, seed=11)
+        truth = {n: m[n].value for n in ALL_FIT}
+        m.PB.value += 3e-8
+        m.A1.value += 2e-6
+        m.TASC.set_value(m.TASC.value.mjd_float + 2e-7)
+        m.EPS1.value += 3e-7
+        m.EPS2.value += 3e-7
+        m.F0.value += 1e-10
+        pre = Residuals(toas, m).calc_chi2()
+        f = WLSFitter(toas, m)
+        chi2 = f.fit_toas(maxiter=3)
+        assert chi2 < pre / 2
+        assert 0.6 < chi2 / f.resids.dof < 1.6
+        for n in ALL_FIT:
+            par = m[n]
+            if n == "TASC":
+                pull = (par.value.mjd_float - truth[n].mjd_float) / \
+                    par.uncertainty
+            else:
+                pull = (par.value - truth[n]) / par.uncertainty
+            assert abs(pull) < 5, f"{n} pull {pull}"

@@ -84,6 +84,17 @@ def test_chi2_uses_scaled_errors():
     assert c1 == pytest.approx(c0 / 4.0, rel=1e-9)
 
 
+def test_add_noise_param_programmatic():
+    from pint_tpu.models.noise_model import ScaleToaError
+
+    st = ScaleToaError()
+    p = st.add_noise_param("EFAC", key="freq", key_value=[0, 3000],
+                           value=1.5)
+    assert p.name == "EFAC1" and p.value == 1.5
+    with pytest.raises(ValueError, match="unknown"):
+        st.add_noise_param("ECORR", value=1.0)
+
+
 def test_multiple_efacs_roundtrip_parfile():
     par = PAR_BASE + ("EFAC freq 0 1000 1.1\n"
                       "EFAC freq 1000 2000 1.2\n")
