@@ -1,0 +1,106 @@
+"""North-star benchmark: WLS chi2 grid on a J0740-class dataset.
+
+Reference harness: `profiling/bench_chisq_grid_WLSFitter.py:10-24` — a 3x3
+M2/SINI grid of WLS fits on the NANOGrav J0740+6620 12.5k-TOA dataset,
+176.437 s total on an i7-6700K (`profiling/README.txt:62-71`), >80% of it
+Python design-matrix assembly.  Here the same shape of work — 9 grid
+points, each a 2-iteration Gauss-Newton WLS fit with a final chi2, on
+12,500 simulated J0740-class TOAs with an ELL1 binary — runs as ONE
+vmapped XLA program on the TPU (`pint_tpu.gridutils.grid_chisq_flat`).
+
+Prints one JSON line:
+  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": speedup}
+(vs_baseline = reference seconds / our seconds; >1 is faster than the
+reference CPU run).  Extra diagnostics go to stderr.
+"""
+
+import json
+import os
+import sys
+import time
+import warnings
+
+warnings.filterwarnings("ignore")
+
+import numpy as np  # noqa: E402
+
+BASELINE_S = 176.437  # reference bench_chisq_grid_WLSFitter total
+NTOAS = 12500
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_cache")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def get_dataset():
+    from pint_tpu.examples import j0740_class_model, simulate_j0740_class
+    from pint_tpu.toa import get_TOAs, write_tim
+
+    timfile = os.path.join(CACHE, f"j0740_bench_{NTOAS}.tim")
+    if os.path.exists(timfile):
+        log(f"using cached {timfile}")
+        model = j0740_class_model()
+        toas = get_TOAs(timfile, model=model)
+    else:
+        t0 = time.time()
+        model, toas = simulate_j0740_class(
+            ntoas=NTOAS, span_days=4550.0, center_mjd=54975.0, seed=0)
+        log(f"simulated {NTOAS} TOAs in {time.time()-t0:.1f} s")
+        os.makedirs(CACHE, exist_ok=True)
+        write_tim(timfile, toas)
+    return model, toas
+
+
+def main():
+    import jax
+
+    # persistent XLA cache: repeat runs skip the one-time compile
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(CACHE, "xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    log("jax devices:", jax.devices())
+    t_setup = time.time()
+    model, toas = get_dataset()
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.gridutils import grid_chisq_flat
+
+    model.M2.frozen = True
+    model.SINI.frozen = True
+    fitter = WLSFitter(toas, model)
+    grid = {
+        "M2": np.repeat(np.array([0.23, 0.25, 0.27]), 3),
+        "SINI": np.tile(np.array([0.97, 0.99, 0.995]), 3),
+    }
+    log(f"setup {time.time()-t_setup:.1f} s; "
+        f"{len(fitter.fit_params)} fit params, 3x3 M2/SINI grid")
+
+    # first call compiles (cached for subsequent shapes); measure steady state
+    t0 = time.time()
+    chi2 = grid_chisq_flat(fitter, grid, maxiter=2)
+    t_compile = time.time() - t0
+    log(f"warmup (incl. compile): {t_compile:.2f} s; chi2 range "
+        f"[{chi2.min():.1f}, {chi2.max():.1f}] dof~{fitter.resids.dof}")
+
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        chi2 = grid_chisq_flat(fitter, grid, maxiter=2)
+        times.append(time.time() - t0)
+    t = min(times)
+    log(f"steady-state grid times: {[f'{x:.3f}' for x in times]}")
+
+    print(json.dumps({
+        "metric": "wls_chisq_grid_3x3_J0740class_12500toas",
+        "value": round(t, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / t, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Shared example configurations (the reference ships example par files
+via `pint.config`/`src/pint/data/examples`; here the flagship bench/test
+configuration lives in one place so bench.py, __graft_entry__.py and the
+test suites cannot drift apart)."""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+#: J0740+6620-class millisecond pulsar with an ELL1 binary — the flagship
+#: configuration used by bench.py (the reference's grid benchmark dataset
+#: is NANOGrav J0740+6620, `profiling/bench_chisq_grid_WLSFitter.py:10-24`)
+J0740_CLASS_PAR = """
+PSR J0740-BENCH
+RAJ 07:40:45.79 1
+DECJ 66:20:33.5 1
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+BINARY ELL1
+PB 4.76694461 1
+A1 3.9775561 1
+TASC 55000.3 1
+EPS1 -5.7e-6 1
+EPS2 -1.89e-5 1
+M2 0.25
+SINI 0.99
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def j0740_class_model():
+    from pint_tpu.models import get_model
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(J0740_CLASS_PAR.strip().splitlines())
+
+
+def simulate_j0740_class(ntoas: int = 40, span_days: float = 600.0,
+                         center_mjd: float = 55000.0, error_us: float = 1.0,
+                         seed: int = 7):
+    """(model, noisy dual-frequency TOAs) for the flagship configuration."""
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    model = j0740_class_model()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        toas = make_fake_toas_uniform(
+            center_mjd - span_days / 2, center_mjd + span_days / 2, ntoas,
+            model, obs="gbt", error_us=error_us,
+            freq_mhz=np.tile([1400.0, 800.0], (ntoas + 1) // 2)[:ntoas],
+            add_noise=True, seed=seed)
+    return model, toas

@@ -1,0 +1,125 @@
+"""Chi-squared grids over frozen parameters — the vmap showcase.
+
+Reference: `grid_chisq` (`/root/reference/src/pint/gridutils.py:169`), which
+deep-copies the whole fitter per grid point and farms points out to a
+`ProcessPoolExecutor` (`gridutils.py:36-116,322-331`) — the reference's only
+scale-out mechanism, at ~20 s/point on CPU.
+
+Here a grid point is just a different value of some ``p["delta"]`` leaves in
+the params pytree, so the WHOLE grid is one `jax.vmap` of the jitted
+Gauss-Newton fit over a stacked pytree: one XLA program, all points resident
+on the accelerator, no copies, no processes.  Sharding the same stacked
+axis over a device mesh is `pint_tpu.parallel`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitter import Fitter, build_wls_step
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.residuals import Residuals
+
+__all__ = ["grid_chisq", "grid_chisq_flat", "build_grid_fit_fn",
+           "stack_grid_pdict", "grid_in_axes"]
+
+
+def _grid_deltas(model: TimingModel, p: dict,
+                 grid_values: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Device-unit delta arrays (G,) that realize the requested par-unit
+    grid values for each (frozen) grid parameter."""
+    out = {}
+    for name, vals in grid_values.items():
+        par = model[name]
+        vals = np.asarray(vals, np.float64)
+        base = np.asarray(par.device_value, np.float64)
+        if par.kind == "mjd":
+            out[name] = vals - (base[0] + base[1])  # grid given in MJD
+        else:
+            out[name] = vals * par.par2dev - base
+    return out
+
+
+def stack_grid_pdict(model: TimingModel, p: dict,
+                     grid_values: Dict[str, np.ndarray]) -> dict:
+    """A params pytree whose ``delta`` leaves for the grid parameters carry
+    a leading grid axis; everything else is shared."""
+    deltas = _grid_deltas(model, p, grid_values)
+    delta = dict(p["delta"])
+    for name, d in deltas.items():
+        delta[name] = jnp.asarray(d)
+    out = dict(p)
+    out["delta"] = delta
+    return out
+
+
+def grid_in_axes(p: dict, grid_names: Sequence[str]) -> dict:
+    """The matching `jax.vmap` in_axes pytree: 0 on the grid deltas."""
+    names = set(grid_names)
+    return {
+        "const": {k: None for k in p["const"]},
+        "delta": {k: (0 if k in names else None) for k in p["delta"]},
+        "mask": {k: None for k in p["mask"]},
+    }
+
+
+def build_grid_fit_fn(model: TimingModel, batch, fit_params: Sequence[str],
+                      track_mode: str, maxiter: int = 2,
+                      threshold: Optional[float] = None):
+    """``fit_one(p) -> (chi2, x)``: a full (fixed-iteration) WLS fit of one
+    pytree — vmap/shard_map this over stacked grid pytrees."""
+    step = build_wls_step(model, batch, fit_params, track_mode,
+                          threshold=threshold)
+
+    def fit_one(p):
+        x = jnp.zeros(len(fit_params))
+        for _ in range(maxiter):
+            x = x + step(x, p)["dx"]
+        out = step(x, p)
+        return out["chi2"], x
+
+    return fit_one
+
+
+def grid_chisq_flat(fitter: Fitter, grid_values: Dict[str, np.ndarray],
+                    maxiter: int = 2) -> np.ndarray:
+    """chi2 at each of G grid points (all grid arrays shape (G,)); the
+    non-grid free parameters are re-fit at every point."""
+    model = fitter.model
+    r = fitter.resids
+    names = [n for n in fitter.fit_params if n not in grid_values]
+    for n in grid_values:
+        if not model[n].frozen:
+            raise ValueError(f"grid parameter {n} must be frozen")
+    p = r.pdict
+    # cache the compiled vmapped fit on the fitter: a fresh jit wrapper
+    # per call would retrace the whole grid program every time
+    key = (tuple(sorted(grid_values)), tuple(names), maxiter)
+    cache = getattr(fitter, "_grid_fit_cache", None)
+    if cache is None:
+        cache = fitter._grid_fit_cache = {}
+    vfit = cache.get(key)
+    if vfit is None:
+        fit_one = build_grid_fit_fn(model, r.batch, names,
+                                    fitter.track_mode, maxiter=maxiter)
+        axes = grid_in_axes(p, list(grid_values))
+        vfit = cache[key] = jax.jit(jax.vmap(fit_one, in_axes=(axes,)))
+    stacked = stack_grid_pdict(model, p, grid_values)
+    chi2, _ = vfit(stacked)
+    return np.asarray(chi2)
+
+
+def grid_chisq(fitter: Fitter, parnames: Sequence[str],
+               parvalues: Sequence[np.ndarray],
+               maxiter: int = 2) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Full outer-product chi2 grid (reference `grid_chisq`,
+    `/root/reference/src/pint/gridutils.py:169`): returns
+    ``(chi2[shape G1 x G2 x ...], meshgrids)``."""
+    grids = np.meshgrid(*[np.asarray(v) for v in parvalues], indexing="ij")
+    flat = {n: g.ravel() for n, g in zip(parnames, grids)}
+    chi2 = grid_chisq_flat(fitter, flat, maxiter=maxiter)
+    return chi2.reshape(grids[0].shape), grids

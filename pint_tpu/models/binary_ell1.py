@@ -185,6 +185,12 @@ class BinaryELL1Base(DelayComponent):
     def shapiro_delay(self, p: dict, Phi):
         return jnp.zeros_like(Phi)
 
+    def roemer_const(self, e1):
+        """The -(3/2)*eps1 Roemer term.  A true constant for ELL1/ELL1H
+        (dropped, unobservable); ELL1k keeps it because eps1(t) varies
+        under OMDOT/LNEDOT (reference `ELL1k_model.py:120-134`)."""
+        return 0.0
+
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         dt = self._ttasc(p, batch, delay)
         orbits, forb = self._orbits_and_freq(p, dt)
@@ -193,7 +199,7 @@ class BinaryELL1Base(DelayComponent):
         e1, e2 = self._eps(p, dt)
         a1 = pv(p, "A1") + dt * pv(p, "A1DOT")
         nhat = 2.0 * math.pi * forb
-        Dre = a1 * roemer_series(Phi, e1, e2, 0)
+        Dre = a1 * (roemer_series(Phi, e1, e2, 0) + self.roemer_const(e1))
         Drep = a1 * roemer_series(Phi, e1, e2, 1)
         Drepp = a1 * roemer_series(Phi, e1, e2, 2)
         # inverse-timing expansion: Dre evaluated at the pulsar proper
@@ -317,3 +323,8 @@ class BinaryELL1k(BinaryELL1):
         co, so = jnp.cos(omdot * dt), jnp.sin(omdot * dt)
         grow = 1.0 + lnedot * dt
         return grow * (e10 * co + e20 * so), grow * (e20 * co - e10 * so)
+
+    def roemer_const(self, e1):
+        # eps1(t) varies, so the -(3/2)*eps1 term is a real, time-varying
+        # delay here (~a1*eps1 scale) and must be kept
+        return -1.5 * e1

@@ -149,13 +149,10 @@ class FloatParam(Param):
         self.unit_scale = unit_scale
         self.scale_factor = scale_factor
         self.scale_threshold = scale_threshold
-        self._scaled_on_parse = False
 
     def set_from_string(self, s: str):
         v = parse_number(s)
-        self._scaled_on_parse = self.unit_scale and \
-            abs(v) > self.scale_threshold
-        if self._scaled_on_parse:
+        if self.unit_scale and abs(v) > self.scale_threshold:
             v *= self.scale_factor
         self.value = v
 

@@ -1,0 +1,205 @@
+"""Multi-device scale-out: shard_map over a jax Mesh.
+
+The reference's only parallelism is a process pool that deep-copies the
+fitter per chi2-grid point (`/root/reference/src/pint/gridutils.py:322`).
+The TPU-native replacement defined here shards two axes of the same jitted
+fit over an ICI mesh:
+
+* ``batch`` — grid points / ensemble pulsars, embarrassingly parallel
+  (the data-parallel axis);
+* ``toa`` — the per-TOA arrays (the "sequence" axis, SURVEY §5's
+  long-context analogue): residuals and design-matrix rows are computed on
+  local TOA shards and the WLS solve runs on `psum`-reduced normal
+  equations, so arbitrarily large TOA sets never need to fit on one chip.
+
+The normal-equation path is range-safe for TPU's emulated f64 (f32
+exponent range): design-matrix columns are rescaled by their global
+(`pmax`) maxima before any square is formed — see
+`pint_tpu.fitter.fit_wls_svd` for the same consideration on one chip.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 public API; fall back for older jax
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from pint_tpu.fitter import build_resid_sec_fn
+from pint_tpu.gridutils import grid_in_axes, stack_grid_pdict
+from pint_tpu.models.timing_model import TimingModel, pv
+from pint_tpu.residuals import raw_phase_resids
+from pint_tpu.toabatch import TOABatch
+
+__all__ = ["make_mesh", "build_sharded_grid_fit", "pad_batch",
+           "sharded_grid_chisq"]
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              batch: Optional[int] = None) -> Mesh:
+    """A ("batch", "toa") mesh over the first ``n_devices`` devices."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if batch is None:
+        batch = 2 if n % 2 == 0 else 1
+    if n % batch:
+        raise ValueError(f"{n} devices do not split into batch={batch}")
+    arr = np.array(devs[:n]).reshape(batch, n // batch)
+    return Mesh(arr, ("batch", "toa"))
+
+
+def pad_batch(batch: TOABatch, multiple: int) -> TOABatch:
+    """Pad the TOA axis to a multiple of the toa-mesh size with
+    zero-weight rows (error -> huge, so they are chi2- and fit-neutral)."""
+    n = batch.ntoas
+    pad = (-n) % multiple
+    if pad == 0:
+        return batch
+    idx = np.concatenate([np.arange(n), np.full(pad, n - 1)])
+    out = batch.select(idx)
+    err = np.asarray(out.error_us).copy()
+    err[n:] = 1e12  # effectively zero weight
+    return out._replace(error_us=jnp.asarray(err))
+
+
+def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
+                           track_mode: str, mesh: Mesh,
+                           maxiter: int = 2, include_offset: bool = True):
+    """``fit(stacked_p, batch) -> (chi2[G], x[G,P])`` with grid points
+    sharded over the mesh's "batch" axis and TOAs over its "toa" axis.
+
+    The inner solver is weighted normal equations with diagonal
+    preconditioning, assembled from per-shard partial sums (`psum` over
+    "toa") — the distributed-WLS formulation that rides ICI collectives
+    instead of gathering rows.
+    """
+    calc = model.calc
+    names = list(fit_params)
+    npar = len(names)
+
+    def resid_sec(x, p, b):
+        p2 = model.with_x(p, x, names)
+        r = raw_phase_resids(calc, p2, b, track_mode,
+                             subtract_mean=False, use_weights=False)
+        return r / pv(p2, "F0")
+
+    def ne_step(x, p, b):
+        """One Gauss-Newton step from psum'd normal equations; returns
+        (dx, chi2_at_x)."""
+        r = resid_sec(x, p, b)
+        J = jax.jacfwd(resid_sec)(x, p, b)
+        M = -J
+        if include_offset:
+            M = jnp.concatenate([M, -jnp.ones((M.shape[0], 1))], axis=1)
+        sigma = model.scaled_toa_uncertainty(p, b) * 1e-6
+        Mw = M / sigma[:, None]
+        rw = r / sigma
+        # global per-column scale before any square (TPU f64 range safety)
+        cmax = jax.lax.pmax(jnp.max(jnp.abs(Mw), axis=0), "toa")
+        cmax = jnp.where(cmax == 0.0, 1.0, cmax)
+        Mc = Mw / cmax
+        A = jax.lax.psum(Mc.T @ Mc, "toa")
+        bb = jax.lax.psum(Mc.T @ rw, "toa")
+        d = jnp.sqrt(jnp.diagonal(A))
+        d = jnp.where(d == 0.0, 1.0, d)
+        An = A / jnp.outer(d, d)
+        z = jnp.linalg.solve(An, bb / d)
+        dx = z / (d * cmax)
+        # chi2 at x with the offset profiled out, reduced over shards
+        w = 1.0 / sigma**2
+        if include_offset:
+            off = jax.lax.psum(jnp.sum(r * w), "toa") / \
+                jax.lax.psum(jnp.sum(w), "toa")
+        else:
+            off = 0.0
+        chi2 = jax.lax.psum(jnp.sum(((r - off) / sigma) ** 2), "toa")
+        return dx[:npar], chi2
+
+    def fit_one(p, b):
+        x = jnp.zeros(npar)
+        for _ in range(maxiter):
+            dx, _ = ne_step(x, p, b)
+            x = x + dx
+        _, chi2 = ne_step(x, p, b)
+        return chi2, x
+
+    grid_names: list = []
+
+    def local_fit(p, b):
+        axes = grid_in_axes(p, grid_names)
+        return jax.vmap(fit_one, in_axes=(axes, None))(p, b)
+
+    def make(p_stacked, batch, names_of_grid):
+        grid_names[:] = list(names_of_grid)
+        gspec = {
+            "const": {k: P() for k in p_stacked["const"]},
+            "delta": {k: (P("batch") if k in grid_names else P())
+                      for k in p_stacked["delta"]},
+            "mask": {k: P("toa") for k in p_stacked["mask"]},
+        }
+        bspec = jax.tree_util.tree_map(lambda leaf: P("toa"), batch)
+        f = shard_map(local_fit, mesh=mesh, in_specs=(gspec, bspec),
+                      out_specs=(P("batch"), P("batch", None)),
+                      check_rep=False)
+        return jax.jit(f)
+
+    return make
+
+
+def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
+                       mesh: Optional[Mesh] = None,
+                       maxiter: int = 2) -> np.ndarray:
+    """chi2 over a flat grid, sharded over the mesh: the distributed
+    replacement for the reference's ProcessPoolExecutor grid."""
+    if not grid_values:
+        raise ValueError("grid_values is empty")
+    mesh = mesh or make_mesh()
+    model = fitter.model
+    r = fitter.resids
+    sizes = {n: len(v) for n, v in grid_values.items()}
+    if len(set(sizes.values())) != 1:
+        raise ValueError(f"grid arrays differ in length: {sizes}")
+    g = next(iter(sizes.values()))
+    if g % mesh.devices.shape[0]:
+        raise ValueError(
+            f"grid size {g} does not split over "
+            f"{mesh.devices.shape[0]} batch-axis devices")
+    for n in grid_values:
+        if not model[n].frozen:
+            raise ValueError(f"grid parameter {n} must be frozen")
+    names = [n for n in fitter.fit_params if n not in grid_values]
+    batch = pad_batch(r.batch, mesh.devices.shape[1])
+    p = model.build_pdict(fitter.toas,
+                          tzr_toas=model.make_tzr_toas_or_none())
+    npad = batch.ntoas - r.batch.ntoas
+    if npad:
+        p = dict(p)
+        p["mask"] = {k: jnp.concatenate(
+            [jnp.asarray(v), jnp.zeros(npad)])
+            for k, v in p["mask"].items()}
+    stacked = stack_grid_pdict(model, p, grid_values)
+    # cache the compiled sharded program on the fitter (same rationale as
+    # gridutils.grid_chisq_flat: a fresh shard_map+jit per call retraces)
+    key = ("sharded", tuple(sorted(grid_values)), tuple(names), maxiter,
+           mesh.devices.shape, batch.ntoas, g)
+    cache = getattr(fitter, "_grid_fit_cache", None)
+    if cache is None:
+        cache = fitter._grid_fit_cache = {}
+    fit = cache.get(key)
+    if fit is None:
+        make = build_sharded_grid_fit(model, names, fitter.track_mode,
+                                      mesh, maxiter=maxiter)
+        fit = cache[key] = make(stacked, batch, list(grid_values))
+    chi2, _ = fit(stacked, batch)
+    return np.asarray(chi2)

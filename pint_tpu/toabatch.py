@@ -74,12 +74,12 @@ class TOABatch(NamedTuple):
     #: keys among {"jupiter","saturn","venus","uranus","neptune","mercury","mars","moon"}
     obs_planet_pos_ls: Dict[str, jnp.ndarray]
 
+    # NOTE: no __len__ override — TOABatch is a NamedTuple and len() must
+    # keep tuple semantics (10 fields): _replace()/_make() and pytree
+    # machinery check it.  Row count is .ntoas.
     @property
     def ntoas(self) -> int:
         return self.tdb_day.shape[0]
-
-    def __len__(self) -> int:  # pragma: no cover - convenience
-        return self.ntoas
 
     @property
     def tdbld(self) -> jnp.ndarray:

@@ -157,6 +157,34 @@ class TestModelBuild:
         assert "BinaryELL1k" in mk.components
 
 
+class TestELL1k:
+    def test_keeps_time_varying_roemer_constant(self):
+        """ELL1k keeps the -(3/2)*a1*eps1(t) term ELL1 drops (it varies
+        under OMDOT/LNEDOT; reference ELL1k_model.py:120-134).  With the
+        evolution rates at zero the two models must differ by exactly
+        that constant."""
+        import jax.numpy as jnp
+
+        m1 = _model()
+        park = PAR.replace("BINARY ELL1", "BINARY ELL1k") + \
+            "OMDOT 0.0\nLNEDOT 0.0\n"
+        mk = _model(park)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(
+                54950, 55050, 20, m1, obs="gbt", error_us=1.0,
+                freq_mhz=np.full(20, 1400.0))
+        b = toas.to_batch()
+        d1 = np.asarray(m1.components["BinaryELL1"].delay(
+            m1.build_pdict(toas), b, jnp.zeros(20)))
+        dk = np.asarray(mk.components["BinaryELL1k"].delay(
+            mk.build_pdict(toas), b, jnp.zeros(20)))
+        const = -1.5 * 3.9775561 * (-5.7e-6)
+        # the inverse-timing expansion couples Dre to its derivatives, so
+        # the difference is the constant only to O(nhat*Drep) ~ 1e-4
+        np.testing.assert_allclose(dk - d1, const, rtol=1e-3)
+
+
 class TestShapiro:
     def test_m2_sini_amplitude(self):
         """Shapiro delay peak-to-peak ~ -2 T_sun M2 ln((1-s)/(1+s))."""
