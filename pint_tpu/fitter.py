@@ -34,10 +34,11 @@ from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
 from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.residuals import Residuals, raw_phase_resids
 from pint_tpu.toabatch import TOABatch
-from pint_tpu.utils import normalize_designmatrix
+from pint_tpu.utils import normalize_designmatrix, woodbury_dot
 
-__all__ = ["Fitter", "WLSFitter", "DownhillWLSFitter", "fit_wls_svd",
-           "build_wls_step"]
+__all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
+           "DownhillGLSFitter", "fit_wls_svd", "build_wls_step",
+           "build_gls_step"]
 
 
 def fit_wls_svd(M, r_sec, sigma_sec, threshold: Optional[float] = None):
@@ -91,6 +92,104 @@ def build_resid_sec_fn(model: TimingModel, batch: TOABatch,
     return resid_sec
 
 
+def build_whitened_assembly(model: TimingModel, batch: TOABatch,
+                            fit_params: Sequence[str], track_mode: str,
+                            include_offset: bool):
+    """``(x, p) -> (r, M, sigma)``: residuals [s], design matrix (offset
+    column appended unless the model carries PHOFF) and scaled per-TOA
+    uncertainties [s] — the assembly shared by the WLS and GLS steps."""
+    resid_sec = build_resid_sec_fn(model, batch, list(fit_params),
+                                   track_mode)
+
+    def assemble(x, p):
+        r = resid_sec(x, p)
+        J = jax.jacfwd(resid_sec)(x, p)
+        M = -J
+        if include_offset:
+            M = jnp.concatenate([M, -jnp.ones((M.shape[0], 1))], axis=1)
+        sigma = model.scaled_toa_uncertainty(p, batch) * 1e-6
+        return r, M, sigma
+
+    return assemble
+
+
+def build_gls_step(model: TimingModel, batch: TOABatch,
+                   fit_params: Sequence[str], track_mode: str,
+                   threshold: Optional[float] = None,
+                   include_offset: bool = True):
+    """The jitted GLS Gauss-Newton step ``(x, p) -> dict`` (reference
+    `GLSFitter.fit_toas` basis path + `get_gls_mtcm_mtcy`,
+    `/root/reference/src/pint/fitter.py:1841,2618`).
+
+    The normal matrix is assembled over the augmented design matrix
+    ``[M | noise basis]`` with the diagonal prior ``phiinv = 1/weights``
+    on the basis columns (zero — an improper flat prior — on timing
+    columns, where the reference uses enterprise's 1e40 constant), then
+    solved by a thresholded eigendecomposition in diagonally
+    preconditioned coordinates (the eigencutoff plays the reference's
+    SVD-fallback/degeneracy-warning role, `fitter.py:2639`).  Returned
+    covariance and noise-realization amplitudes are in normalized
+    coordinates + norms, denormalized on host (TPU f64 range; see
+    `fit_wls_svd`).  chi2 is the Woodbury form r^T C^-1 r with the
+    offset profiled out in the SAME C^-1 metric (reference
+    `residuals.py:646`, `utils.py:3097`).
+    """
+    names = list(fit_params)
+    npar = len(names)
+    assemble = build_whitened_assembly(model, batch, names, track_mode,
+                                       include_offset)
+
+    @jax.jit
+    def step(x, p):
+        r, M, sigma = assemble(x, p)
+        U = model.noise_basis(p)
+        phi = model.noise_weights(p)
+        ntm = M.shape[1]
+        Mfull = M if U is None else jnp.concatenate([M, U], axis=1)
+        Mw = Mfull / sigma[:, None]
+        rw = r / sigma
+        # two-stage range-safe column normalization (see fit_wls_svd)
+        cmax = jnp.max(jnp.abs(Mw), axis=0)
+        cmax = jnp.where(cmax == 0.0, 1.0, cmax)
+        Mc = Mw / cmax
+        Mn, nc = normalize_designmatrix(Mc)
+        norms = cmax * nc
+        phiinv = jnp.zeros(Mfull.shape[1]) if phi is None else \
+            jnp.concatenate([jnp.zeros(ntm), 1.0 / phi])
+        # (sqrt(phiinv)/norms)^2, NOT phiinv/norms^2: timing-column norms
+        # can exceed 1e19 and norms**2 leaves the emulated-f64 exponent
+        # range on TPU (the squared form stays bounded for every column)
+        A = Mn.T @ Mn + jnp.diag((jnp.sqrt(phiinv) / norms) ** 2)
+        e, V = jnp.linalg.eigh(A)
+        thr = jnp.finfo(jnp.float64).eps * A.shape[0] \
+            if threshold is None else threshold
+        bad = e <= thr * e[-1]
+        einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
+        y = V @ (einv * (V.T @ (Mn.T @ rw)))
+        sol = y / norms
+        Sigma_n = (V * einv) @ V.T
+        # chi2 at x, offset profiled out in the C^-1 metric
+        if phi is None:
+            w = 1.0 / sigma**2
+            off = jnp.sum(r * w) / jnp.sum(w) if include_offset else 0.0
+            chi2 = jnp.sum(((r - off) / sigma) ** 2)
+        else:
+            ones = jnp.ones_like(r)
+            if include_offset:
+                d11, _ = woodbury_dot(sigma**2, U, phi, ones, ones)
+                d1r, _ = woodbury_dot(sigma**2, U, phi, ones, r)
+                off = d1r / d11
+            else:
+                off = 0.0
+            chi2, _ = woodbury_dot(sigma**2, U, phi, r - off, r - off)
+        return {"dx": sol[:npar], "offset": off, "chi2": chi2,
+                "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
+                "noise_ampls": sol[ntm:], "resid_sec": r,
+                "n_bad": jnp.sum(bad)}
+
+    return step
+
+
 def build_wls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
@@ -109,16 +208,12 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
     `/root/reference/src/pint/models/timing_model.py:2326`).
     """
     names = list(fit_params)
-    resid_sec = build_resid_sec_fn(model, batch, names, track_mode)
+    assemble = build_whitened_assembly(model, batch, names, track_mode,
+                                       include_offset)
 
     @jax.jit
     def step(x, p):
-        r = resid_sec(x, p)
-        J = jax.jacfwd(resid_sec)(x, p)
-        M = -J
-        if include_offset:
-            M = jnp.concatenate([M, -jnp.ones((M.shape[0], 1))], axis=1)
-        sigma = model.scaled_toa_uncertainty(p, batch) * 1e-6
+        r, M, sigma = assemble(x, p)
         dpars, Sigma_n, norms, n_bad = fit_wls_svd(M, r, sigma, threshold)
         # chi2 at x with the offset profiled out (the linear best fit of a
         # pure offset to the current residuals)
@@ -254,6 +349,30 @@ class Fitter:
     def fit_toas(self, maxiter: int = 2, **kw) -> float:
         raise NotImplementedError
 
+    def _make_step(self, names, threshold, include_offset):
+        """The jitted Gauss-Newton step; WLS by default, overridden by the
+        GLS fitters."""
+        return build_wls_step(self.model, self.resids.batch, names,
+                              self.track_mode, threshold=threshold,
+                              include_offset=include_offset)
+
+    def _store_noise(self, out, p):
+        """Recover per-component noise realizations from the basis
+        amplitudes (reference `fitter.py:1952-1968`)."""
+        if "noise_ampls" not in out:
+            return
+        ampls = np.asarray(out["noise_ampls"])
+        self.noise_ampls = {}
+        self.noise_resids = {}
+        k = 0
+        for c in self.model.correlated_noise_components:
+            U = np.asarray(p["const"][c.basis_pytree_name])
+            w = U.shape[1]
+            a = ampls[k:k + w]
+            self.noise_ampls[type(c).__name__] = a
+            self.noise_resids[type(c).__name__] = U @ a
+            k += w
+
     def _finalize(self, p: dict, x: np.ndarray, Sigma: np.ndarray,
                   names: List[str]):
         """Write the solution back into host parameters + uncertainties."""
@@ -278,11 +397,8 @@ class WLSFitter(Fitter):
         m = self.model
         names = self.fit_params
         p = self.resids.pdict
-        batch = self.resids.batch
         include_offset = "PhaseOffset" not in m.components
-        step = build_wls_step(m, batch, names, self.track_mode,
-                              threshold=threshold,
-                              include_offset=include_offset)
+        step = self._make_step(names, threshold, include_offset)
         x = np.zeros(len(names))
         prev_chi2 = None
         for it in range(maxiter):
@@ -300,10 +416,24 @@ class WLSFitter(Fitter):
         # final chi2 at the converged x
         final = step(jnp.asarray(x), p)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
+        self._store_noise(final, p)
         self._finalize(p, x, Sigma, names)
         self.fitresult = FitSummary(float(final["chi2"]), self.resids.dof,
                                     maxiter, True)
         return float(final["chi2"])
+
+
+class GLSFitter(WLSFitter):
+    """Generalized least squares over the augmented [timing | noise-basis]
+    design matrix (reference `GLSFitter`,
+    `/root/reference/src/pint/fitter.py:1821`); chi2 is the Woodbury
+    r^T C^-1 r.  Also valid (and equal to WLS) with no correlated
+    components."""
+
+    def _make_step(self, names, threshold, include_offset):
+        return build_gls_step(self.model, self.resids.batch, names,
+                              self.track_mode, threshold=threshold,
+                              include_offset=include_offset)
 
 
 class DownhillWLSFitter(Fitter):
@@ -319,11 +449,8 @@ class DownhillWLSFitter(Fitter):
         m = self.model
         names = self.fit_params
         p = self.resids.pdict
-        batch = self.resids.batch
         include_offset = "PhaseOffset" not in m.components
-        step = build_wls_step(m, batch, names, self.track_mode,
-                              threshold=threshold,
-                              include_offset=include_offset)
+        step = self._make_step(names, threshold, include_offset)
         x = np.zeros(len(names))
         out = step(jnp.asarray(x), p)
         chi2 = float(out["chi2"])
@@ -353,9 +480,16 @@ class DownhillWLSFitter(Fitter):
             if lam == 1.0 and improvement < required_chi2_decrease:
                 converged = True
                 break
+        self._store_noise(out, p)
         self._finalize(p, x, denormalize_covariance(out["Sigma_n"],
                                                     out["norms"]), names)
         self.fitresult = FitSummary(chi2, self.resids.dof, it + 1, converged)
         if exception is not None and not converged:
             warnings.warn(str(exception))
         return chi2
+
+
+class DownhillGLSFitter(DownhillWLSFitter, GLSFitter):
+    """Downhill line search over the GLS step (reference
+    `DownhillGLSFitter`, `/root/reference/src/pint/fitter.py:1386`):
+    fit_toas from the downhill base, _make_step from GLSFitter."""

@@ -1,4 +1,4 @@
-"""White-noise rescaling and (later in this module) correlated-noise bases.
+"""White-noise rescaling and correlated-noise bases (ECORR, red noise).
 
 Reference: `ScaleToaError` (`/root/reference/src/pint/models/noise_model.py:79`)
 rescales TOA uncertainties as
@@ -19,14 +19,23 @@ jit-compiled into the residual/chi2/fit kernels.
 
 from __future__ import annotations
 
-from typing import List
+import math
+from typing import List, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.models.parameter import MaskParam, split_prefix
+from pint_tpu.models.parameter import (
+    FloatParam,
+    IntParam,
+    MaskParam,
+    split_prefix,
+)
 from pint_tpu.models.timing_model import Component, pv
 from pint_tpu.toabatch import TOABatch
+
+SECS_PER_DAY = 86400.0
+FYR = 1.0 / (365.25 * SECS_PER_DAY)  # 1/yr in Hz
 
 
 class NoiseComponent(Component):
@@ -47,18 +56,28 @@ class NoiseComponent(Component):
         """Transform per-TOA uncertainties [us]; identity by default."""
         return sigma_us
 
-    # correlated components override these (GLS basis protocol):
-    def noise_basis(self, p: dict, batch: TOABatch) -> jnp.ndarray:
-        """Basis matrix U, shape (ntoas, k)."""
+    # correlated components implement the basis/weight protocol
+    # (reference `noise_model.py:47-60`): host-built basis data shipped as
+    # pytree constants, and jit-pure prior variances [s^2] per column
+    # (differentiable in the noise parameters, which is what makes
+    # likelihood-based noise fitting autodiff-able).  ``noise_weights``
+    # derives EVERYTHING from ``p`` — never from component instance state
+    # — so a pdict snapshot stays self-consistent even after the component
+    # serves other TOAs.
+    def basis_entries(self, toas) -> dict:
+        """{pytree const name: array} — the (ntoas, k) basis plus whatever
+        static metadata `noise_weights` needs (frequencies, column->param
+        maps)."""
         raise NotImplementedError
 
-    def noise_weights(self, p: dict, batch: TOABatch) -> jnp.ndarray:
-        """Prior variance per basis column, shape (k,)."""
+    def noise_weights(self, p: dict) -> jnp.ndarray:
+        """Prior variance per basis column [s^2], shape (k,); jit-pure,
+        reading basis metadata from ``p["const"]``."""
         raise NotImplementedError
 
-    def basis_width(self, batch) -> int:
-        """Static column count of this component's basis (host-side)."""
-        raise NotImplementedError
+    @property
+    def basis_pytree_name(self) -> str:
+        return f"__noisebasis_{type(self).__name__}__"
 
 
 class ScaleToaError(NoiseComponent):
@@ -133,3 +152,202 @@ class ScaleToaError(NoiseComponent):
                 continue
             scale = scale * (1.0 + m * (pv(p, par.name) - 1.0))
         return scale * jnp.sqrt(var)
+
+
+def ecorr_epochs(t_sec: np.ndarray, dt: float = 1.0,
+                 nmin: int = 2) -> List[np.ndarray]:
+    """Group TOAs into observing epochs: sorted times bucketed within
+    ``dt`` seconds, keeping only buckets of >= nmin TOAs (reference
+    `get_ecorr_epochs`, `/root/reference/src/pint/models/noise_model.py:1196`)."""
+    if len(t_sec) == 0:
+        return []
+    isort = np.argsort(t_sec)
+    ref = t_sec[isort[0]]
+    buckets = [[isort[0]]]
+    for i in isort[1:]:
+        if t_sec[i] - ref < dt:
+            buckets[-1].append(i)
+        else:
+            ref = t_sec[i]
+            buckets.append([i])
+    return [np.array(b) for b in buckets if len(b) >= nmin]
+
+
+class EcorrNoise(NoiseComponent):
+    """Epoch-correlated white noise (jitter): rank-k block basis over
+    observing epochs, weight ECORR^2 per epoch (reference `EcorrNoise`,
+    `/root/reference/src/pint/models/noise_model.py:367`)."""
+
+    register = True
+    category = "ecorr_noise"
+    introduces_correlated_errors = True
+
+    def __init__(self):
+        super().__init__()
+        self._basis_cache: Tuple = ()
+
+    def mask_families(self) -> List[str]:
+        return ["ECORR", "TNECORR"]
+
+    def make_param(self, name: str):
+        name = {"TNECORR": "ECORR"}.get(name, name)
+        if name == "ECORR":
+            stem, index = "ECORR", 1 + max(
+                [q.index or 0 for q in self.prefix_params("ECORR")],
+                default=0)
+        else:
+            try:
+                stem, index = split_prefix(name)
+            except ValueError:
+                return None
+        if stem in ("ECORR", "TNECORR"):
+            return MaskParam("ECORR", index=index, units="us",
+                            description="epoch-correlated error")
+        return None
+
+    def ecorr_params(self) -> List[MaskParam]:
+        """All ECORR mask params with a nonzero value (a zero ECORR would
+        put a zero prior variance — an infinite phiinv — in the GLS
+        solve, so those columns are simply not built)."""
+        return [q for q in self.prefix_params("ECORR")
+                if q.value is not None and q.value != 0.0]
+
+    @property
+    def colmap_pytree_name(self) -> str:
+        return f"__noisecolmap_{type(self).__name__}__"
+
+    def basis_entries(self, toas) -> dict:
+        """Quantization matrix + a column->ECORR-parameter index map
+        (reference `get_noise_basis`, `noise_model.py:430`).  Cached on
+        TDB content — TOAs objects are mutated in place by e.g.
+        `zero_residuals`."""
+        t = np.asarray(toas.tdb.mjd_float) * SECS_PER_DAY
+        params = self.ecorr_params()
+        key = (toas.ntoas, hash(t.tobytes()),
+               tuple((q.name, q.key, tuple(q.key_value)) for q in params))
+        if self._basis_cache and self._basis_cache[0] == key:
+            return self._basis_cache[1]
+        cols = []
+        col_idx = []
+        n = toas.ntoas
+        for j, par in enumerate(params):
+            mask = par.select_mask(toas)
+            idx = np.flatnonzero(mask)
+            for epoch in ecorr_epochs(t[idx]):
+                c = np.zeros(n)
+                c[idx[epoch]] = 1.0
+                cols.append(c)
+                col_idx.append(j)
+        U = np.stack(cols, axis=1) if cols else np.zeros((n, 0))
+        out = {self.basis_pytree_name: U,
+               self.colmap_pytree_name: np.asarray(col_idx, np.int32)}
+        self._basis_cache = (key, out)
+        return out
+
+    def noise_weights(self, p: dict) -> jnp.ndarray:
+        col_idx = p["const"].get(self.colmap_pytree_name)
+        if col_idx is None or len(col_idx) == 0:
+            return jnp.zeros(0)
+        vals = jnp.stack([pv(p, q.name) for q in self.ecorr_params()])
+        return (jnp.take(vals, jnp.asarray(col_idx)) * 1e-6) ** 2
+
+
+def powerlaw_psd(f, amp, gamma):
+    """Power-law PSD in timing-residual units (reference `powerlaw`,
+    `/root/reference/src/pint/models/noise_model.py:1370`):
+    P(f) = A^2/(12 pi^2) fyr^(gamma-3) f^(-gamma)."""
+    return amp**2 / (12.0 * math.pi**2) * FYR ** (gamma - 3.0) \
+        * f ** (-gamma)
+
+
+class PLRedNoise(NoiseComponent):
+    """Power-law achromatic red noise via a Fourier basis (reference
+    `PLRedNoise`, `/root/reference/src/pint/models/noise_model.py:1004`;
+    Lentati et al. 2014 / van Haasteren & Vallisneri 2014).
+
+    Basis: alternating sin/cos columns at f_k = k/Tspan, k = 1..TNREDC
+    (host-built, static); weights: P(f_k) * df, differentiable in
+    TNREDAMP/TNREDGAM (or tempo RNAMP/RNIDX)."""
+
+    register = True
+    category = "pl_red_noise"
+    introduces_correlated_errors = True
+    is_time_correlated = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(FloatParam("TNREDAMP", units="",
+                                  description="log10 red-noise amplitude"))
+        self.add_param(FloatParam("TNREDGAM", units="",
+                                  description="red-noise spectral index"))
+        self.add_param(IntParam("TNREDC", value=30, units="",
+                                description="number of Fourier modes"))
+        self.add_param(FloatParam("RNAMP", units="",
+                                  description="tempo-format red amplitude"))
+        self.add_param(FloatParam("RNIDX", units="",
+                                  description="tempo-format red index"))
+        self.add_param(FloatParam("TNREDTSPAN", units="yr",
+                                  description="fundamental-period override"))
+        self._basis_cache: Tuple = ()
+
+    def validate(self):
+        has_tn = self.TNREDAMP.value is not None and \
+            self.TNREDGAM.value is not None
+        has_rn = self.RNAMP.value is not None and self.RNIDX.value is not None
+        if not (has_tn or has_rn):
+            from pint_tpu.exceptions import MissingParameter
+
+            raise MissingParameter(
+                "PLRedNoise needs TNREDAMP+TNREDGAM or RNAMP+RNIDX")
+
+    def nmodes(self) -> int:
+        return int(self.TNREDC.value) if self.TNREDC.value is not None else 30
+
+    def amp_gamma(self, p: dict):
+        """(amplitude, gamma) on device; RNAMP/RNIDX use the tempo
+        conversion (reference `get_plc_vals`, `noise_model.py:1130-1135`)."""
+        if self.TNREDAMP.value is not None:
+            return 10.0 ** pv(p, "TNREDAMP"), pv(p, "TNREDGAM")
+        fac = (86400.0 * 365.24 * 1e6) / (2.0 * math.pi * math.sqrt(3.0))
+        return pv(p, "RNAMP") / fac, -pv(p, "RNIDX")
+
+    def _freqs(self, toas) -> np.ndarray:
+        t = np.asarray(toas.tdb.mjd_float) * SECS_PER_DAY
+        if self.TNREDTSPAN.value is not None:
+            T = self.TNREDTSPAN.value * 365.25 * SECS_PER_DAY
+        else:
+            T = t.max() - t.min()
+        return np.arange(1, self.nmodes() + 1) / T
+
+    @property
+    def freqs_pytree_name(self) -> str:
+        return f"__noisefreqs_{type(self).__name__}__"
+
+    def basis_entries(self, toas) -> dict:
+        """Fourier design matrix (sin/cos alternating, reference
+        `create_fourier_design_matrix`, `noise_model.py:1339`) plus its
+        frequencies — shipped together so a pdict snapshot stays
+        self-consistent.  Cached on TDB content (TOAs objects are mutated
+        in place)."""
+        t = np.asarray(toas.tdb.mjd_float) * SECS_PER_DAY
+        key = (toas.ntoas, hash(t.tobytes()), self.nmodes(),
+               self.TNREDTSPAN.value)
+        if self._basis_cache and self._basis_cache[0] == key:
+            return self._basis_cache[1]
+        f = self._freqs(toas)
+        F = np.zeros((toas.ntoas, 2 * len(f)))
+        F[:, 0::2] = np.sin(2.0 * math.pi * t[:, None] * f)
+        F[:, 1::2] = np.cos(2.0 * math.pi * t[:, None] * f)
+        out = {self.basis_pytree_name: F, self.freqs_pytree_name: f}
+        self._basis_cache = (key, out)
+        return out
+
+    def noise_weights(self, p: dict) -> jnp.ndarray:
+        f = p["const"].get(self.freqs_pytree_name)
+        if f is None:
+            return jnp.zeros(0)
+        f = jnp.asarray(f)  # may be traced (it is pytree data)
+        amp, gam = self.amp_gamma(p)
+        df = jnp.diff(jnp.concatenate([jnp.zeros(1), f]))
+        psd = powerlaw_psd(jnp.repeat(f, 2), amp, gam)
+        return psd * jnp.repeat(df, 2)

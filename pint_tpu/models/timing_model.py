@@ -459,6 +459,8 @@ class TimingModel:
                     delta[par.name] = np.zeros_like(np.asarray(dv, np.float64))
             if toas is not None:
                 mask.update(c.mask_entries(toas))
+                if getattr(c, "introduces_correlated_errors", False):
+                    const.update(c.basis_entries(toas))
             if tzr_toas is not None:
                 tzr_mask.update(c.mask_entries(tzr_toas))
         p = {"const": const, "delta": delta, "mask": mask}
@@ -536,6 +538,31 @@ class TimingModel:
         for c in self.noise_components:
             sigma = c.scaled_sigma_us(p, batch, sigma)
         return sigma
+
+    @property
+    def correlated_noise_components(self):
+        return [c for c in self.noise_components
+                if c.introduces_correlated_errors]
+
+    def noise_basis(self, p: dict):
+        """(ntoas, K) concatenated noise basis (reference
+        ``noise_model_designmatrix``,
+        `/root/reference/src/pint/models/timing_model.py:1844`); None when
+        no correlated components.  The per-component blocks ride in
+        ``p["const"]`` (host-built by ``build_pdict``)."""
+        mats = [p["const"][c.basis_pytree_name]
+                for c in self.correlated_noise_components
+                if c.basis_pytree_name in p["const"]]
+        return jnp.concatenate([jnp.asarray(m) for m in mats], axis=1) \
+            if mats else None
+
+    def noise_weights(self, p: dict):
+        """(K,) prior variances [s^2] matching ``noise_basis`` columns
+        (reference ``noise_model_basis_weight``, ibid:1922); jit-pure and
+        differentiable in the noise parameters."""
+        ws = [c.noise_weights(p) for c in self.correlated_noise_components
+              if c.basis_pytree_name in p["const"]]
+        return jnp.concatenate(ws) if ws else None
 
     # -- physics ----------------------------------------------------------
     @property

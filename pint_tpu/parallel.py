@@ -180,8 +180,9 @@ def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
             raise ValueError(f"grid parameter {n} must be frozen")
     names = [n for n in fitter.fit_params if n not in grid_values]
     batch = pad_batch(r.batch, mesh.devices.shape[1])
-    p = model.build_pdict(fitter.toas,
-                          tzr_toas=model.make_tzr_toas_or_none())
+    # reuse the fitter's pdict snapshot (same parameter state the
+    # single-device grid path uses); only the masks need padding
+    p = r.pdict
     npad = batch.ntoas - r.batch.ntoas
     if npad:
         p = dict(p)

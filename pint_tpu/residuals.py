@@ -146,11 +146,27 @@ class Residuals:
         mean = np.sum(r * w) / np.sum(w)
         return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))
 
+    def _gaussian_quadratic(self, r):
+        """(r^T C^-1 r, logdet C) under the full noise model: white
+        diagonal, or Woodbury over the noise basis when correlated
+        components are present (reference `calc_chi2` dispatch,
+        `/root/reference/src/pint/residuals.py:646-748`)."""
+        sigma_s = np.asarray(self.get_data_error(), np.float64) * 1e-6
+        if self.model.has_correlated_errors:
+            from pint_tpu.utils import woodbury_dot
+
+            U = np.asarray(self.model.noise_basis(self.pdict), np.float64)
+            phi = np.asarray(self.model.noise_weights(self.pdict),
+                             np.float64)
+            keep = phi > 0  # zero prior variance = column not present
+            return woodbury_dot(sigma_s**2, U[:, keep], phi[keep], r, r)
+        return (np.sum((r / sigma_s) ** 2),
+                2.0 * np.sum(np.log(sigma_s)))
+
     def calc_chi2(self) -> float:
-        """Weighted chi2 against the scaled TOA uncertainties (white-noise
-        path; correlated-noise chi2 arrives with the GLS layer)."""
-        sigma_s = self.get_data_error() * 1e-6
-        return float(np.sum((self.time_resids / sigma_s) ** 2))
+        """Weighted chi2 (Woodbury form when correlated noise present)."""
+        dot, _ = self._gaussian_quadratic(self.time_resids)
+        return float(dot)
 
     def get_data_error(self) -> np.ndarray:
         """Scaled uncertainties [us] (EFAC/EQUAD once noise models exist)."""
@@ -158,6 +174,14 @@ class Residuals:
         if scaled is not None:
             return np.asarray(scaled(self.pdict, self.batch))
         return self.toas.error_us
+
+    def lnlikelihood(self) -> float:
+        """Gaussian log-likelihood of the residuals under the full noise
+        model, -(chi2 + logdet C + N ln 2pi)/2 (reference `lnlikelihood`,
+        `/root/reference/src/pint/residuals.py:792`)."""
+        r = self.time_resids
+        dot, logdet = self._gaussian_quadratic(r)
+        return float(-0.5 * (dot + logdet + len(r) * np.log(2.0 * np.pi)))
 
     @property
     def dof(self) -> int:
