@@ -144,6 +144,12 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
         r, M, sigma = assemble(x, p)
         U = model.noise_basis(p)
         phi = model.noise_weights(p)
+        if phi is not None:
+            # zero prior variance (e.g. a disabled red-noise amplitude)
+            # would make phiinv infinite; floor it so those columns are
+            # pinned to ~zero amplitude instead of poisoning the solve
+            # (1e-30 keeps 1/phi inside TPU's emulated-f64 range)
+            phi = jnp.where(phi > 0.0, phi, 1e-30)
         ntm = M.shape[1]
         Mfull = M if U is None else jnp.concatenate([M, U], axis=1)
         Mw = Mfull / sigma[:, None]

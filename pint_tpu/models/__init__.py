@@ -34,6 +34,7 @@ from pint_tpu.models.timing_model import (  # noqa: F401
 from pint_tpu.models import (  # noqa: F401  isort:skip
     absolute_phase,
     astrometry,
+    binary_dd,
     binary_ell1,
     dispersion,
     jump,

@@ -28,7 +28,6 @@ from __future__ import annotations
 import math
 from typing import List
 
-import jax
 import jax.numpy as jnp
 
 from pint_tpu import Tsun
@@ -42,7 +41,6 @@ from pint_tpu.models.parameter import (
 from pint_tpu.models.timing_model import DelayComponent, pv
 from pint_tpu.models.spindown import dt_seconds_qs
 from pint_tpu.toabatch import TOABatch
-from pint_tpu.utils import taylor_horner, taylor_horner_deriv
 
 SECS_PER_DAY = 86400.0
 SECS_PER_YEAR = 365.25 * SECS_PER_DAY
@@ -164,16 +162,9 @@ class BinaryELL1Base(DelayComponent):
 
     def _orbits_and_freq(self, p: dict, dt):
         """(orbit count, orbital frequency [1/s]) at dt = t - TASC."""
-        fbs = self.fb_names()
-        if fbs:
-            coeffs = [jnp.float64(0.0)] + [pv(p, n) for n in fbs]
-            return taylor_horner(dt, coeffs), \
-                taylor_horner_deriv(dt, coeffs, 1)
-        pb = pv(p, "PB")
-        pbdot = pv(p, "PBDOT")
-        phase = dt / pb - 0.5 * pbdot * (dt / pb) ** 2
-        freq = (1.0 - pbdot * (dt / pb)) / pb
-        return phase, freq
+        from pint_tpu.models.binary_orbits import orbits_and_freq
+
+        return orbits_and_freq(p, dt, self.fb_names())
 
     def _eps(self, p: dict, dt):
         """(eps1(t), eps2(t))."""

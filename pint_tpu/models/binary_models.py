@@ -14,6 +14,10 @@ BINARY_COMPONENTS = {
     "ELL1": "BinaryELL1",
     "ELL1H": "BinaryELL1H",
     "ELL1K": "BinaryELL1k",
+    "BT": "BinaryBT",
+    "DD": "BinaryDD",
+    "DDS": "BinaryDDS",
+    "DDH": "BinaryDDH",
 }
 
 

@@ -306,7 +306,8 @@ class PLRedNoise(NoiseComponent):
     def amp_gamma(self, p: dict):
         """(amplitude, gamma) on device; RNAMP/RNIDX use the tempo
         conversion (reference `get_plc_vals`, `noise_model.py:1130-1135`)."""
-        if self.TNREDAMP.value is not None:
+        if self.TNREDAMP.value is not None and \
+                self.TNREDGAM.value is not None:
             return 10.0 ** pv(p, "TNREDAMP"), pv(p, "TNREDGAM")
         fac = (86400.0 * 365.24 * 1e6) / (2.0 * math.pi * math.sqrt(3.0))
         return pv(p, "RNAMP") / fac, -pv(p, "RNIDX")
