@@ -37,7 +37,8 @@ from pint_tpu.toabatch import TOABatch
 from pint_tpu.utils import normalize_designmatrix, woodbury_dot
 
 __all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
-           "DownhillGLSFitter", "fit_wls_svd", "build_wls_step",
+           "DownhillGLSFitter", "WidebandTOAFitter",
+           "WidebandDownhillFitter", "fit_wls_svd", "build_wls_step",
            "build_gls_step"]
 
 
@@ -95,9 +96,11 @@ def build_resid_sec_fn(model: TimingModel, batch: TOABatch,
 def build_whitened_assembly(model: TimingModel, batch: TOABatch,
                             fit_params: Sequence[str], track_mode: str,
                             include_offset: bool):
-    """``(x, p) -> (r, M, sigma)``: residuals [s], design matrix (offset
-    column appended unless the model carries PHOFF) and scaled per-TOA
-    uncertainties [s] — the assembly shared by the WLS and GLS steps."""
+    """``(x, p) -> (r, M, sigma, offc)``: residuals [s], design matrix
+    (offset column appended unless the model carries PHOFF), scaled per-TOA
+    uncertainties [s], and the offset regressor column (None when the
+    offset is not profiled) — the assembly shared by the WLS and GLS
+    steps."""
     resid_sec = build_resid_sec_fn(model, batch, list(fit_params),
                                    track_mode)
 
@@ -105,10 +108,57 @@ def build_whitened_assembly(model: TimingModel, batch: TOABatch,
         r = resid_sec(x, p)
         J = jax.jacfwd(resid_sec)(x, p)
         M = -J
+        offc = None
         if include_offset:
-            M = jnp.concatenate([M, -jnp.ones((M.shape[0], 1))], axis=1)
+            offc = jnp.ones(M.shape[0])
+            M = jnp.concatenate([M, -offc[:, None]], axis=1)
         sigma = model.scaled_toa_uncertainty(p, batch) * 1e-6
-        return r, M, sigma
+        return r, M, sigma, offc
+
+    return assemble
+
+
+def build_wideband_assembly(model: TimingModel, batch: TOABatch,
+                            dm_index, dm_data, dm_error,
+                            fit_params: Sequence[str], track_mode: str,
+                            include_offset: bool):
+    """The wideband ``(x, p) -> (r, M, sigma, offc)`` assembly (reference
+    `WidebandTOAFitter.get_designmatrix` / `pint_matrix.combine_design_matrices_by_quantity`,
+    `/root/reference/src/pint/fitter.py:1975`, `pint_matrix.py:532`).
+
+    Rows are ``[TOA residuals [s] ; DM residuals [pc cm^-3]]``; the design
+    matrix is one `jax.jacfwd` of the stacked residual function, so the DM
+    block automatically picks up every parameter with a ``dm_value``
+    dependence (DM/DMX/DMJUMP) and the TOA block every delay/phase
+    dependence.  The mixed units cancel in the whitened solve.  The phase
+    offset regressor covers only the TOA rows."""
+    from pint_tpu.residuals import scaled_dm_sigma_rows
+
+    names = list(fit_params)
+    resid_sec = build_resid_sec_fn(model, batch, names, track_mode)
+    idx = jnp.asarray(np.asarray(dm_index), dtype=jnp.int64)
+    dmv = jnp.asarray(np.asarray(dm_data, np.float64))
+    dme = jnp.asarray(np.asarray(dm_error, np.float64))
+    nt = batch.ntoas
+
+    def combined(x, p):
+        p2 = model.with_x(p, x, names)
+        r_t = resid_sec(x, p)
+        # measured - model (reference residuals.py:1077)
+        r_dm = dmv - model.total_dm(p2, batch)[idx]
+        return jnp.concatenate([r_t, r_dm])
+
+    def assemble(x, p):
+        r = combined(x, p)
+        M = -jax.jacfwd(combined)(x, p)
+        offc = None
+        if include_offset:
+            offc = jnp.concatenate(
+                [jnp.ones(nt), jnp.zeros(idx.shape[0])])
+            M = jnp.concatenate([M, -offc[:, None]], axis=1)
+        sigma_t = model.scaled_toa_uncertainty(p, batch) * 1e-6
+        sigma_dm = scaled_dm_sigma_rows(model, p, batch, idx, dme)
+        return r, M, jnp.concatenate([sigma_t, sigma_dm]), offc
 
     return assemble
 
@@ -116,7 +166,7 @@ def build_whitened_assembly(model: TimingModel, batch: TOABatch,
 def build_gls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
-                   include_offset: bool = True):
+                   include_offset: bool = True, assemble=None):
     """The jitted GLS Gauss-Newton step ``(x, p) -> dict`` (reference
     `GLSFitter.fit_toas` basis path + `get_gls_mtcm_mtcy`,
     `/root/reference/src/pint/fitter.py:1841,2618`).
@@ -136,14 +186,22 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
     """
     names = list(fit_params)
     npar = len(names)
-    assemble = build_whitened_assembly(model, batch, names, track_mode,
-                                       include_offset)
+    if assemble is None:
+        assemble = build_whitened_assembly(model, batch, names, track_mode,
+                                           include_offset)
 
     @jax.jit
     def step(x, p):
-        r, M, sigma = assemble(x, p)
+        r, M, sigma, offc = assemble(x, p)
         U = model.noise_basis(p)
         phi = model.noise_weights(p)
+        if U is not None and U.shape[0] != r.shape[0]:
+            # wideband: the noise basis covers only the TOA rows; the DM
+            # block is uncorrelated (reference pint_matrix.py:532 pads the
+            # same way when combining design matrices)
+            U = jnp.concatenate(
+                [U, jnp.zeros((r.shape[0] - U.shape[0], U.shape[1]))],
+                axis=0)
         if phi is not None:
             # zero prior variance (e.g. a disabled red-noise amplitude)
             # would make phiinv infinite; floor it so those columns are
@@ -174,20 +232,22 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
         y = V @ (einv * (V.T @ (Mn.T @ rw)))
         sol = y / norms
         Sigma_n = (V * einv) @ V.T
-        # chi2 at x, offset profiled out in the C^-1 metric
+        # chi2 at x, offset profiled out in the C^-1 metric (over the
+        # offc regressor — ones on TOA rows, zeros on wideband DM rows)
+        off = jnp.float64(0.0)
         if phi is None:
-            w = 1.0 / sigma**2
-            off = jnp.sum(r * w) / jnp.sum(w) if include_offset else 0.0
-            chi2 = jnp.sum(((r - off) / sigma) ** 2)
+            if offc is not None:
+                w = offc / sigma**2
+                off = jnp.sum(r * w) / jnp.sum(w * offc)
+            chi2 = jnp.sum(((r - off * offc if offc is not None else r)
+                            / sigma) ** 2)
         else:
-            ones = jnp.ones_like(r)
-            if include_offset:
-                d11, _ = woodbury_dot(sigma**2, U, phi, ones, ones)
-                d1r, _ = woodbury_dot(sigma**2, U, phi, ones, r)
+            if offc is not None:
+                d11, _ = woodbury_dot(sigma**2, U, phi, offc, offc)
+                d1r, _ = woodbury_dot(sigma**2, U, phi, offc, r)
                 off = d1r / d11
-            else:
-                off = 0.0
-            chi2, _ = woodbury_dot(sigma**2, U, phi, r - off, r - off)
+            r_off = r - off * offc if offc is not None else r
+            chi2, _ = woodbury_dot(sigma**2, U, phi, r_off, r_off)
         return {"dx": sol[:npar], "offset": off, "chi2": chi2,
                 "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
                 "noise_ampls": sol[ntm:], "resid_sec": r,
@@ -199,7 +259,7 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
 def build_wls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
-                   include_offset: bool = True):
+                   include_offset: bool = True, assemble=None):
     """The jitted Gauss-Newton step ``(x, p) -> dict`` for a frozen model
     structure.
 
@@ -214,21 +274,25 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
     `/root/reference/src/pint/models/timing_model.py:2326`).
     """
     names = list(fit_params)
-    assemble = build_whitened_assembly(model, batch, names, track_mode,
-                                       include_offset)
+    if assemble is None:
+        assemble = build_whitened_assembly(model, batch, names, track_mode,
+                                           include_offset)
 
     @jax.jit
     def step(x, p):
-        r, M, sigma = assemble(x, p)
+        r, M, sigma, offc = assemble(x, p)
         dpars, Sigma_n, norms, n_bad = fit_wls_svd(M, r, sigma, threshold)
-        # chi2 at x with the offset profiled out (the linear best fit of a
-        # pure offset to the current residuals)
-        if include_offset:
-            w = 1.0 / sigma**2
-            off = jnp.sum(r * w) / jnp.sum(w)
+        # chi2 at x with the offset profiled out (the linear best fit of
+        # the offc regressor — ones on TOA rows, zeros on wideband DM rows
+        # — to the current residuals)
+        if offc is not None:
+            w = offc / sigma**2
+            off = jnp.sum(r * w) / jnp.sum(w * offc)
+            r_off = r - off * offc
         else:
-            off = 0.0
-        chi2 = jnp.sum(((r - off) / sigma) ** 2)
+            off = jnp.float64(0.0)
+            r_off = r
+        chi2 = jnp.sum((r_off / sigma) ** 2)
         npar = len(names)
         return {"dx": dpars[:npar], "offset": off, "chi2": chi2,
                 "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
@@ -499,3 +563,53 @@ class DownhillGLSFitter(DownhillWLSFitter, GLSFitter):
     """Downhill line search over the GLS step (reference
     `DownhillGLSFitter`, `/root/reference/src/pint/fitter.py:1386`):
     fit_toas from the downhill base, _make_step from GLSFitter."""
+
+
+class WidebandTOAFitter(GLSFitter):
+    """Wideband fitter: simultaneous TOA + DM least squares (reference
+    `WidebandTOAFitter`, `/root/reference/src/pint/fitter.py:1975`).
+
+    The data vector stacks time residuals [s] and DM residuals [pc cm^-3]
+    (the TOAs' ``-pp_dm``/``-pp_dme`` flags); one `jax.jacfwd` of the
+    stacked residual function yields the combined design matrix, replacing
+    the reference's `pint_matrix` block assembly (`pint_matrix.py:532`).
+    GLS-based, so correlated noise (ECORR/red) on the TOA block is handled;
+    without correlated components it reduces to wideband WLS.
+    """
+
+    def __init__(self, toas, model: TimingModel,
+                 track_mode: Optional[str] = None):
+        from pint_tpu.residuals import WidebandTOAResiduals
+
+        wb = WidebandTOAResiduals(toas, model, track_mode=track_mode)
+        super().__init__(toas, model, residuals=wb)
+
+    def _make_step(self, names, threshold, include_offset):
+        wb = self.resids
+        assemble = build_wideband_assembly(
+            self.model, wb.batch, wb.dm_index, wb.dm_data, wb.dm_error,
+            names, self.track_mode, include_offset)
+        return build_gls_step(self.model, wb.batch, names,
+                              self.track_mode, threshold=threshold,
+                              include_offset=include_offset,
+                              assemble=assemble)
+
+    def get_designmatrix(self):
+        """(M, names): the *combined* TOA+DM design matrix — TOA rows in
+        [s/unit], DM rows in [pc cm^-3/unit] (reference
+        `WidebandTOAFitter.get_designmatrix`,
+        `/root/reference/src/pint/fitter.py:2052`)."""
+        names = self.fit_params
+        wb = self.resids
+        assemble = build_wideband_assembly(
+            self.model, wb.batch, wb.dm_index, wb.dm_data, wb.dm_error,
+            names, self.track_mode, include_offset=False)
+        p = wb.pdict
+        x = self.model.x0(p, names)
+        _, M, _, _ = jax.jit(assemble)(x, p)
+        return np.asarray(M), names
+
+
+class WidebandDownhillFitter(DownhillWLSFitter, WidebandTOAFitter):
+    """Downhill line search over the wideband GLS step (reference
+    `WidebandDownhillFitter`, `/root/reference/src/pint/fitter.py:1558`)."""

@@ -19,6 +19,7 @@ import numpy as np
 from pint_tpu import DMconst
 from pint_tpu.models.parameter import (
     FloatParam,
+    MaskParam,
     MJDParam,
     prefixParameter,
     split_prefix,
@@ -158,3 +159,61 @@ class DispersionDMX(DelayComponent):
         finite = jnp.isfinite(batch.freq_mhz)
         f = jnp.where(finite, batch.freq_mhz, 1.0)
         return jnp.where(finite, DMconst * dm / f**2, 0.0)
+
+
+class DispersionJump(DelayComponent):
+    """System-dependent offsets to the *measured* wideband DM values
+    (DMJUMP mask parameters).
+
+    Reference: `DispersionJump`
+    (`/root/reference/src/pint/models/dispersion_model.py:727`): each
+    DMJUMP subtracts its value from the model DM over its TOA selection,
+    and contributes **zero** time delay — it models fiducial-DM offsets
+    between wideband receiving systems, not a physical delay.
+    """
+
+    register = True
+    category = "dispersion_jump"
+
+    def mask_families(self):
+        return ["DMJUMP"]
+
+    @property
+    def dm_jumps(self):
+        return [par for par in self.params.values()
+                if isinstance(par, MaskParam)]
+
+    def add_dmjump(self, index=None, key=None, key_value=(), value=0.0,
+                   frozen=True) -> MaskParam:
+        if index is None:
+            index = 1 + max([par.index or 0 for par in self.dm_jumps],
+                            default=0)
+        par = MaskParam("DMJUMP", index=index, key=key,
+                        key_value=key_value, value=value, frozen=frozen,
+                        units="pc cm^-3")
+        return self.add_param(par)
+
+    def make_param(self, name):
+        if name == "DMJUMP":
+            idx = 1 + max([par.index or 0 for par in self.dm_jumps],
+                          default=0)
+            return MaskParam("DMJUMP", index=idx, units="pc cm^-3")
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == "DMJUMP":
+            return MaskParam("DMJUMP", index=index, units="pc cm^-3")
+        return None
+
+    def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        total = jnp.zeros(batch.ntoas)
+        for par in self.dm_jumps:
+            m = p["mask"].get(par.mask_pytree_name)
+            if m is None:
+                continue
+            total = total - pv(p, par.name) * m
+        return total
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return jnp.zeros(batch.ntoas)

@@ -564,7 +564,31 @@ class TimingModel:
               if c.basis_pytree_name in p["const"]]
         return jnp.concatenate(ws) if ws else None
 
+    def scaled_dm_uncertainty(self, p: dict, batch: TOABatch, dm_error):
+        """Per-TOA wideband DM uncertainties [pc cm^-3] after DMEFAC/DMEQUAD
+        rescaling (reference ``scaled_dm_uncertainty``,
+        `/root/reference/src/pint/models/timing_model.py:1802`).  Jit-pure."""
+        sigma = dm_error
+        for c in self.noise_components:
+            f = getattr(c, "scaled_dm_sigma", None)
+            if f is not None:
+                sigma = f(p, batch, sigma)
+        return sigma
+
     # -- physics ----------------------------------------------------------
+    def total_dm(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        """Model DM at each TOA [pc cm^-3]: the sum over every component
+        exposing ``dm_value`` (reference ``TimingModel.total_dm``,
+        `/root/reference/src/pint/models/timing_model.py:1714`).  Jit-pure
+        and differentiable — the DM half of the wideband design matrix is
+        its jacfwd."""
+        dm = jnp.zeros(batch.ntoas)
+        for c in self.components.values():
+            f = getattr(c, "dm_value", None)
+            if f is not None:
+                dm = dm + f(p, batch)
+        return dm
+
     @property
     def calc(self) -> PhaseCalc:
         return PhaseCalc(self.delay_components, self.phase_components)

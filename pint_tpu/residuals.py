@@ -24,7 +24,8 @@ from pint_tpu import qs
 from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.toabatch import TOABatch
 
-__all__ = ["Residuals", "raw_phase_resids", "build_resid_fn"]
+__all__ = ["Residuals", "WidebandTOAResiduals", "raw_phase_resids",
+           "build_resid_fn"]
 
 
 def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
@@ -187,6 +188,114 @@ class Residuals:
     def dof(self) -> int:
         return self.toas.ntoas - len(self.model.free_params) - \
             int(self.subtract_mean)
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.calc_chi2() / self.dof
+
+
+def scaled_dm_sigma_rows(model: TimingModel, p: dict, batch: TOABatch,
+                         dm_index, dm_error) -> jnp.ndarray:
+    """DMEFAC/DMEQUAD-scaled DM uncertainties [pc cm^-3] on the wideband
+    rows: scatter the measured errors to full batch length (the noise
+    masks are per-TOA), scale, gather back.  Jit-pure; shared by the
+    residuals and the wideband fit assembly."""
+    idx = jnp.asarray(dm_index)
+    full = jnp.zeros(batch.ntoas).at[idx].set(jnp.asarray(dm_error))
+    return model.scaled_dm_uncertainty(p, batch, full)[idx]
+
+
+class WidebandTOAResiduals:
+    """Combined TOA + wideband-DM residuals (reference
+    `WidebandTOAResiduals` / `WidebandDMResiduals`,
+    `/root/reference/src/pint/residuals.py:1232,987`).
+
+    The TOA block is an ordinary :class:`Residuals`; the DM block is
+    ``measured - model`` over the TOAs carrying ``-pp_dm`` flags, with
+    DMEFAC/DMEQUAD-scaled uncertainties.  chi2 and dof are the sums of the
+    two blocks (reference `CombinedResiduals.chi2`,
+    `/root/reference/src/pint/residuals.py:1218`).
+    """
+
+    def __init__(self, toas, model: TimingModel,
+                 track_mode: Optional[str] = None):
+        dmdata = toas.get_dm_data()
+        if dmdata is None:
+            raise ValueError(
+                "wideband residuals need TOAs with -pp_dm/-pp_dme flags")
+        self.dm_index, self.dm_data, self.dm_error = dmdata
+        self.toa = Residuals(toas, model, track_mode=track_mode)
+        self.toas = toas
+        self.model = model
+
+    # the attributes fitters rely on delegate to the TOA block
+    @property
+    def batch(self):
+        return self.toa.batch
+
+    @property
+    def pdict(self):
+        return self.toa.pdict
+
+    @property
+    def track_mode(self):
+        return self.toa.track_mode
+
+    @property
+    def subtract_mean(self):
+        return self.toa.subtract_mean
+
+    def update(self):
+        self.toa.update()
+
+    # -- TOA block --------------------------------------------------------
+    @property
+    def time_resids(self) -> np.ndarray:
+        return self.toa.time_resids
+
+    def rms_weighted(self) -> float:
+        return self.toa.rms_weighted()
+
+    def get_data_error(self) -> np.ndarray:
+        return self.toa.get_data_error()
+
+    # -- DM block ---------------------------------------------------------
+    def calc_dm_resids(self) -> np.ndarray:
+        """measured DM - model DM [pc cm^-3] over the wideband TOAs
+        (reference `WidebandDMResiduals.calc_resids`,
+        `/root/reference/src/pint/residuals.py:1077`)."""
+        p = self.toa.pdict
+        model_dm = np.asarray(self.model.total_dm(p, self.toa.batch))
+        return self.dm_data - model_dm[self.dm_index]
+
+    @property
+    def dm_resids(self) -> np.ndarray:
+        return self.calc_dm_resids()
+
+    def get_dm_error(self) -> np.ndarray:
+        """DMEFAC/DMEQUAD-scaled DM uncertainties [pc cm^-3] on the
+        wideband rows."""
+        return np.asarray(scaled_dm_sigma_rows(
+            self.model, self.toa.pdict, self.toa.batch, self.dm_index,
+            self.dm_error))
+
+    def calc_dm_chi2(self) -> float:
+        return float(np.sum((self.calc_dm_resids() /
+                             self.get_dm_error()) ** 2))
+
+    # -- combined ---------------------------------------------------------
+    def calc_chi2(self) -> float:
+        return self.toa.calc_chi2() + self.calc_dm_chi2()
+
+    def lnlikelihood(self) -> float:
+        r, e = self.calc_dm_resids(), self.get_dm_error()
+        dm_ll = -0.5 * (np.sum((r / e) ** 2) + 2.0 * np.sum(np.log(e)) +
+                        len(e) * np.log(2.0 * np.pi))
+        return self.toa.lnlikelihood() + float(dm_ll)
+
+    @property
+    def dof(self) -> int:
+        return self.toa.dof + len(self.dm_data)
 
     @property
     def reduced_chi2(self) -> float:

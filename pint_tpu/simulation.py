@@ -21,7 +21,7 @@ from pint_tpu.residuals import build_resid_fn
 from pint_tpu.toa import TOAs, get_TOAs_array
 
 __all__ = ["zero_residuals", "make_fake_toas_uniform", "make_fake_toas_fromtim",
-           "update_fake_toa_errors"]
+           "update_fake_toa_errors", "add_wideband_dm_data"]
 
 
 def zero_residuals(toas: TOAs, model: TimingModel, maxiter: int = 10,
@@ -94,6 +94,24 @@ def make_fake_toas_fromtim(timfile, model: TimingModel,
         toas.utc = mjdmod.add_sec(toas.utc, noise)
         toas.compute_TDBs(ephem=toas.ephem)
         toas.compute_posvels(ephem=toas.ephem, planets=toas.planets)
+    return toas
+
+
+def add_wideband_dm_data(toas: TOAs, model: TimingModel,
+                         dm_error: float = 1e-4,
+                         add_noise: bool = False,
+                         seed: Optional[int] = None) -> TOAs:
+    """Attach simulated wideband DM measurements (``-pp_dm``/``-pp_dme``
+    flags) drawn from the model's ``total_dm`` (reference
+    `update_fake_dms`, `/root/reference/src/pint/simulation.py:125`)."""
+    rng = np.random.default_rng(seed)
+    p = model.build_pdict(toas, tzr_toas=model.make_tzr_toas_or_none())
+    dm = np.asarray(model.total_dm(p, toas.to_batch()))
+    if add_noise:
+        dm = dm + rng.standard_normal(toas.ntoas) * dm_error
+    for i, f in enumerate(toas.flags):
+        f["pp_dm"] = repr(float(dm[i]))
+        f["pp_dme"] = repr(float(dm_error))
     return toas
 
 

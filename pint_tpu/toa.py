@@ -360,6 +360,30 @@ class TOAs:
             return None
         return np.array([float(f.get("pn", np.nan)) for f in self.flags])
 
+    @property
+    def is_wideband(self) -> bool:
+        """True when any TOA carries a ``-pp_dm`` wideband DM measurement
+        (reference `TOAs.is_wideband`,
+        `/root/reference/src/pint/toa.py:1659`)."""
+        return any("pp_dm" in f for f in self.flags)
+
+    def get_dm_data(self):
+        """Wideband DM measurements: ``(index, dm, dm_error)`` — the TOA
+        row indices carrying ``-pp_dm``/``-pp_dme`` flags and their values
+        [pc cm^-3] — or None if no TOA has DM data (reference
+        `WidebandDMResiduals.get_dm_data`,
+        `/root/reference/src/pint/residuals.py:1114`)."""
+        idx = [i for i, f in enumerate(self.flags) if "pp_dm" in f]
+        if not idx:
+            return None
+        dm = np.array([float(self.flags[i]["pp_dm"]) for i in idx])
+        dme = np.array([float(self.flags[i].get("pp_dme", 0.0))
+                        for i in idx])
+        if np.any(dme <= 0.0):
+            raise ValueError(
+                "wideband TOAs need positive -pp_dme DM uncertainties")
+        return np.array(idx), dm, dme
+
     def get_flag_value(self, flag, fill_value=None, as_type=None):
         vals = []
         idx = []

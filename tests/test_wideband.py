@@ -1,0 +1,228 @@
+"""Wideband (TOA + DM measurement) tests.
+
+Mirrors the reference's wideband test strategy
+(`/root/reference/tests/test_wideband_dm_data.py`,
+`test_fitter_compare.py::test_wideband`): simulated TOAs carry
+``-pp_dm``/``-pp_dme`` DM measurements; the combined fitter must recover
+perturbed spin *and* DM-family parameters, DMJUMP must move only the DM
+block, and DMEFAC/DMEQUAD must rescale only the DM uncertainties.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import WidebandDownhillFitter, WidebandTOAFitter, WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals, WidebandTOAResiduals
+from pint_tpu.simulation import add_wideband_dm_data, make_fake_toas_uniform
+
+PAR = """
+PSR FAKEWB
+RAJ 07:40:45.79 1
+DECJ 66:20:33.5 1
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+DM1 3e-4 1
+DMEPOCH 55000
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def make_wb_dataset(par=PAR, ntoas=60, dm_error=2e-4, seed=3,
+                    add_noise=True):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(par.strip().splitlines())
+        toas = make_fake_toas_uniform(
+            54700, 55300, ntoas, model, obs="gbt", error_us=1.0,
+            freq_mhz=np.tile([1400.0, 800.0], (ntoas + 1) // 2)[:ntoas],
+            add_noise=add_noise, seed=seed)
+        toas = add_wideband_dm_data(toas, model, dm_error=dm_error,
+                                    add_noise=add_noise, seed=seed + 1)
+    return model, toas
+
+
+class TestWidebandResiduals:
+    def test_dm_data_extraction(self):
+        model, toas = make_wb_dataset()
+        idx, dm, dme = toas.get_dm_data()
+        assert toas.is_wideband
+        assert len(idx) == toas.ntoas
+        assert np.allclose(dm, 14.96, atol=0.5)
+        assert np.all(dme == 2e-4)
+
+    def test_unperturbed_resids_small(self):
+        model, toas = make_wb_dataset(add_noise=False)
+        wb = WidebandTOAResiduals(toas, model)
+        assert np.max(np.abs(wb.dm_resids)) < 1e-9
+        assert wb.calc_dm_chi2() < 1e-6
+        # combined chi2 = toa chi2 + dm chi2
+        assert wb.calc_chi2() == pytest.approx(
+            wb.toa.calc_chi2() + wb.calc_dm_chi2())
+        assert wb.dof == wb.toa.dof + toas.ntoas
+
+    def test_noise_chi2_reasonable(self):
+        model, toas = make_wb_dataset(add_noise=True)
+        wb = WidebandTOAResiduals(toas, model)
+        assert 0.5 < wb.calc_dm_chi2() / toas.ntoas < 2.0
+
+    def test_non_wideband_raises(self):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(PAR.strip().splitlines())
+            toas = make_fake_toas_uniform(54700, 55300, 10, model)
+        with pytest.raises(ValueError):
+            WidebandTOAResiduals(toas, model)
+
+
+class TestWidebandFitter:
+    def test_recover_spin_and_dm(self):
+        model, toas = make_wb_dataset()
+        true_dm = model.DM.value
+        true_f0 = model.F0.value
+        # perturb
+        model.DM.value = true_dm + 5e-3
+        model.F0.value = true_f0 + 1e-9
+        f = WidebandTOAFitter(toas, model)
+        f.fit_toas(maxiter=3)
+        assert abs(model.DM.value - true_dm) < 5 * model.DM.uncertainty
+        assert abs(model.F0.value - true_f0) < 5 * model.F0.uncertainty
+        assert f.resids.reduced_chi2 < 1.5
+        # the DM data constrain DM far better than timing alone: the
+        # wideband DM uncertainty should be ~dm_error/sqrt(N)-scale
+        assert model.DM.uncertainty < 2e-4
+
+    def test_dm_constraint_tighter_than_narrowband(self):
+        model, toas = make_wb_dataset()
+        f = WidebandTOAFitter(toas, model)
+        f.fit_toas(maxiter=3)
+        wb_unc = model.DM.uncertainty
+
+        model2, toas2 = make_wb_dataset()
+        for fl in toas2.flags:
+            fl.pop("pp_dm"), fl.pop("pp_dme")
+        f2 = WLSFitter(toas2, model2)
+        f2.fit_toas(maxiter=3)
+        assert wb_unc < f2.model.DM.uncertainty
+
+    def test_downhill_variant(self):
+        model, toas = make_wb_dataset()
+        model.DM.value = model.DM.value + 2e-3
+        f = WidebandDownhillFitter(toas, model)
+        chi2 = f.fit_toas(maxiter=10)
+        assert f.fitresult.converged
+        assert chi2 / f.resids.dof < 1.5
+
+
+class TestDMJump:
+    def test_dmjump_moves_only_dm_block(self):
+        model, toas = make_wb_dataset(add_noise=False)
+        # tag alternating receivers
+        for i, fl in enumerate(toas.flags):
+            fl["fe"] = "RcvrA" if i % 2 == 0 else "RcvrB"
+        from pint_tpu.models.dispersion import DispersionJump
+
+        dj = DispersionJump()
+        dj.add_dmjump(key="-fe", key_value=["RcvrB"], value=1e-2,
+                      frozen=False)
+        model.add_component(dj)
+        wb = WidebandTOAResiduals(toas, model)
+        # TOA residuals untouched (DMJUMP has zero delay)
+        assert np.max(np.abs(wb.toa.time_resids)) < 1e-7
+        r_dm = wb.dm_resids
+        # model DM -= DMJUMP on RcvrB rows => dm resid = +DMJUMP there
+        assert np.allclose(r_dm[1::2], 1e-2, atol=1e-9)
+        assert np.allclose(r_dm[0::2], 0.0, atol=1e-9)
+
+    def test_fit_recovers_dmjump(self):
+        model, toas = make_wb_dataset(add_noise=True)
+        for i, fl in enumerate(toas.flags):
+            fl["fe"] = "RcvrA" if i % 2 == 0 else "RcvrB"
+            if i % 2:  # inject a +3e-3 DM offset into RcvrB measurements
+                fl["pp_dm"] = repr(float(fl["pp_dm"]) + 3e-3)
+        from pint_tpu.models.dispersion import DispersionJump
+
+        dj = DispersionJump()
+        dj.add_dmjump(key="-fe", key_value=["RcvrB"], value=0.0,
+                      frozen=False)
+        model.add_component(dj)
+        f = WidebandTOAFitter(toas, model)
+        f.fit_toas(maxiter=3)
+        fitted = model.DMJUMP1.value
+        # model dm includes -DMJUMP; measurement got +3e-3, so the fit
+        # drives DMJUMP toward -3e-3
+        assert fitted == pytest.approx(-3e-3, abs=5e-4)
+
+    def test_dmjump_par_roundtrip(self):
+        par = PAR + "DMJUMP -fe RcvrB 0.003 1\n"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.strip().splitlines())
+        assert "DispersionJump" in model.components
+        assert model.DMJUMP1.value == pytest.approx(0.003)
+        assert not model.DMJUMP1.frozen
+        out = model.as_parfile()
+        assert "DMJUMP" in out and "RcvrB" in out
+
+
+class TestScaleDmError:
+    def test_dmefac_scales_dm_errors(self):
+        model, toas = make_wb_dataset(add_noise=False)
+        for fl in toas.flags:
+            fl["fe"] = "RcvrA"
+        from pint_tpu.models.noise_model import ScaleDmError
+
+        sde = ScaleDmError()
+        sde.add_noise_param("DMEFAC", key="-fe", key_value=["RcvrA"],
+                            value=2.0)
+        model.add_component(sde)
+        wb = WidebandTOAResiduals(toas, model)
+        assert np.allclose(wb.get_dm_error(), 2.0 * 2e-4)
+        # TOA errors unaffected
+        assert np.allclose(wb.get_data_error(), toas.error_us)
+
+    def test_dmequad_quadrature(self):
+        model, toas = make_wb_dataset(add_noise=False)
+        for fl in toas.flags:
+            fl["fe"] = "RcvrA"
+        from pint_tpu.models.noise_model import ScaleDmError
+
+        sde = ScaleDmError()
+        sde.add_noise_param("DMEQUAD", key="-fe", key_value=["RcvrA"],
+                            value=3e-4)
+        model.add_component(sde)
+        wb = WidebandTOAResiduals(toas, model)
+        expect = np.sqrt((2e-4) ** 2 + (3e-4) ** 2)
+        assert np.allclose(wb.get_dm_error(), expect)
+
+    def test_dmefac_par_roundtrip(self):
+        par = PAR + "DMEFAC -fe RcvrA 1.3\nDMEQUAD -fe RcvrA 0.0002\n"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.strip().splitlines())
+        assert "ScaleDmError" in model.components
+        assert model.DMEFAC1.value == pytest.approx(1.3)
+        assert model.DMEQUAD1.value == pytest.approx(2e-4)
+
+
+class TestWidebandWithCorrelatedNoise:
+    def test_gls_wideband_with_ecorr(self):
+        par = PAR + "ECORR -fe RcvrA 0.5\n"
+        model, toas = make_wb_dataset(par=par, ntoas=40)
+        for fl in toas.flags:
+            fl["fe"] = "RcvrA"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model2 = get_model(par.strip().splitlines())
+            f = WidebandTOAFitter(toas, model2)
+            f.fit_toas(maxiter=3)
+        assert np.isfinite(f.fitresult.chi2)
+        assert f.fitresult.chi2 / f.resids.dof < 2.0
