@@ -36,12 +36,19 @@ from pint_tpu.models import (  # noqa: F401  isort:skip
     astrometry,
     binary_dd,
     binary_ell1,
+    chromatic,
     dispersion,
+    frequency_dependent,
+    glitch,
+    ifunc,
     jump,
     noise_model,
     phase_offset,
+    piecewise,
     solar_system_shapiro,
+    solar_wind,
     spindown,
+    wave,
 )
 from pint_tpu.models.model_builder import (  # noqa: F401  isort:skip
     get_model,

@@ -1,0 +1,156 @@
+"""Chromatic (variable frequency-index) delays: CM polynomial and CMX.
+
+Reference: `ChromaticCM` / `ChromaticCMX`
+(`/root/reference/src/pint/models/chromatic_model.py:118,313`):
+
+    delay = DMconst * CM(t) * (f/MHz)^(-TNCHROMIDX)
+
+the generalization of dispersion (TNCHROMIDX=2 reproduces DM) used for
+scattering-like chromatic noise (typical index 4).  CM carries Taylor
+derivatives about CMEPOCH; CMX are piecewise-constant offsets over MJD
+ranges, formulated exactly like DMX (host-precomputed range masks, dense
+masked sum on device).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DMconst
+from pint_tpu.models.parameter import (
+    FloatParam,
+    MJDParam,
+    prefixParameter,
+    split_prefix,
+)
+from pint_tpu.models.timing_model import DelayComponent, pv
+from pint_tpu.toabatch import TOABatch
+from pint_tpu.utils import taylor_horner
+
+SECS_PER_YEAR = 365.25 * 86400.0
+
+
+def chromatic_delay(cm, alpha, freq_mhz):
+    """DMconst * cm * f^-alpha with infinite-frequency rows zeroed."""
+    finite = jnp.isfinite(freq_mhz)
+    f = jnp.where(finite, freq_mhz, 1.0)
+    return jnp.where(finite, DMconst * cm * f**(-alpha), 0.0)
+
+
+class ChromaticCM(DelayComponent):
+    """Chromatic-measure Taylor polynomial (CM, CM1, ... about CMEPOCH)."""
+
+    register = True
+    category = "chromatic_constant"
+
+    def __init__(self):
+        super().__init__()
+        cm = FloatParam("CM", value=0.0, units="pc cm^-3 MHz^(alpha-2)",
+                        description="Chromatic measure")
+        cm.prefix, cm.index = "CM", 0
+        self.add_param(cm)
+        self.add_param(FloatParam("TNCHROMIDX", value=4.0, units="",
+                                  description="Chromatic index alpha"))
+        self.add_param(MJDParam("CMEPOCH", description="CM reference epoch"))
+
+    def cm_names(self):
+        return [p.name for p in self.prefix_params("CM")]
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == "CM" and index >= 1:
+            return prefixParameter(
+                "float", name, units=f"pc cm^-3 MHz^(alpha-2) yr^-{index}",
+                par2dev=SECS_PER_YEAR ** -index)
+        return None
+
+    def validate(self):
+        if len(self.cm_names()) > 1 and self.CMEPOCH.value is None:
+            if self._parent is None or self._parent.PEPOCH.value is None:
+                raise ValueError("CMEPOCH required for CM derivatives")
+
+    def cm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        names = self.cm_names()
+        coeffs = [pv(p, n) for n in names]
+        if len(names) == 1:
+            return jnp.broadcast_to(coeffs[0], (batch.ntoas,))
+        ep = "CMEPOCH" if self.CMEPOCH.value is not None else "PEPOCH"
+        day0 = p["const"][ep][0] + p["const"][ep][1] + p["delta"].get(ep, 0.0)
+        dt_sec = (batch.tdb_day + batch.tdb_frac - day0) * 86400.0
+        return taylor_horner(dt_sec, coeffs)
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return chromatic_delay(self.cm_value(p, batch), pv(p, "TNCHROMIDX"),
+                               batch.freq_mhz)
+
+
+class ChromaticCMX(DelayComponent):
+    """Piecewise-constant CM offsets over MJD ranges (CMX_####/CMXR1/CMXR2)."""
+
+    register = True
+    category = "chromatic_cmx"
+
+    def add_cmx_range(self, index: int, r1_mjd, r2_mjd, value=0.0,
+                      frozen=True):
+        self.add_param(prefixParameter(
+            "float", f"CMX_{index:04d}", units="pc cm^-3 MHz^(alpha-2)",
+            value=value, frozen=frozen))
+        self.add_param(prefixParameter("mjd", f"CMXR1_{index:04d}",
+                                       value=r1_mjd))
+        self.add_param(prefixParameter("mjd", f"CMXR2_{index:04d}",
+                                       value=r2_mjd))
+
+    def cmx_names(self):
+        return [p.name for p in self.prefix_params("CMX_")]
+
+    def prefix_families(self):
+        return ["CMX_", "CMXR1_", "CMXR2_"]
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == "CMX_":
+            return prefixParameter("float", name,
+                                   units="pc cm^-3 MHz^(alpha-2)")
+        if prefix in ("CMXR1_", "CMXR2_"):
+            return prefixParameter("mjd", name)
+        return None
+
+    def validate(self):
+        if self.cmx_names() and (
+                self._parent is None or "TNCHROMIDX" not in self._parent):
+            raise ValueError(
+                "ChromaticCMX needs a ChromaticCM component (TNCHROMIDX)")
+        for n in self.cmx_names():
+            idx = n.split("_")[1]
+            if f"CMXR1_{idx}" not in self.params or \
+                    f"CMXR2_{idx}" not in self.params:
+                raise ValueError(f"{n} needs CMXR1_{idx} and CMXR2_{idx}")
+
+    def mask_entries(self, toas):
+        out = super().mask_entries(toas)
+        m = toas.utc.mjd_float
+        for n in self.cmx_names():
+            idx = n.split("_")[1]
+            r1 = self.params[f"CMXR1_{idx}"].mjd_float
+            r2 = self.params[f"CMXR2_{idx}"].mjd_float
+            out[f"{n}__rangemask"] = ((m >= r1) & (m <= r2)).astype(np.float64)
+        return out
+
+    def cm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        names = self.cmx_names()
+        if not names:
+            return jnp.zeros(batch.ntoas)
+        masks = jnp.stack([p["mask"][f"{n}__rangemask"] for n in names])
+        vals = jnp.stack([pv(p, n) for n in names])
+        return vals @ masks
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return chromatic_delay(self.cm_value(p, batch), pv(p, "TNCHROMIDX"),
+                               batch.freq_mhz)

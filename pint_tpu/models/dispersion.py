@@ -31,6 +31,14 @@ from pint_tpu.utils import taylor_horner
 SECS_PER_YEAR = 365.25 * 86400.0
 
 
+def dispersion_delay(dm, freq_mhz):
+    """K * dm / f^2 [s] with infinite-frequency (barycentered) rows zeroed
+    — the single cold-plasma mapping shared by every DM-type component."""
+    finite = jnp.isfinite(freq_mhz)
+    f = jnp.where(finite, freq_mhz, 1.0)
+    return jnp.where(finite, DMconst * dm / f**2, 0.0)
+
+
 class DispersionDM(DelayComponent):
     """Cold-plasma dispersion from a DM Taylor polynomial."""
 
@@ -84,10 +92,7 @@ class DispersionDM(DelayComponent):
         return taylor_horner(dt_sec, coeffs)
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
-        dm = self.dm_value(p, batch)
-        finite = jnp.isfinite(batch.freq_mhz)
-        f = jnp.where(finite, batch.freq_mhz, 1.0)
-        return jnp.where(finite, DMconst * dm / f**2, 0.0)
+        return dispersion_delay(self.dm_value(p, batch), batch.freq_mhz)
 
 
 class DispersionDMX(DelayComponent):
@@ -117,6 +122,9 @@ class DispersionDMX(DelayComponent):
 
     def dmx_names(self):
         return [p.name for p in self.prefix_params("DMX_")]
+
+    def prefix_families(self):
+        return ["DMX_", "DMXR1_", "DMXR2_"]
 
     def make_param(self, name):
         try:
@@ -155,10 +163,7 @@ class DispersionDMX(DelayComponent):
         return vals @ masks
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
-        dm = self.dm_value(p, batch)
-        finite = jnp.isfinite(batch.freq_mhz)
-        f = jnp.where(finite, batch.freq_mhz, 1.0)
-        return jnp.where(finite, DMconst * dm / f**2, 0.0)
+        return dispersion_delay(self.dm_value(p, batch), batch.freq_mhz)
 
 
 class DispersionJump(DelayComponent):

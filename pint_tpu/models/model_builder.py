@@ -81,6 +81,13 @@ class AllComponents:
         for cname, comp in self.components.items():
             for hook in getattr(comp, "mask_families", lambda: [])():
                 self.prefix_owner[hook].append(cname)
+        # declared prefix families whose members exist only on demand
+        # (DMX_/GLEP_/WXFREQ_...; the reference declares a first member in
+        # __init__ instead — here an explicit hook keeps prototypes empty)
+        for cname, comp in self.components.items():
+            for stem in getattr(comp, "prefix_families", lambda: [])():
+                if cname not in self.prefix_owner[stem]:
+                    self.prefix_owner[stem].append(cname)
 
     def resolve(self, name: str) -> Optional[Tuple[List[str], str]]:
         """par-file name -> (candidate components, canonical name), creating

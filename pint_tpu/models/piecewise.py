@@ -1,0 +1,114 @@
+"""PiecewiseSpindown: interval-local spin-parameter corrections.
+
+Reference: `PiecewiseSpindown` (`/root/reference/src/pint/models/piecewise.py:12`).
+Each group i has an epoch PWEP_i, a validity window [PWSTART_i, PWSTOP_i],
+and local corrections PWPH_i/PWF0_i/PWF1_i/PWF2_i; inside its window:
+
+    dphase = PWPH + dt*(PWF0 + dt/2*(PWF1 + dt/3*PWF2)),  dt = t - PWEP
+
+Window masks are host-precomputed {0,1} arrays (the DMX pattern), so the
+device side is a dense masked Taylor sum; everything is differentiable in
+the PW coefficients.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import qs
+from pint_tpu.models.parameter import prefixParameter, split_prefix
+from pint_tpu.models.timing_model import PhaseComponent, pv
+from pint_tpu.toabatch import TOABatch
+
+SECS_PER_DAY = 86400.0
+
+_PW_FAMILIES = {
+    "PWEP_": ("mjd", "d"),
+    "PWSTART_": ("mjd", "d"),
+    "PWSTOP_": ("mjd", "d"),
+    "PWPH_": ("float", "cycles"),
+    "PWF0_": ("float", "Hz"),
+    "PWF1_": ("float", "Hz/s"),
+    "PWF2_": ("float", "Hz/s^2"),
+}
+
+
+class PiecewiseSpindown(PhaseComponent):
+    register = True
+    category = "piecewise_spindown"
+
+    def group_indices(self) -> List[int]:
+        return sorted(p.index for p in self.prefix_params("PWEP_"))
+
+    def add_group(self, index: int, ep, start, stop, pwph=0.0, pwf0=0.0,
+                  pwf1=0.0, pwf2=0.0, frozen=True):
+        self.add_param(prefixParameter("mjd", f"PWEP_{index}", value=ep))
+        self.add_param(prefixParameter("mjd", f"PWSTART_{index}", value=start))
+        self.add_param(prefixParameter("mjd", f"PWSTOP_{index}", value=stop))
+        for stem, v in (("PWPH_", pwph), ("PWF0_", pwf0), ("PWF1_", pwf1),
+                        ("PWF2_", pwf2)):
+            kind, units = _PW_FAMILIES[stem]
+            self.add_param(prefixParameter(kind, f"{stem}{index}",
+                                           units=units, value=v,
+                                           frozen=frozen))
+        self.setup()
+
+    def prefix_families(self):
+        return list(_PW_FAMILIES)
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        fam = _PW_FAMILIES.get(prefix)
+        if fam is None:
+            return None
+        kind, units = fam
+        return prefixParameter(kind, name, units=units)
+
+    def setup(self):
+        for idx in self.group_indices():
+            for stem in ("PWPH_", "PWF0_", "PWF1_", "PWF2_"):
+                nm = f"{stem}{idx}"
+                if nm not in self.params:
+                    kind, units = _PW_FAMILIES[stem]
+                    self.add_param(prefixParameter(kind, nm, units=units,
+                                                   value=0.0))
+
+    def validate(self):
+        for idx in self.group_indices():
+            for stem in ("PWSTART_", "PWSTOP_"):
+                par = self.params.get(f"{stem}{idx}")
+                if par is None or par.value is None:
+                    raise ValueError(f"PWEP_{idx} needs {stem}{idx}")
+
+    def mask_entries(self, toas):
+        out = super().mask_entries(toas)
+        m = toas.utc.mjd_float
+        for idx in self.group_indices():
+            r1 = self.params[f"PWSTART_{idx}"].mjd_float
+            r2 = self.params[f"PWSTOP_{idx}"].mjd_float
+            out[f"PWEP_{idx}__rangemask"] = \
+                ((m >= r1) & (m <= r2)).astype(np.float64)
+        return out
+
+    def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
+        t = batch.tdb_day + batch.tdb_frac
+        total = jnp.zeros(batch.ntoas)
+        for idx in self.group_indices():
+            ep = f"PWEP_{idx}"
+            mask = p["mask"].get(f"{ep}__rangemask")
+            if mask is None:  # e.g. the 1-row TZR batch
+                continue
+            day0 = p["const"][ep][0] + p["const"][ep][1] \
+                + p["delta"].get(ep, 0.0)
+            dt = (t - day0) * SECS_PER_DAY - delay
+            dph = pv(p, f"PWPH_{idx}") + dt * (
+                pv(p, f"PWF0_{idx}") + dt / 2.0 * (
+                    pv(p, f"PWF1_{idx}") + dt / 3.0 * pv(p, f"PWF2_{idx}")))
+            total = total + mask * dph
+        return qs.from_f64_device(total)

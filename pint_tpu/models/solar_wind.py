@@ -1,0 +1,121 @@
+"""Solar-wind dispersion: NE_SW electron-density model.
+
+Reference: `SolarWindDispersion`
+(`/root/reference/src/pint/models/solar_wind_dispersion.py:272`), SWM=0 —
+the spherically-symmetric 1/r^2 model of Edwards et al. 2006 (eqs. 29-30):
+
+    DM_sw = n_e(1 AU) * AU^2 * rho / (r * sin(rho))      [pc cm^-3]
+
+with rho = pi - (Sun-pulsar elongation seen from the observatory) and r
+the observatory-Sun distance.  NE_SW may carry Taylor derivatives
+(NE_SW1, ... about SWEPOCH), as in the reference.  The SWM=1/SWP general
+power-law model (Hazboun et al. 2022) needs hypergeometric functions and
+is not supported — matching the reference's own SWM=0 default.
+
+The geometry is a pure function of the TOA batch (obs-Sun vector) and the
+astrometry component's pulsar direction, so the whole term is jit-pure and
+differentiable in both NE_SW and the pulsar position.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu import AU, c as C
+from pint_tpu.models.dispersion import dispersion_delay
+from pint_tpu.models.parameter import FloatParam, MJDParam, prefixParameter, split_prefix
+from pint_tpu.models.timing_model import DelayComponent, pv
+from pint_tpu.toabatch import TOABatch
+from pint_tpu.utils import taylor_horner
+
+SECS_PER_YEAR = 365.25 * 86400.0
+AU_LS = AU / C                      # 1 au in light-seconds
+PC_LS = 3.0856775814913673e16 / C   # 1 pc in light-seconds
+
+
+def solar_wind_geometry_pc(obs_sun_pos_ls: jnp.ndarray,
+                           psr_dir: jnp.ndarray) -> jnp.ndarray:
+    """AU^2 * rho / (r sin rho) in parsecs (Edwards et al. 2006 eq. 30;
+    reference `solar_wind_geometry`, `solar_wind_dispersion.py:370-398`)."""
+    r = jnp.linalg.norm(obs_sun_pos_ls, axis=1)
+    safe_r = jnp.where(r > 0.0, r, 1.0)
+    # elongation: angle at the observatory between Sun and pulsar
+    cos_elong = jnp.sum(obs_sun_pos_ls * psr_dir, axis=1) / safe_r
+    cos_elong = jnp.clip(cos_elong, -1.0, 1.0)
+    rho = jnp.pi - jnp.arccos(cos_elong)
+    sin_rho = jnp.sin(rho)
+    safe_sin = jnp.where(sin_rho > 1e-12, sin_rho, 1.0)
+    geom = AU_LS**2 * rho / (safe_r * safe_sin) / PC_LS
+    # barycentric rows (r == 0) carry no solar-wind delay
+    return jnp.where((r > 0.0) & (sin_rho > 1e-12), geom, 0.0)
+
+
+class SolarWindDispersion(DelayComponent):
+    """NE_SW solar-wind dispersion (SWM=0)."""
+
+    register = True
+    category = "solar_wind"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(FloatParam(
+            "NE_SW", value=0.0, units="cm^-3", aliases=["NE1AU", "SOLARN0"],
+            description="Solar wind electron density at 1 AU"))
+        self.add_param(FloatParam(
+            "SWM", value=0.0, units="",
+            description="Solar wind model (0 is the only supported mode)"))
+        self.add_param(MJDParam("SWEPOCH",
+                                description="NE_SW reference epoch"))
+
+    def ne_sw_names(self):
+        out = ["NE_SW"]
+        out += [p.name for p in self.prefix_params("NE_SW")
+                if p.name != "NE_SW"]
+        return out
+
+    def prefix_families(self):
+        return ["NE_SW"]
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == "NE_SW" and index >= 1:
+            return prefixParameter(
+                "float", name, units=f"cm^-3 / yr^{index}",
+                par2dev=SECS_PER_YEAR ** -index)
+        return None
+
+    def validate(self):
+        if self.SWM.value not in (None, 0.0):
+            raise ValueError(
+                f"SWM={self.SWM.value} is not supported (only SWM=0)")
+        if len(self.ne_sw_names()) > 1 and self.SWEPOCH.value is None:
+            if self._parent is None or self._parent.PEPOCH.value is None:
+                raise ValueError("SWEPOCH required for NE_SW derivatives")
+
+    def _astrometry(self):
+        for comp in self._parent.components.values():
+            if hasattr(comp, "psr_dir"):
+                return comp
+        raise AttributeError(
+            "SolarWindDispersion needs an astrometry component")
+
+    def ne_sw_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        names = self.ne_sw_names()
+        coeffs = [pv(p, n) for n in names]
+        if len(names) == 1:
+            return jnp.broadcast_to(coeffs[0], (batch.ntoas,))
+        ep = "SWEPOCH" if self.SWEPOCH.value is not None else "PEPOCH"
+        day0 = p["const"][ep][0] + p["const"][ep][1] + p["delta"].get(ep, 0.0)
+        dt_sec = (batch.tdb_day + batch.tdb_frac - day0) * 86400.0
+        return taylor_horner(dt_sec, coeffs)
+
+    def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        psr_dir = self._astrometry().psr_dir(p, batch)
+        geom = solar_wind_geometry_pc(batch.obs_sun_pos_ls, psr_dir)
+        return self.ne_sw_value(p, batch) * geom
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return dispersion_delay(self.dm_value(p, batch), batch.freq_mhz)

@@ -66,9 +66,13 @@ DEFAULT_ORDER = [
     "dispersion_constant",
     "dispersion_dmx",
     "dispersion_jump",
-    "chromatic",
+    "dmwavex",
+    "chromatic_constant",
+    "chromatic_cmx",
+    "cmwavex",
     "pulsar_system",
     "frequency_dependent",
+    "fdjump",
     "absolute_phase",
     "spindown",
     "glitch",

@@ -1,0 +1,240 @@
+"""Sinusoidal timing-noise models: Wave, WaveX, DMWaveX, CMWaveX.
+
+Reference:
+* `Wave` (`/root/reference/src/pint/models/wave.py:11`) — tempo-style
+  harmonically-related sinusoids: phase += F0 * sum_k [A_k sin(k w dt) +
+  B_k cos(k w dt)] with w = WAVE_OM [rad/day] about WAVEEPOCH.
+* `WaveX` (`/root/reference/src/pint/models/wavex.py:14`) — unevenly
+  spaced sinusoidal *delays*: delay += sum_i [WXSIN_i sin(2 pi f_i dt) +
+  WXCOS_i cos(2 pi f_i dt)], f_i = WXFREQ_000i [1/day] about WXEPOCH.
+* `DMWaveX` / `CMWaveX` (`dmwavex.py:15`, `cmwavex.py:15`) — the same
+  basis in DM [pc cm^-3] / CM space, entering through the dispersion /
+  chromatic delay scaling.
+
+All four are closed-form, jit-pure, and differentiable in every
+amplitude/frequency; dt uses f64 MJDs (sub-ns adequacy for delay-level
+terms, as everywhere outside the spin Taylor sum).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from pint_tpu import qs
+from pint_tpu.models.chromatic import chromatic_delay
+from pint_tpu.models.dispersion import dispersion_delay
+from pint_tpu.models.parameter import (
+    FloatParam,
+    MJDParam,
+    PairParam,
+    prefixParameter,
+    split_prefix,
+)
+from pint_tpu.models.timing_model import DelayComponent, PhaseComponent, pv
+from pint_tpu.toabatch import TOABatch
+
+SECS_PER_DAY = 86400.0
+
+
+def _epoch_days(p: dict, name: str) -> jnp.ndarray:
+    return p["const"][name][0] + p["const"][name][1] + \
+        p["delta"].get(name, 0.0)
+
+
+class Wave(PhaseComponent):
+    """Tempo WAVE sinusoids (pre-WaveX red-noise whitening)."""
+
+    register = True
+    category = "wave"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(FloatParam("WAVE_OM", units="rad/d", aliases=["WAVEOM"],
+                                  description="Wave fundamental frequency"))
+        self.add_param(MJDParam("WAVEEPOCH", description="Wave epoch"))
+
+    def wave_names(self) -> List[str]:
+        return [p.name for p in self.prefix_params("WAVE")
+                if p.name not in ("WAVE_OM",)]
+
+    def add_wave_component(self, index: int, a=0.0, b=0.0, frozen=True):
+        return self.add_param(prefixParameter(
+            "pair", f"WAVE{index}", units="s", value=(a, b), frozen=frozen))
+
+    def prefix_families(self):
+        return ["WAVE"]
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == "WAVE" and index >= 1:
+            return prefixParameter("pair", name, units="s")
+        return None
+
+    def validate(self):
+        names = self.wave_names()
+        for i, n in enumerate(names):
+            if n != f"WAVE{i + 1}":
+                raise ValueError(f"non-contiguous WAVE sequence at {n}")
+        if names and self.WAVE_OM.value is None:
+            raise ValueError("WAVE terms require WAVE_OM")
+        if self.WAVE_OM.value is not None and self.WAVEEPOCH.value is None:
+            if self._parent is None or self._parent.PEPOCH.value is None:
+                raise ValueError("WAVEEPOCH or PEPOCH required with WAVE_OM")
+
+    def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
+        names = self.wave_names()
+        if not names:
+            return qs.from_f64_device(jnp.zeros(batch.ntoas))
+        ep = "WAVEEPOCH" if self.WAVEEPOCH.value is not None else "PEPOCH"
+        dt_day = (batch.tdb_day + batch.tdb_frac - _epoch_days(p, ep)) \
+            - delay / SECS_PER_DAY
+        base = pv(p, "WAVE_OM") * dt_day
+        times = jnp.zeros(batch.ntoas)
+        for k, n in enumerate(names):
+            ab = pv(p, n)
+            arg = (k + 1) * base
+            times = times + ab[..., 0] * jnp.sin(arg) \
+                + ab[..., 1] * jnp.cos(arg)
+        return qs.from_f64_device(times * pv(p, "F0"))
+
+
+class _WaveXBasis:
+    """Shared SIN/COS machinery for the WaveX family."""
+
+    #: (freq, sin, cos) prefix spellings and the value units
+    stems = ("WXFREQ_", "WXSIN_", "WXCOS_")
+    epoch = "WXEPOCH"
+    units = "s"
+
+    def wavex_indices(self) -> List[int]:
+        return sorted(p.index for p in self.prefix_params(self.stems[0]))
+
+    def add_wavex_component(self, freq_per_day: float, index=None,
+                            sin=0.0, cos=0.0, frozen=True):
+        if index is None:
+            index = 1 + max(self.wavex_indices(), default=0)
+        fs, ss, cs = self.stems
+        self.add_param(prefixParameter("float", f"{fs}{index:04d}",
+                                       units="1/d", value=freq_per_day))
+        self.add_param(prefixParameter("float", f"{ss}{index:04d}",
+                                       units=self.units, value=sin,
+                                       frozen=frozen))
+        self.add_param(prefixParameter("float", f"{cs}{index:04d}",
+                                       units=self.units, value=cos,
+                                       frozen=frozen))
+        return index
+
+    def prefix_families(self):
+        return list(self.stems)
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == self.stems[0]:
+            return prefixParameter("float", name, units="1/d")
+        if prefix in self.stems[1:]:
+            return prefixParameter("float", name, units=self.units)
+        return None
+
+    def validate(self):
+        idx = self.wavex_indices()
+        for i in idx:
+            for stem in self.stems[1:]:
+                if f"{stem}{i:04d}" not in self.params:
+                    raise ValueError(f"{self.stems[0]}{i:04d} needs "
+                                     f"{stem}{i:04d}")
+        if idx and self.params[self.epoch].value is None:
+            if self._parent is None or self._parent.PEPOCH.value is None:
+                raise ValueError(f"{self.epoch} or PEPOCH required")
+
+    def _epoch_name(self) -> str:
+        return self.epoch if self.params[self.epoch].value is not None \
+            else "PEPOCH"
+
+    def basis_sum(self, p: dict, batch: TOABatch, dt_shift_day) -> jnp.ndarray:
+        """sum_i [ SIN_i sin(2 pi f_i dt) + COS_i cos(2 pi f_i dt) ]."""
+        idx = self.wavex_indices()
+        out = jnp.zeros(batch.ntoas)
+        if not idx:
+            return out
+        dt = batch.tdb_day + batch.tdb_frac \
+            - _epoch_days(p, self._epoch_name()) - dt_shift_day
+        fs, ss, cs = self.stems
+        for i in idx:
+            arg = 2.0 * jnp.pi * pv(p, f"{fs}{i:04d}") * dt
+            out = out + pv(p, f"{ss}{i:04d}") * jnp.sin(arg) \
+                + pv(p, f"{cs}{i:04d}") * jnp.cos(arg)
+        return out
+
+
+class WaveX(_WaveXBasis, DelayComponent):
+    """Unevenly-sampled sinusoidal achromatic delays."""
+
+    register = True
+    category = "wavex"
+    stems = ("WXFREQ_", "WXSIN_", "WXCOS_")
+    epoch = "WXEPOCH"
+    units = "s"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParam("WXEPOCH", description="WaveX epoch"))
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return self.basis_sum(p, batch, delay / SECS_PER_DAY)
+
+
+class DMWaveX(_WaveXBasis, DelayComponent):
+    """Sinusoidal DM variations (reference `DMWaveX`, `dmwavex.py:15`)."""
+
+    register = True
+    category = "dmwavex"
+    stems = ("DMWXFREQ_", "DMWXSIN_", "DMWXCOS_")
+    epoch = "DMWXEPOCH"
+    units = "pc cm^-3"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParam("DMWXEPOCH", description="DMWaveX epoch"))
+
+    def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        return self.basis_sum(p, batch, 0.0)
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return dispersion_delay(self.dm_value(p, batch), batch.freq_mhz)
+
+
+class CMWaveX(_WaveXBasis, DelayComponent):
+    """Sinusoidal chromatic-measure variations (reference `CMWaveX`,
+    `cmwavex.py:15`); needs a ChromaticCM component for TNCHROMIDX."""
+
+    register = True
+    category = "cmwavex"
+    stems = ("CMWXFREQ_", "CMWXSIN_", "CMWXCOS_")
+    epoch = "CMWXEPOCH"
+    units = "pc cm^-3 MHz^(alpha-2)"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParam("CMWXEPOCH", description="CMWaveX epoch"))
+
+    def cm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        return self.basis_sum(p, batch, 0.0)
+
+    def validate(self):
+        super().validate()
+        if self.wavex_indices() and (
+                self._parent is None or "TNCHROMIDX" not in self._parent):
+            raise ValueError(
+                "CMWaveX needs a ChromaticCM component (TNCHROMIDX)")
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return chromatic_delay(self.cm_value(p, batch),
+                               pv(p, "TNCHROMIDX"), batch.freq_mhz)
