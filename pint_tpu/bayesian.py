@@ -1,0 +1,250 @@
+"""Bayesian timing interface: lnprior / prior_transform / lnlikelihood /
+lnposterior.
+
+Reference: `BayesianTiming` (`/root/reference/src/pint/bayesian.py:12`),
+which exposes the same four functions for use with external samplers, with
+params given in par-file ("fitting") units.  Two TPU-native upgrades over
+the reference:
+
+* every function here is **jit-compiled, vmappable and differentiable**
+  (the reference's are pure-python loops, and its MCMC cannot use
+  gradients), enabling the HMC sampler in :mod:`pint_tpu.mcmc` and
+  device-resident ensemble sampling;
+* the **GLS likelihood for correlated noise is implemented** (Woodbury
+  form with log-determinant, Lentati+ 2013) — the reference raises
+  NotImplementedError for that case (`bayesian.py:113-121`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.residuals import Residuals, raw_phase_resids
+from pint_tpu.utils import woodbury_dot
+
+__all__ = ["UniformPrior", "NormalPrior", "BayesianTiming",
+           "default_prior_info"]
+
+LOG2PI = float(np.log(2.0 * np.pi))
+
+
+class UniformPrior:
+    """Uniform prior on [pmin, pmax]."""
+
+    def __init__(self, pmin: float, pmax: float):
+        if not pmax > pmin:
+            raise ValueError(f"need pmax > pmin, got [{pmin}, {pmax}]")
+        self.pmin, self.pmax = float(pmin), float(pmax)
+
+    def logpdf(self, x):
+        inb = (x >= self.pmin) & (x <= self.pmax)
+        return jnp.where(inb, -jnp.log(self.pmax - self.pmin), -jnp.inf)
+
+    def ppf(self, q):
+        return self.pmin + q * (self.pmax - self.pmin)
+
+
+class NormalPrior:
+    """Normal prior with mean mu and width sigma."""
+
+    def __init__(self, mu: float, sigma: float):
+        if not sigma > 0:
+            raise ValueError("sigma must be positive")
+        self.mu, self.sigma = float(mu), float(sigma)
+
+    def logpdf(self, x):
+        z = (x - self.mu) / self.sigma
+        return -0.5 * (z * z + LOG2PI) - jnp.log(self.sigma)
+
+    def ppf(self, q):
+        from jax.scipy.special import ndtri
+
+        return self.mu + self.sigma * ndtri(q)
+
+
+def _make_prior(spec: dict):
+    distr = spec.get("distr", "uniform")
+    if distr == "uniform":
+        return UniformPrior(spec["pmin"], spec["pmax"])
+    if distr == "normal":
+        return NormalPrior(spec["mu"], spec["sigma"])
+    raise NotImplementedError(
+        f"only uniform and normal priors are supported, not {distr!r} "
+        "(reference bayesian.py:45-49 has the same restriction)")
+
+
+def default_prior_info(model: TimingModel, nsigma: float = 20.0
+                       ) -> Dict[str, dict]:
+    """Uniform priors of half-width ``nsigma * uncertainty`` about each
+    free parameter's current value — a convenience the reference leaves to
+    the user; parameters without uncertainties must still be given priors
+    explicitly."""
+    out = {}
+    for name in model.free_params:
+        par = model[name]
+        if par.uncertainty:
+            v = float(par.value if np.isscalar(par.value) or
+                      isinstance(par.value, float) else par.mjd_float)
+            w = nsigma * float(par.uncertainty)
+            out[name] = {"distr": "uniform", "pmin": v - w, "pmax": v + w}
+    return out
+
+
+class BayesianTiming:
+    """Jit-pure Bayesian timing posterior (reference `BayesianTiming`,
+    `/root/reference/src/pint/bayesian.py:12`).
+
+    ``params`` arrays are in par-file units, ordered as
+    ``param_labels`` (= the model's free parameters).  All four methods
+    accept 1-D arrays; the underscored ``_fn`` attributes are the raw
+    jitted closures for samplers (`lnposterior_fn` composes with
+    `jax.vmap` / `jax.grad`).
+    """
+
+    def __init__(self, model: TimingModel, toas,
+                 use_pulse_numbers: bool = False,
+                 prior_info: Optional[Dict[str, dict]] = None):
+        self.model = model
+        self.toas = toas
+        self.track_mode = "use_pulse_numbers" if use_pulse_numbers \
+            else "nearest"
+        self.is_wideband = toas.is_wideband
+        self.param_labels: List[str] = list(model.free_params)
+        self.nparams = len(self.param_labels)
+        if self.nparams == 0:
+            raise ValueError("model has no free parameters")
+
+        info = dict(prior_info or {})
+        self.priors = []
+        for name in self.param_labels:
+            if name not in info:
+                raise AttributeError(
+                    f"prior is not set for free parameter {name}; pass "
+                    "prior_info (see default_prior_info)")
+            self.priors.append(_make_prior(info[name]))
+
+        self._build()
+
+    # -- jit closures ------------------------------------------------------
+    def _build(self):
+        model, names = self.model, self.param_labels
+        resids = Residuals(self.toas, model, track_mode=self.track_mode)
+        self.resids = resids
+        batch, p0 = resids.batch, resids.pdict
+        calc = model.calc
+        track = resids.track_mode
+        # par-file value of each free parameter at the pytree reference
+        # point, and d(device)/d(par-unit)
+        self._ref = np.array([self._par_value(n) for n in names])
+        self._units = np.array(model.fit_units(names))
+        refs = jnp.asarray(self._ref)
+        units = jnp.asarray(self._units)
+        correlated = model.has_correlated_errors
+        wideband = self.is_wideband
+        if wideband:
+            dm_index, dm_data, dm_error = self.toas.get_dm_data()
+            idx = jnp.asarray(dm_index)
+            dmv = jnp.asarray(dm_data)
+            dme = jnp.asarray(dm_error)
+
+        def lnlike_off(dx):
+            # dx: offsets from the reference values, par units.  Working in
+            # offsets avoids the catastrophic quantization of e.g.
+            # F0 = 346.53... +- 2e-11 (a ~350-ulp posterior) that sampling
+            # raw par values would suffer.
+            p = model.with_x(p0, dx * units, names)
+            r_cyc = raw_phase_resids(calc, p, batch, track,
+                                     subtract_mean=False, use_weights=False)
+            from pint_tpu.models.timing_model import pv
+
+            r = r_cyc / pv(p, "F0")
+            sigma = model.scaled_toa_uncertainty(p, batch) * 1e-6
+            w = 1.0 / sigma**2
+            # the phase offset is profiled out analytically (the reference
+            # subtracts the weighted mean the same way, residuals.py:442)
+            off = jnp.sum(r * w) / jnp.sum(w)
+            r = r - off
+            if correlated:
+                U = model.noise_basis(p)
+                phi = model.noise_weights(p)
+                phi = jnp.where(phi > 0.0, phi, 1e-30)
+                dot, logdet = woodbury_dot(sigma**2, U, phi, r, r)
+                ll = -0.5 * (dot + logdet + r.shape[0] * LOG2PI)
+            else:
+                chi2 = jnp.sum((r / sigma) ** 2)
+                logdet = 2.0 * jnp.sum(jnp.log(sigma))
+                ll = -0.5 * (chi2 + logdet + r.shape[0] * LOG2PI)
+            if wideband:
+                r_dm = dmv - model.total_dm(p, batch)[idx]
+                sdm = model.scaled_dm_uncertainty(
+                    p, batch, jnp.zeros(batch.ntoas).at[idx].set(dme))[idx]
+                ll = ll - 0.5 * (jnp.sum((r_dm / sdm) ** 2)
+                                 + 2.0 * jnp.sum(jnp.log(sdm))
+                                 + r_dm.shape[0] * LOG2PI)
+            return ll
+
+        priors = list(self.priors)
+
+        def lnprior(params):
+            terms = [pr.logpdf(params[i]) for i, pr in enumerate(priors)]
+            return jnp.sum(jnp.stack(terms))
+
+        def lnpost_off(dx):
+            lp = lnprior(refs + dx)
+            # evaluate the likelihood only where the prior is finite
+            # (jit-safe: compute and mask)
+            ll = lnlike_off(dx)
+            return jnp.where(jnp.isfinite(lp), lp + ll, -jnp.inf)
+
+        #: offset-space closures — the preferred sampler interface
+        self.lnlikelihood_offset_fn = jax.jit(lnlike_off)
+        self.lnposterior_offset_fn = jax.jit(lnpost_off)
+        #: reference-parity closures over raw par-unit values (these
+        #: re-derive the offset by subtraction, so they inherit the par
+        #: value's ulp quantization — fine for evaluation, poor for
+        #: sampling tightly-determined parameters)
+        self.lnlikelihood_fn = jax.jit(lambda params: lnlike_off(params - refs))
+        self.lnprior_fn = jax.jit(lnprior)
+        self.lnposterior_fn = jax.jit(lambda params: lnpost_off(params - refs))
+
+    def _par_value(self, name: str) -> float:
+        par = self.model[name]
+        try:
+            return float(par.value)
+        except (TypeError, ValueError):
+            return float(par.mjd_float)
+
+    # -- reference-parity methods -----------------------------------------
+    def lnprior(self, params) -> float:
+        return float(self.lnprior_fn(jnp.asarray(params, jnp.float64)))
+
+    def lnlikelihood(self, params) -> float:
+        return float(self.lnlikelihood_fn(jnp.asarray(params, jnp.float64)))
+
+    def lnposterior(self, params) -> float:
+        return float(self.lnposterior_fn(jnp.asarray(params, jnp.float64)))
+
+    def prior_transform(self, cube):
+        cube = np.asarray(cube)
+        return np.array([np.asarray(pr.ppf(c))
+                         for pr, c in zip(self.priors, cube)])
+
+    def scales(self) -> np.ndarray:
+        """Per-parameter scale guesses (par units) for sampler seeding:
+        prior sigma, or 1/100 of a uniform prior's width."""
+        out = []
+        for pr in self.priors:
+            if isinstance(pr, NormalPrior):
+                out.append(pr.sigma)
+            else:
+                out.append((pr.pmax - pr.pmin) / 100.0)
+        return np.array(out)
+
+    def start_point(self) -> np.ndarray:
+        """Current model values (prior centers for ppf=0.5 fallback)."""
+        return self._ref.copy()

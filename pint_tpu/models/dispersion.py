@@ -24,7 +24,7 @@ from pint_tpu.models.parameter import (
     prefixParameter,
     split_prefix,
 )
-from pint_tpu.models.timing_model import DelayComponent, pv
+from pint_tpu.models.timing_model import DelayComponent, epoch_days, pv
 from pint_tpu.toabatch import TOABatch
 from pint_tpu.utils import taylor_horner
 
@@ -87,7 +87,7 @@ class DispersionDM(DelayComponent):
         if len(names) == 1:
             return jnp.broadcast_to(coeffs[0], (batch.ntoas,))
         ep = "DMEPOCH" if self.DMEPOCH.value is not None else "PEPOCH"
-        day0 = p["const"][ep][0] + p["const"][ep][1] + p["delta"].get(ep, 0.0)
+        day0 = epoch_days(p, ep)
         dt_sec = (batch.tdb_day + batch.tdb_frac - day0) * 86400.0
         return taylor_horner(dt_sec, coeffs)
 

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from pint_tpu import qs
 from pint_tpu.models.parameter import prefixParameter, split_prefix
-from pint_tpu.models.timing_model import PhaseComponent, pv
+from pint_tpu.models.timing_model import PhaseComponent, epoch_days, pv
 from pint_tpu.toabatch import TOABatch
 
 SECS_PER_DAY = 86400.0
@@ -102,8 +102,7 @@ class Glitch(PhaseComponent):
         total = jnp.zeros(batch.ntoas)
         for idx in self.glitch_indices():
             ep = f"GLEP_{idx}"
-            day0 = p["const"][ep][0] + p["const"][ep][1] \
-                + p["delta"].get(ep, 0.0)
+            day0 = epoch_days(p, ep)
             dt = (t - day0) * SECS_PER_DAY - delay
             on = dt > 0.0
             dts = jnp.where(on, dt, 0.0)

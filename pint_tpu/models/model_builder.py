@@ -241,17 +241,31 @@ class ModelBuilder:
             f"{type(comp).__name__} cannot create parameter {canon}")
 
 
-def get_model(parfile, name: str = "") -> TimingModel:
+def get_model(parfile, name: str = "",
+              allow_tcb: bool = False) -> TimingModel:
     """Build a TimingModel from a par file (reference `get_model`,
-    `/root/reference/src/pint/models/model_builder.py:775`)."""
-    return ModelBuilder()(parfile, name=name)
+    `/root/reference/src/pint/models/model_builder.py:775`).
+
+    ``allow_tcb``: a par file with UNITS TCB is refused unless this is
+    set, in which case it is converted to TDB on load (approximately —
+    re-fit the result), as in the reference."""
+    model = ModelBuilder()(parfile, name=name)
+    if (model.UNITS.value or "TDB").upper() == "TCB":
+        if not allow_tcb:
+            raise TimingModelError(
+                "par file is in TCB units; pass allow_tcb=True to convert "
+                "it to TDB on load (approximate; re-fit afterwards)")
+        from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+        convert_tcb_tdb(model)
+    return model
 
 
-def get_model_and_toas(parfile, timfile, **kw):
+def get_model_and_toas(parfile, timfile, allow_tcb: bool = False, **kw):
     """Reference `get_model_and_toas`
     (`/root/reference/src/pint/models/model_builder.py:858`)."""
     from pint_tpu.toa import get_TOAs
 
-    model = get_model(parfile)
+    model = get_model(parfile, allow_tcb=allow_tcb)
     toas = get_TOAs(timfile, model=model, **kw)
     return model, toas

@@ -506,6 +506,9 @@ class funcParameter(Param):
             + (f", computed from {self.source_params}; set those instead"
                if self.source_params else ""))
 
+    def value_as_string(self) -> str:
+        return _fmt(float(self.value))
+
     def as_parfile_line(self) -> str:
         return ""
 
@@ -532,7 +535,7 @@ def maskParameter(name, index=1, **kw) -> MaskParam:
     return MaskParam(name, index=index, **kw)
 
 
-_PREFIX_RE = re.compile(r"^([A-Za-z0-9]*[A-Za-z_])(\d+)$")
+_PREFIX_RE = re.compile(r"^([A-Za-z0-9_]*[A-Za-z_])(\d+)$")
 
 
 def split_prefix(name: str) -> Tuple[str, int]:

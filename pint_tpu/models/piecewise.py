@@ -20,7 +20,7 @@ import numpy as np
 
 from pint_tpu import qs
 from pint_tpu.models.parameter import prefixParameter, split_prefix
-from pint_tpu.models.timing_model import PhaseComponent, pv
+from pint_tpu.models.timing_model import PhaseComponent, epoch_days, pv
 from pint_tpu.toabatch import TOABatch
 
 SECS_PER_DAY = 86400.0
@@ -104,8 +104,7 @@ class PiecewiseSpindown(PhaseComponent):
             mask = p["mask"].get(f"{ep}__rangemask")
             if mask is None:  # e.g. the 1-row TZR batch
                 continue
-            day0 = p["const"][ep][0] + p["const"][ep][1] \
-                + p["delta"].get(ep, 0.0)
+            day0 = epoch_days(p, ep)
             dt = (t - day0) * SECS_PER_DAY - delay
             dph = pv(p, f"PWPH_{idx}") + dt * (
                 pv(p, f"PWF0_{idx}") + dt / 2.0 * (

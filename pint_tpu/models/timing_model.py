@@ -113,6 +113,12 @@ def mjd_parts(p: dict, name: str):
             dv(p, name))
 
 
+def epoch_days(p: dict, name: str):
+    """Current f64 MJD of an epoch parameter: day + frac + fit offset."""
+    c = p["const"][name]
+    return c[0] + c[1] + p["delta"].get(name, 0.0)
+
+
 def mask_of(p: dict, param: MaskParam):
     return p["mask"][param.mask_pytree_name]
 

@@ -32,15 +32,15 @@ from pint_tpu.models.parameter import (
     prefixParameter,
     split_prefix,
 )
-from pint_tpu.models.timing_model import DelayComponent, PhaseComponent, pv
+from pint_tpu.models.timing_model import (
+    DelayComponent,
+    PhaseComponent,
+    epoch_days,
+    pv,
+)
 from pint_tpu.toabatch import TOABatch
 
 SECS_PER_DAY = 86400.0
-
-
-def _epoch_days(p: dict, name: str) -> jnp.ndarray:
-    return p["const"][name][0] + p["const"][name][1] + \
-        p["delta"].get(name, 0.0)
 
 
 class Wave(PhaseComponent):
@@ -91,7 +91,7 @@ class Wave(PhaseComponent):
         if not names:
             return qs.from_f64_device(jnp.zeros(batch.ntoas))
         ep = "WAVEEPOCH" if self.WAVEEPOCH.value is not None else "PEPOCH"
-        dt_day = (batch.tdb_day + batch.tdb_frac - _epoch_days(p, ep)) \
+        dt_day = (batch.tdb_day + batch.tdb_frac - epoch_days(p, ep)) \
             - delay / SECS_PER_DAY
         base = pv(p, "WAVE_OM") * dt_day
         times = jnp.zeros(batch.ntoas)
@@ -165,7 +165,7 @@ class _WaveXBasis:
         if not idx:
             return out
         dt = batch.tdb_day + batch.tdb_frac \
-            - _epoch_days(p, self._epoch_name()) - dt_shift_day
+            - epoch_days(p, self._epoch_name()) - dt_shift_day
         fs, ss, cs = self.stems
         for i in idx:
             arg = 2.0 * jnp.pi * pv(p, f"{fs}{i:04d}") * dt

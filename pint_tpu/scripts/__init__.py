@@ -1,0 +1,8 @@
+"""Command-line tools (reference `/root/reference/src/pint/scripts/`).
+
+Each module exposes ``main(argv=None)`` and is wired to a console script in
+``pyproject.toml``: ``tpintempo`` (fit), ``tzima`` (simulate),
+``tpintbary`` (barycenter), ``ttcb2tdb`` (unit conversion),
+``tcompare_parfiles`` (model diff).  The ``t`` prefix keeps them
+side-by-side-installable with the reference's tools.
+"""

@@ -1,0 +1,37 @@
+"""Compare two par files parameter by parameter.
+
+Reference: `compare_parfiles`
+(`/root/reference/src/pint/scripts/compare_parfiles.py`).
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Compare two par files (cf. compare_parfiles)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("par1")
+    parser.add_argument("par2")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    from pint_tpu.models import get_model
+
+    m1 = get_model(args.par1)
+    m2 = get_model(args.par2)
+    diff = m1.compare(m2)
+    print(f"# THIS = {args.par1}")
+    print(f"# OTHER = {args.par2}")
+    print(diff)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

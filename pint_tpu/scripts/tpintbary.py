@@ -1,0 +1,80 @@
+"""Command-line barycentering of a single time.
+
+Reference: `pintbary` (`/root/reference/src/pint/scripts/pintbary.py`):
+given a UTC MJD, an observatory, and a source position (par file or
+RA/DEC), print the barycentric arrival time (TDB at the SSB, with Roemer,
+Shapiro, and dispersion removed).
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main"]
+
+_MINIMAL_PAR = """PSR BARY
+RAJ {ra}
+DECJ {dec}
+F0 1.0
+PEPOCH {mjd}
+DM {dm}
+"""
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu command-line barycentering (cf. pintbary)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("time", help="UTC MJD, e.g. 57000.123456789")
+    parser.add_argument("--obs", default="geocenter", help="observatory")
+    parser.add_argument("--freq", type=float, default=float("inf"),
+                        help="observing frequency [MHz]")
+    parser.add_argument("--parfile", default=None)
+    parser.add_argument("--ra", default=None,
+                        help="RAJ (H:M:S) if no par file")
+    parser.add_argument("--dec", default=None,
+                        help="DECJ (D:M:S) if no par file")
+    parser.add_argument("--dm", type=float, default=0.0)
+    parser.add_argument("--ephem", default="DE421")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    import numpy as np
+
+    from pint_tpu import mjd as mjdmod
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toa import TOA, TOAs
+
+    if args.parfile:
+        model = get_model(args.parfile)
+    else:
+        if not (args.ra and args.dec):
+            parser.error("either --parfile or both --ra and --dec required")
+        mjd0 = args.time.split(".")[0]
+        model = get_model(_MINIMAL_PAR.format(
+            ra=args.ra, dec=args.dec, mjd=mjd0,
+            dm=args.dm).splitlines())
+
+    t = TOA(mjd=mjdmod.from_string(args.time), error_us=1.0,
+            freq_mhz=args.freq, obs=args.obs)
+    toas = TOAs([t])
+    toas.apply_clock_corrections()
+    toas.compute_TDBs(ephem=args.ephem)
+    toas.compute_posvels(ephem=args.ephem)
+    r = Residuals(toas, model, subtract_mean=False)
+    # barycentric time = TDB at the observatory minus all delays
+    delay_sec = float(np.asarray(model.delay(r.pdict, r.batch))[0])
+    bat = mjdmod.add_sec(toas.tdb, -delay_sec)
+    day, frac = int(bat.day[0]), float(bat.frac[0])
+    if frac < 0.0:
+        day, frac = day - 1, frac + 1.0
+    print(f"Barycentric MJD (TDB): {day}{f'{frac:.15f}'[1:]}")
+    print(f"Total delay removed: {delay_sec:.9f} s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

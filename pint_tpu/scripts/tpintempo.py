@@ -1,0 +1,94 @@
+"""Fit a timing model to TOAs from the command line.
+
+Reference: `pintempo` (`/root/reference/src/pint/scripts/pintempo.py`):
+load par + tim, compute pre-fit residuals, fit, print the summary, and
+optionally write the post-fit par file and residuals.
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu command-line timing fit (cf. pintempo)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("parfile", help="model par file")
+    parser.add_argument("timfile", help="TOA tim file")
+    parser.add_argument("--fitter", default="auto",
+                        choices=["auto", "wls", "gls", "downhill",
+                                 "downhill_gls", "wideband",
+                                 "wideband_downhill"],
+                        help="fitter to use; auto picks GLS/wideband from "
+                             "the model and data")
+    parser.add_argument("--maxiter", type=int, default=10)
+    parser.add_argument("--outfile", default=None,
+                        help="write the post-fit model to this par file")
+    parser.add_argument("--plotfile", default=None,
+                        help="write pre/post-fit residuals (MJD, us, err) "
+                             "to this text file")
+    parser.add_argument("--ephem", default=None, help="ephemeris override")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress warnings")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    from pint_tpu import fitter as F
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toa import get_TOAs
+
+    model = get_model(args.parfile)
+    kw = {"model": model}
+    if args.ephem:
+        kw["ephem"] = args.ephem
+    toas = get_TOAs(args.timfile, **kw)
+    print(f"Read {toas.ntoas} TOAs from {args.timfile}")
+
+    wideband = toas.is_wideband
+    name = args.fitter
+    if name == "auto":
+        if wideband:
+            name = "wideband_downhill"
+        elif model.has_correlated_errors:
+            name = "downhill_gls"
+        else:
+            name = "downhill"
+    cls = {"wls": F.WLSFitter, "gls": F.GLSFitter,
+           "downhill": F.DownhillWLSFitter,
+           "downhill_gls": F.DownhillGLSFitter,
+           "wideband": F.WidebandTOAFitter,
+           "wideband_downhill": F.WidebandDownhillFitter}[name]
+
+    prefit = Residuals(toas, model)
+    print(f"Pre-fit weighted RMS: {prefit.rms_weighted()*1e6:.4f} us")
+    f = cls(toas, model)
+    f.fit_toas(maxiter=args.maxiter)
+    print(f"Fitted with {type(f).__name__}")
+    print(f.get_summary())
+
+    if args.plotfile:
+        import numpy as np
+
+        r = f.resids
+        toa_r = r.toa if hasattr(r, "toa") else r
+        mjd = np.asarray(toa_r.batch.tdbld)
+        with open(args.plotfile, "w") as fh:
+            fh.write("# MJD prefit_us postfit_us err_us\n")
+            for row in zip(mjd, prefit.time_resids * 1e6,
+                           toa_r.time_resids * 1e6, toa_r.get_data_error()):
+                fh.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+        print(f"Wrote residuals to {args.plotfile}")
+    if args.outfile:
+        model.write_parfile(args.outfile,
+                            comment="post-fit model written by tpintempo")
+        print(f"Wrote post-fit model to {args.outfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

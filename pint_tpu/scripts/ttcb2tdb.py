@@ -1,0 +1,35 @@
+"""Convert a TCB par file to TDB.
+
+Reference: `tcb2tdb` (`/root/reference/src/pint/scripts/tcb2tdb.py`).
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Approximately convert a TCB par file to TDB "
+                    "(cf. tcb2tdb); re-fit the output",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("input_par", help="TCB par file")
+    parser.add_argument("output_par", help="output TDB par file")
+    args = parser.parse_args(argv)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.models import get_model
+
+        model = get_model(args.input_par, allow_tcb=True)
+    model.write_parfile(args.output_par,
+                        comment="converted TCB -> TDB by ttcb2tdb "
+                                "(approximate; re-fit)")
+    print(f"Wrote TDB model to {args.output_par}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

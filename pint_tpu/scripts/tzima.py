@@ -1,0 +1,67 @@
+"""Simulate fake TOAs from a timing model ("zima").
+
+Reference: `zima` (`/root/reference/src/pint/scripts/zima.py`): generate
+uniformly spaced TOAs that the model predicts perfectly, optionally add
+white measurement noise and wideband DM data, write a tim file.
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu fake-TOA simulator (cf. zima)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("parfile", help="model par file")
+    parser.add_argument("timfile", help="output tim file")
+    parser.add_argument("--ntoa", type=int, default=100)
+    parser.add_argument("--startMJD", type=float, default=56000.0)
+    parser.add_argument("--duration", type=float, default=400.0,
+                        help="span [days]")
+    parser.add_argument("--obs", default="gbt")
+    parser.add_argument("--freq", type=float, nargs="+", default=[1400.0],
+                        help="observing frequencies [MHz], cycled over TOAs")
+    parser.add_argument("--error", type=float, default=1.0,
+                        help="TOA uncertainty [us]")
+    parser.add_argument("--fuzzdays", type=float, default=0.0)
+    parser.add_argument("--addnoise", action="store_true")
+    parser.add_argument("--wideband", action="store_true",
+                        help="attach -pp_dm/-pp_dme wideband DM data")
+    parser.add_argument("--dmerror", type=float, default=1e-4,
+                        help="wideband DM uncertainty [pc cm^-3]")
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import (
+        add_wideband_dm_data,
+        make_fake_toas_uniform,
+    )
+    from pint_tpu.toa import write_tim
+
+    model = get_model(args.parfile)
+    freqs = np.resize(np.asarray(args.freq, float), args.ntoa)
+    toas = make_fake_toas_uniform(
+        args.startMJD, args.startMJD + args.duration, args.ntoa, model,
+        obs=args.obs, error_us=args.error, freq_mhz=freqs,
+        fuzz_days=args.fuzzdays, add_noise=args.addnoise, seed=args.seed)
+    if args.wideband:
+        dm_seed = None if args.seed is None else args.seed + 1
+        toas = add_wideband_dm_data(toas, model, dm_error=args.dmerror,
+                                    add_noise=args.addnoise, seed=dm_seed)
+    write_tim(args.timfile, toas)
+    print(f"Wrote {toas.ntoas} simulated TOAs to {args.timfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Bayesian layer + samplers.
+
+Mirrors the reference's `tests/test_bayesian.py` (prior/likelihood/
+posterior consistency, narrowband & wideband) and adds sampler-correctness
+checks the reference cannot run in CI (it has no built-in sampler).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.bayesian import (
+    BayesianTiming,
+    NormalPrior,
+    UniformPrior,
+    default_prior_info,
+)
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.mcmc import MCMCFitter, ensemble_sample, hmc_sample
+from pint_tpu.models import get_model
+from pint_tpu.simulation import add_wideband_dm_data, make_fake_toas_uniform
+
+PAR = """
+PSR BAYESTEST
+RAJ 07:40:45.79
+DECJ 66:20:33.5
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def dataset(ntoas=40, seed=9, wideband=False):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(PAR.strip().splitlines())
+        toas = make_fake_toas_uniform(
+            54700, 55300, ntoas, model, obs="gbt", error_us=1.0,
+            freq_mhz=np.tile([1400.0, 800.0], ntoas // 2), add_noise=True,
+            seed=seed)
+        if wideband:
+            toas = add_wideband_dm_data(toas, model, dm_error=2e-4,
+                                        add_noise=True, seed=seed + 1)
+    return model, toas
+
+
+class TestPriors:
+    def test_uniform(self):
+        pr = UniformPrior(1.0, 3.0)
+        assert float(pr.logpdf(2.0)) == pytest.approx(-np.log(2.0))
+        assert float(pr.logpdf(0.5)) == -np.inf
+        assert float(pr.ppf(0.25)) == pytest.approx(1.5)
+
+    def test_normal(self):
+        pr = NormalPrior(5.0, 2.0)
+        assert float(pr.ppf(0.5)) == pytest.approx(5.0)
+        # logpdf integrates to a proper normal
+        assert float(pr.logpdf(5.0)) == pytest.approx(
+            -0.5 * np.log(2 * np.pi) - np.log(2.0))
+
+
+class TestBayesianTiming:
+    def test_requires_priors(self):
+        model, toas = dataset()
+        with pytest.raises(AttributeError, match="prior is not set"):
+            BayesianTiming(model, toas)
+
+    def test_posterior_peaks_at_truth(self):
+        model, toas = dataset()
+        # fit first so uncertainties exist for default priors
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=3)
+        bt = BayesianTiming(model, toas,
+                            prior_info=default_prior_info(model))
+        x0 = bt.start_point()
+        lp0 = bt.lnposterior(x0)
+        assert np.isfinite(lp0)
+        # moving any parameter by 10 sigma must lower the posterior
+        for i, name in enumerate(bt.param_labels):
+            x = x0.copy()
+            x[i] += 10 * self_unc(model, name)
+            assert bt.lnposterior(x) < lp0
+        # outside the prior: -inf
+        x = x0.copy()
+        x[0] += 1e3 * self_unc(model, bt.param_labels[0])
+        assert bt.lnposterior(x) == -np.inf
+        # prior + likelihood = posterior
+        assert bt.lnposterior(x0) == pytest.approx(
+            bt.lnprior(x0) + bt.lnlikelihood(x0))
+
+    def test_gradient_finite(self):
+        model, toas = dataset()
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=3)
+        bt = BayesianTiming(model, toas,
+                            prior_info=default_prior_info(model))
+        g = np.asarray(jax.grad(bt.lnposterior_fn)(
+            jnp.asarray(bt.start_point())))
+        assert np.all(np.isfinite(g))
+
+    def test_wideband_lnlike(self):
+        model, toas = dataset(wideband=True)
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=3)
+        info = default_prior_info(model)
+        bt_wb = BayesianTiming(model, toas, prior_info=info)
+        toas_nb = toas.select(np.ones(toas.ntoas, bool))
+        for fl in toas_nb.flags:
+            fl.pop("pp_dm", None), fl.pop("pp_dme", None)
+        bt_nb = BayesianTiming(model, toas_nb, prior_info=info)
+        assert bt_wb.is_wideband and not bt_nb.is_wideband
+        x0 = bt_wb.start_point()
+        # wideband adds the (finite) DM-block terms
+        assert np.isfinite(bt_wb.lnlikelihood(x0))
+        assert bt_wb.lnlikelihood(x0) != bt_nb.lnlikelihood(x0)
+
+    def test_gls_lnlike_with_ecorr(self):
+        par = PAR + "ECORR -fe R1 0.5\n"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.strip().splitlines())
+            toas = make_fake_toas_uniform(
+                54700, 55300, 30, model, obs="gbt", error_us=1.0,
+                freq_mhz=np.tile([1400.0, 800.0], 15), add_noise=True,
+                seed=3)
+        for fl in toas.flags:
+            fl["fe"] = "R1"
+        info = {n: {"distr": "uniform",
+                    "pmin": float(model[n].value) - 1e-3 * abs(float(model[n].value) or 1) - 1e-6,
+                    "pmax": float(model[n].value) + 1e-3 * abs(float(model[n].value) or 1) + 1e-6}
+                for n in model.free_params}
+        bt = BayesianTiming(model, toas, prior_info=info)
+        # the reference raises NotImplementedError here; we return a number
+        assert np.isfinite(bt.lnlikelihood(bt.start_point()))
+
+    def test_prior_transform(self):
+        model, toas = dataset()
+        info = {"F0": {"distr": "uniform", "pmin": 346.0, "pmax": 347.0},
+                "F1": {"distr": "normal", "mu": -1.46e-15, "sigma": 1e-18},
+                "DM": {"distr": "uniform", "pmin": 14.0, "pmax": 16.0}}
+        bt = BayesianTiming(model, toas, prior_info=info)
+        x = bt.prior_transform(np.full(bt.nparams, 0.5))
+        i = bt.param_labels.index("F0")
+        assert x[i] == pytest.approx(346.5)
+
+
+def self_unc(model, name):
+    return float(model[name].uncertainty)
+
+
+class TestSamplersOnGaussian:
+    """Analytic-target correctness: a correlated 3-D Gaussian."""
+
+    mean = np.array([1.0, -2.0, 0.5])
+    cov = np.array([[1.0, 0.6, 0.0],
+                    [0.6, 2.0, 0.3],
+                    [0.0, 0.3, 0.5]])
+
+    def lnpost(self):
+        prec = jnp.asarray(np.linalg.inv(self.cov))
+        mu = jnp.asarray(self.mean)
+
+        def f(x):
+            d = x - mu
+            return -0.5 * d @ prec @ d
+
+        return f
+
+    def test_ensemble_recovers_moments(self):
+        rng = np.random.default_rng(0)
+        x0 = self.mean + rng.standard_normal((32, 3)) * 0.1
+        res = ensemble_sample(self.lnpost(), x0, nsteps=3000, seed=1)
+        flat = res.chain[1000:].reshape(-1, 3)
+        assert 0.1 < res.acceptance < 0.9
+        assert np.allclose(flat.mean(axis=0), self.mean, atol=0.12)
+        assert np.allclose(np.cov(flat.T), self.cov, atol=0.35)
+
+    def test_hmc_recovers_moments(self):
+        res = hmc_sample(self.lnpost(), np.zeros(3), num_warmup=800,
+                         num_samples=3000, seed=2)
+        assert res.acceptance > 0.5
+        flat = res.samples
+        assert np.allclose(flat.mean(axis=0), self.mean, atol=0.15)
+        assert np.allclose(np.cov(flat.T), self.cov, atol=0.4)
+
+
+class TestMCMCFitterEndToEnd:
+    def test_posterior_matches_wls(self):
+        model, toas = dataset(ntoas=40)
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=3)
+        wls_vals = {n: float(model[n].value) for n in ("F0", "DM")}
+        wls_unc = {n: float(model[n].uncertainty) for n in ("F0", "DM")}
+        mf = MCMCFitter(toas, model)
+        mf.fit_toas(nsteps=1500, seed=4)
+        assert 0.1 < mf.acceptance < 0.9
+        refs = mf.bt.start_point()
+        for n in ("F0", "DM"):
+            i = mf.bt.param_labels.index(n)
+            # offset-space statistics (no ulp quantization on e.g. F0)
+            post_mean = refs[i] + mf.chain_offsets[:, i].mean()
+            post_std = mf.chain_offsets[:, i].std()
+            # with flat priors the posterior must match the WLS solution
+            assert abs(post_mean - wls_vals[n]) < 3 * wls_unc[n]
+            assert 0.5 < post_std / wls_unc[n] < 2.0
+        # model updated in place with posterior means/stds
+        assert float(model.F0.uncertainty) == pytest.approx(
+            mf.chain_offsets[:, mf.bt.param_labels.index("F0")].std())

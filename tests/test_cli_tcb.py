@@ -1,0 +1,186 @@
+"""CLI scripts + TCB->TDB conversion tests.
+
+Mirrors the reference's `tests/test_tcb2tdb.py` scaling/epoch checks and
+its script smoke tests (`tests/test_zima.py`, `test_pintempo.py`,
+`test_pintbary.py`, `test_compare_parfiles.py`).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.models.tcb_conversion import IFTE_K, IFTE_MJD0, convert_tcb_tdb
+
+PAR_TCB = """
+PSR TCBTEST
+RAJ 07:40:45.79 1
+DECJ 66:20:33.5 1
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+BINARY ELL1
+PB 4.76694461
+A1 3.9775561
+TASC 55000.3
+EPS1 -5.7e-6
+EPS2 -1.89e-5
+UNITS TCB
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+PAR_TDB = PAR_TCB.replace("UNITS TCB", "UNITS TDB")
+
+
+def load(par, **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(par.strip().splitlines(), **kw)
+
+
+class TestTCBConversion:
+    def test_tcb_refused_by_default(self):
+        from pint_tpu.exceptions import TimingModelError
+
+        with pytest.raises(TimingModelError, match="TCB"):
+            load(PAR_TCB)
+
+    def test_scalings(self):
+        m = load(PAR_TCB, allow_tcb=True)
+        m0 = load(PAR_TDB)
+        assert m.UNITS.value == "TDB"
+        # F0 scales by K, F1 by K^2 (Irwin & Fukushima 1999)
+        assert m.F0.value == pytest.approx(m0.F0.value * IFTE_K, rel=1e-15)
+        assert m.F1.value == pytest.approx(m0.F1.value * IFTE_K**2,
+                                           rel=1e-14)
+        # time-like parameters shrink: PB, A1 divide by K
+        assert m.PB.value == pytest.approx(m0.PB.value / IFTE_K, rel=1e-15)
+        assert m.A1.value == pytest.approx(m0.A1.value / IFTE_K, rel=1e-15)
+        # DM scales like a rate
+        assert m.DM.value == pytest.approx(m0.DM.value * IFTE_K, rel=1e-15)
+        # epochs transform affinely about IFTE_MJD0
+        expected = (55000.0 - IFTE_MJD0) / IFTE_K + IFTE_MJD0
+        assert m.PEPOCH.mjd_float == pytest.approx(expected, abs=1e-9)
+        # TZRMJD is deliberately left alone (reference exclusion list)
+        assert m.TZRMJD.mjd_float == pytest.approx(55000.1, abs=1e-12)
+
+    def test_mass_parallax_signs(self):
+        # M2 is a time (Tsun*M2): shrinks TCB->TDB; PX is a rate: grows
+        par = PAR_TCB.replace("BINARY ELL1", "BINARY ELL1\nM2 0.25\nSINI 0.99\nPX 0.5")
+        m = load(par, allow_tcb=True)
+        assert m.M2.value == pytest.approx(0.25 / IFTE_K, rel=1e-15)
+        assert m.PX.value == pytest.approx(0.5 * IFTE_K, rel=1e-15)
+
+    def test_wave_left_whole(self):
+        # reference leaves Wave (incl. WAVEEPOCH) entirely unconverted
+        par = PAR_TCB + "WAVE_OM 0.01\nWAVEEPOCH 54000\nWAVE1 1e-5 0\n"
+        m = load(par, allow_tcb=True)
+        assert m.WAVEEPOCH.mjd_float == pytest.approx(54000.0, abs=1e-12)
+        assert m.WAVE_OM.value == pytest.approx(0.01, rel=1e-15)
+
+    def test_roundtrip(self):
+        m = load(PAR_TCB, allow_tcb=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            convert_tcb_tdb(m, backwards=True)
+        m0 = load(PAR_TCB, allow_tcb=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            convert_tcb_tdb(m)
+        assert m.UNITS.value == "TDB"
+        assert m.F0.value == pytest.approx(m0.F0.value, rel=1e-15)
+
+    def test_noop_on_tdb(self):
+        m = load(PAR_TDB)
+        f0 = m.F0.value
+        with pytest.warns(UserWarning, match="doing nothing"):
+            convert_tcb_tdb(m)
+        assert m.F0.value == f0
+
+
+class TestScripts:
+    @pytest.fixture()
+    def workdir(self, tmp_path):
+        par = tmp_path / "test.par"
+        par.write_text(PAR_TDB.strip() + "\n")
+        return tmp_path
+
+    def test_zima_and_pintempo(self, workdir):
+        from pint_tpu.scripts import tpintempo, tzima
+
+        par = str(workdir / "test.par")
+        tim = str(workdir / "fake.tim")
+        out = str(workdir / "post.par")
+        resids = str(workdir / "resids.txt")
+        rc = tzima.main([par, tim, "--ntoa", "24", "--startMJD", "54800",
+                         "--duration", "400", "--addnoise", "--seed", "5",
+                         "--quiet"])
+        assert rc == 0 and os.path.exists(tim)
+        rc = tpintempo.main([par, tim, "--outfile", out, "--plotfile",
+                             resids, "--quiet", "--maxiter", "5"])
+        assert rc == 0
+        assert os.path.exists(out) and os.path.exists(resids)
+        m = load(open(out).read())
+        assert m.CHI2.value is not None
+        body = open(resids).read().splitlines()
+        assert len(body) == 25  # header + 24 rows
+
+    def test_zima_wideband(self, workdir):
+        from pint_tpu.scripts import tpintempo, tzima
+
+        par = str(workdir / "test.par")
+        tim = str(workdir / "wb.tim")
+        rc = tzima.main([par, tim, "--ntoa", "20", "--startMJD", "54800",
+                         "--duration", "300", "--addnoise", "--wideband",
+                         "--seed", "5", "--quiet"])
+        assert rc == 0
+        from pint_tpu.toa import get_TOAs
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = get_TOAs(tim, model=load(PAR_TDB))
+        assert toas.is_wideband
+        rc = tpintempo.main([par, tim, "--quiet", "--maxiter", "5"])
+        assert rc == 0
+
+    def test_pintbary(self, workdir, capsys):
+        from pint_tpu.scripts import tpintbary
+
+        rc = tpintbary.main(["55000.1234567890123", "--obs", "gbt",
+                             "--parfile", str(workdir / "test.par"),
+                             "--quiet"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        line = [ln for ln in out.splitlines() if "Barycentric" in ln][0]
+        bat = float(line.split()[-1])
+        # Roemer delay is at most ~500 s
+        assert abs(bat - 55000.1234567890123) < 600.0 / 86400.0
+
+    def test_tcb2tdb_script(self, workdir, tmp_path):
+        from pint_tpu.scripts import ttcb2tdb
+
+        tcb = tmp_path / "tcb.par"
+        tcb.write_text(PAR_TCB.strip() + "\n")
+        out = str(tmp_path / "tdb.par")
+        rc = ttcb2tdb.main([str(tcb), out])
+        assert rc == 0
+        m = load(open(out).read())
+        assert m.UNITS.value == "TDB"
+
+    def test_compare_parfiles(self, workdir, tmp_path, capsys):
+        from pint_tpu.scripts import tcompare_parfiles
+
+        par2 = tmp_path / "other.par"
+        par2.write_text(PAR_TDB.replace("14.96", "15.00").strip() + "\n")
+        rc = tcompare_parfiles.main([str(workdir / "test.par"), str(par2),
+                                     "--quiet"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "DM" in out

@@ -150,6 +150,17 @@ class TestSolarWind:
         with pytest.raises(ValueError, match="SWM"):
             build("NE_SW 8.0\nSWM 1\n")
 
+    def test_ne_sw_derivatives_parse_and_apply(self):
+        # regression: interior-underscore prefixes (NE_SW1) must resolve
+        model, toas = build("NE_SW 8.0\nNE_SW1 4.0\nSWEPOCH 55000\n",
+                            add_noise=False)
+        assert "NE_SW1" in model
+        r = Residuals(toas, model)
+        comp = model.components["SolarWindDispersion"]
+        ne = np.asarray(comp.ne_sw_value(r.pdict, r.batch))
+        t_yr = (np.asarray(r.batch.tdbld) - 55000.0) / 365.25
+        assert np.allclose(ne, 8.0 + 4.0 * t_yr, rtol=1e-12)
+
 
 class TestGlitch:
     def test_phase_before_epoch_zero(self):
