@@ -120,13 +120,13 @@ class BayesianTiming:
             raise ValueError("model has no free parameters")
 
         info = dict(prior_info or {})
-        self.priors = []
-        for name in self.param_labels:
-            if name not in info:
-                raise AttributeError(
-                    f"prior is not set for free parameter {name}; pass "
-                    "prior_info (see default_prior_info)")
-            self.priors.append(_make_prior(info[name]))
+        missing = [n for n in self.param_labels if n not in info]
+        if missing:
+            raise AttributeError(
+                f"prior is not set for free parameter(s) {missing}; pass "
+                "prior_info entries for them, or fit the model first so "
+                "default_prior_info can derive widths from uncertainties")
+        self.priors = [_make_prior(info[n]) for n in self.param_labels]
 
         self._build()
 

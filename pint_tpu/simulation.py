@@ -21,7 +21,8 @@ from pint_tpu.residuals import build_resid_fn
 from pint_tpu.toa import TOAs, get_TOAs_array
 
 __all__ = ["zero_residuals", "make_fake_toas_uniform", "make_fake_toas_fromtim",
-           "update_fake_toa_errors", "add_wideband_dm_data"]
+           "update_fake_toa_errors", "add_wideband_dm_data",
+           "add_correlated_noise", "calculate_random_models"]
 
 
 def zero_residuals(toas: TOAs, model: TimingModel, maxiter: int = 10,
@@ -70,7 +71,14 @@ def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int,
                           freqs_mhz=freqs, ephem=ephem, planets=planets)
     toas = zero_residuals(toas, model)
     if add_noise:
-        noise = rng.standard_normal(ntoas) * toas.error_us * 1e-6
+        sigma_us = np.asarray(toas.error_us)
+        if model.noise_components:
+            # EFAC/EQUAD-scaled white noise, as the reference simulates
+            # (`simulation.py:126` uses scaled_toa_uncertainty)
+            from pint_tpu.residuals import Residuals
+
+            sigma_us = Residuals(toas, model).get_data_error()
+        noise = rng.standard_normal(ntoas) * sigma_us * 1e-6
         toas.utc = mjdmod.add_sec(toas.utc, noise)
         toas.compute_TDBs(ephem=ephem)
         toas.compute_posvels(ephem=ephem, planets=planets)
@@ -95,6 +103,85 @@ def make_fake_toas_fromtim(timfile, model: TimingModel,
         toas.compute_TDBs(ephem=toas.ephem)
         toas.compute_posvels(ephem=toas.ephem, planets=toas.planets)
     return toas
+
+
+def add_correlated_noise(toas: TOAs, model: TimingModel,
+                         seed: Optional[int] = None) -> TOAs:
+    """Shift TOAs by one realization of the model's correlated noise
+    (ECORR epochs, red-noise Fourier modes): delay = U @ (sqrt(phi) * z)
+    with z ~ N(0, I) (reference `make_fake_toas(..., add_correlated_noise=
+    True)`, `/root/reference/src/pint/simulation.py:126-170`)."""
+    import numpy as _np
+
+    from pint_tpu.residuals import Residuals
+
+    if not model.has_correlated_errors:
+        raise ValueError("model has no correlated noise components")
+    rng = np.random.default_rng(seed)
+    r = Residuals(toas, model)
+    U = _np.asarray(model.noise_basis(r.pdict))
+    phi = _np.asarray(model.noise_weights(r.pdict))
+    z = rng.standard_normal(U.shape[1])
+    delay_sec = U @ (np.sqrt(np.maximum(phi, 0.0)) * z)
+    toas.utc = mjdmod.add_sec(toas.utc, delay_sec)
+    toas.compute_TDBs(ephem=toas.ephem)
+    toas.compute_posvels(ephem=toas.ephem, planets=toas.planets)
+    return toas
+
+
+def calculate_random_models(fitter, toas: TOAs, Nmodels: int = 100,
+                            seed: Optional[int] = None,
+                            return_time: bool = False):
+    """Phase (or time) deviations of ``Nmodels`` parameter vectors drawn
+    from the fit covariance, evaluated at ``toas`` (reference
+    `calculate_random_models`, `/root/reference/src/pint/simulation.py:524`,
+    there a python loop over deep-copied models; here ONE `jax.vmap` of
+    the jitted residual function over the draw matrix).
+
+    Returns ``(dphase, draws)``: dphase shape (Nmodels, ntoas) in cycles
+    (seconds if ``return_time``); draws shape (Nmodels, nfree) are the
+    sampled parameter offsets in device units.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.fitter import build_resid_sec_fn
+    from pint_tpu.residuals import Residuals
+
+    model = fitter.model
+    names = fitter.covariance_params or fitter.fit_params
+    C = np.asarray(fitter.parameter_covariance_matrix)[
+        :len(names), :len(names)]
+    # range-safe draw: factor the correlation on the (IEEE f64) host,
+    # scale columns afterwards
+    s = np.sqrt(np.diag(C))
+    L = np.linalg.cholesky(C / np.outer(s, s) +
+                           1e-12 * np.eye(len(names)))
+    rng = np.random.default_rng(seed)
+    draws = (rng.standard_normal((Nmodels, len(names))) @ L.T) * s[None, :]
+
+    r = Residuals(toas, model, track_mode=fitter.track_mode)
+    resid_sec = build_resid_sec_fn(model, r.batch, names, r.track_mode)
+    p = r.pdict
+
+    w = 1.0 / jnp.asarray(toas.error_us) ** 2
+
+    @jax.jit
+    def dev(xs):
+        base = resid_sec(jnp.zeros(len(names)), p)
+
+        def one(x):
+            d = resid_sec(x, p) - base
+            # profile out the constant phase offset, as the fit does —
+            # the covariance describes offset-marginalized scatter
+            return d - jnp.sum(d * w) / jnp.sum(w)
+
+        return jax.vmap(one)(xs)
+
+    dt_sec = np.asarray(dev(jnp.asarray(draws)))
+    if return_time:
+        return dt_sec, draws
+    return dt_sec * float(model.F0.value), draws
 
 
 def add_wideband_dm_data(toas: TOAs, model: TimingModel,

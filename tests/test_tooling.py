@@ -1,0 +1,344 @@
+"""Tooling tail: derived quantities, polycos, binary conversion,
+simulation noise realizations, random models.
+
+Mirrors the reference's `tests/test_derived_quantities.py`,
+`test_polycos.py`, `test_binary_conversions.py`, `test_random_models.py`.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu import derived_quantities as dq
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import (
+    add_correlated_noise,
+    calculate_random_models,
+    make_fake_toas_uniform,
+)
+
+PAR_ELL1 = """
+PSR TOOLTEST
+RAJ 07:40:45.79 1
+DECJ 66:20:33.5 1
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+BINARY ELL1
+PB 4.76694461
+A1 3.9775561
+TASC 55000.3
+EPS1 -5.7e-6
+EPS2 -1.89e-5
+M2 0.25
+SINI 0.99
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def load(par):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(par.strip().splitlines())
+
+
+class TestDerivedQuantities:
+    """Golden values computed against the reference formulas."""
+
+    def test_p_to_f_roundtrip(self):
+        f, fd = dq.p_to_f(0.0333, -1e-15)
+        p, pd = dq.p_to_f(f, fd)  # involution
+        assert p == pytest.approx(0.0333) and pd == pytest.approx(-1e-15)
+
+    def test_crab_like_age_b(self):
+        # Crab-ish: F0=29.946923, F1=-3.77535e-10
+        age = dq.pulsar_age(29.946923, -3.77535e-10)
+        assert age == pytest.approx(1257.0, rel=0.01)  # ~1.26 kyr
+        B = dq.pulsar_B(29.946923, -3.77535e-10)
+        assert B == pytest.approx(3.8e12, rel=0.05)
+        edot = dq.pulsar_edot(29.946923, -3.77535e-10)
+        assert edot == pytest.approx(4.5e38, rel=0.05)
+
+    def test_mass_function_consistency(self):
+        # J0740-like: PB=4.7669 d, A1=3.9776 ls
+        mf = dq.mass_funct(4.76694461, 3.9775561)
+        # published J0740+6620 mass function ~0.00297 Msun
+        assert mf == pytest.approx(0.00297, rel=2e-2)
+        # mass_funct2 at the solution masses must reproduce it
+        mp = dq.pulsar_mass(4.76694461, 3.9775561, 0.26, 87.0)
+        mf2 = dq.mass_funct2(mp, 0.26, 87.0)
+        assert mf2 == pytest.approx(mf, rel=1e-10)
+
+    def test_companion_pulsar_mass_inverse(self):
+        mc = dq.companion_mass(4.76694461, 3.9775561, i_deg=87.0, mp=2.0)
+        mp = dq.pulsar_mass(4.76694461, 3.9775561, mc, 87.0)
+        assert mp == pytest.approx(2.0, rel=1e-8)
+
+    def test_gr_pk_parameters_hulse_taylor(self):
+        # B1913+16: Pb=0.322997 d, e=0.6171, mp=1.438, mc=1.390
+        pb, e, mp, mc = 0.322997448918, 0.6171338, 1.438, 1.390
+        assert dq.omdot(mp, mc, pb, e) == pytest.approx(4.226, rel=2e-3)
+        assert dq.gamma(mp, mc, pb, e) == pytest.approx(4.307e-3, rel=5e-3)
+        assert dq.pbdot(mp, mc, pb, e) == pytest.approx(-2.402e-12,
+                                                        rel=5e-3)
+        # mtot back from omdot
+        mtot = dq.omdot_to_mtot(4.226595, pb, e)
+        assert mtot == pytest.approx(mp + mc, rel=1e-3)
+
+    def test_sini_a1sini(self):
+        s = dq.sini(1.4, 0.3, 10.0, dq.a1sini(1.4, 0.3, 10.0))
+        assert s == pytest.approx(1.0, rel=1e-9)
+
+    def test_shklovskii(self):
+        # ~J0437: mu=141 mas/yr, d=0.157 kpc
+        a_s = dq.shklovskii_factor(141.0, 0.157)
+        # apparent Pdot for P=5.757 ms: ~2.4e-20 s/s... well-known ~1e-19
+        assert 1e-20 < a_s * 5.757e-3 < 1e-18
+
+
+class TestPolycos:
+    def setup_method(self):
+        self.model = load(PAR_ELL1)
+
+    def test_generate_and_predict(self):
+        from pint_tpu.polycos import Polycos
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pcs = Polycos.generate_polycos(
+                self.model, 55000.0, 55000.5, obs="gbt", segLength=60.0,
+                ncoeff=12, obsFreq=1400.0)
+            assert len(pcs.entries) == 12
+            # polyco phase prediction must match the full model at
+            # arbitrary times to ~1e-6 cycles (reference test_polycos.py
+            # checks the same round trip)
+            rng = np.random.default_rng(1)
+            t = 55000.0 + 0.5 * rng.random(20)
+            ints, fracs = pcs.eval_abs_phase(t)
+
+            from pint_tpu import qs
+            from pint_tpu.toa import get_TOAs_array
+
+            toas = get_TOAs_array(t, obs="gbt", errors_us=1.0,
+                                  freqs_mhz=np.full(20, 1400.0),
+                                  ephem="DE421")
+            r = Residuals(toas, self.model, subtract_mean=False)
+            ph = self.model.calc.phase(r.pdict, r.batch)
+            ip_m, fp_m = qs.round_nearest(ph)
+            ip_m = np.asarray(ip_m)
+            fp_m = np.asarray(qs.to_f64(fp_m))
+        dphi = (ints - ip_m) + (fracs - fp_m)
+        dphi -= np.round(dphi)
+        assert np.max(np.abs(dphi)) < 1e-6
+
+    def test_freq_prediction(self):
+        from pint_tpu.polycos import Polycos
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pcs = Polycos.generate_polycos(
+                self.model, 55000.0, 55000.1, obs="gbt", segLength=30.0,
+                ncoeff=10)
+        f = pcs.eval_spin_freq([55000.02, 55000.05])
+        # apparent frequency = F0 within the ~1e-4 fractional doppler
+        assert np.allclose(f, 346.53199992, rtol=2e-4)
+
+    def test_file_roundtrip(self, tmp_path):
+        from pint_tpu.polycos import Polycos
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pcs = Polycos.generate_polycos(
+                self.model, 55000.0, 55000.2, obs="gbt", segLength=60.0,
+                ncoeff=8)
+        fn = str(tmp_path / "polyco.dat")
+        pcs.write_polyco_file(fn)
+        pcs2 = Polycos.read_polyco_file(fn)
+        assert len(pcs2.entries) == len(pcs.entries)
+        t = np.array([55000.05, 55000.15])
+        i1, f1 = pcs.eval_abs_phase(t)
+        i2, f2 = pcs2.eval_abs_phase(t)
+        d = (i1 - i2) + (f1 - f2)
+        assert np.max(np.abs(d)) < 1e-5
+
+    def test_uncovered_time_raises(self):
+        from pint_tpu.polycos import Polycos
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pcs = Polycos.generate_polycos(
+                self.model, 55000.0, 55000.1, obs="gbt", segLength=60.0,
+                ncoeff=8)
+        with pytest.raises(ValueError, match="not covered"):
+            pcs.eval_abs_phase([55010.0])
+
+
+class TestBinaryConvert:
+    def test_ell1_dd_roundtrip_delay(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        m_ell1 = load(PAR_ELL1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m_dd = convert_binary(m_ell1, "DD")
+            assert m_dd.BINARY.value == "DD"
+            assert "BinaryDD" in m_dd.components
+            m_back = convert_binary(m_dd, "ELL1")
+            toas = make_fake_toas_uniform(54950, 55050, 30, m_ell1,
+                                          obs="gbt", add_noise=False)
+            r1 = Residuals(toas, m_ell1)
+            r2 = Residuals(toas, m_dd)
+            r3 = Residuals(toas, m_back)
+        # ELL1 ignores O(e^2) terms; for e~2e-5 agreement ~ x*e^2 ~ 1.6ps
+        assert np.max(np.abs(r2.time_resids - r1.time_resids)) < 1e-8
+        assert np.max(np.abs(r3.time_resids - r1.time_resids)) < 1e-10
+        # parameter round trip
+        assert float(m_back.EPS1.value) == pytest.approx(-5.7e-6, rel=1e-6)
+        assert float(m_back.EPS2.value) == pytest.approx(-1.89e-5, rel=1e-6)
+
+    def test_ell1_to_ell1h_orthometric(self):
+        from pint_tpu import Tsun
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = load(PAR_ELL1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mh = convert_binary(m, "ELL1H")
+        assert mh.BINARY.value == "ELL1H"
+        sini = 0.99
+        cbar = np.sqrt(1 - sini**2)
+        stig = sini / (1 + cbar)
+        assert float(mh.STIGMA.value) == pytest.approx(stig, rel=1e-12)
+        assert float(mh.H3.value) == pytest.approx(
+            Tsun * 0.25 * stig**3, rel=1e-12)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m_back = convert_binary(mh, "ELL1")
+        assert float(m_back.M2.value) == pytest.approx(0.25, rel=1e-10)
+        assert float(m_back.SINI.value) == pytest.approx(0.99, rel=1e-10)
+
+    def test_dd_to_dds_shapmax(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = load(PAR_ELL1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mdds = convert_binary(m, "DDS")
+        assert mdds.BINARY.value == "DDS"
+        assert float(mdds.SHAPMAX.value) == pytest.approx(
+            -np.log(1 - 0.99), rel=1e-12)
+
+    def test_unknown_target_rejected(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        with pytest.raises(ValueError, match="unsupported"):
+            convert_binary(load(PAR_ELL1), "DDGR")
+
+    def test_secular_terms_roundtrip(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        par = PAR_ELL1 + "EPS1DOT 3e-17\nEPS2DOT -1e-17\n"
+        m = load(par)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mdd = convert_binary(m, "DD")
+            assert mdd.EDOT.value is not None
+            assert mdd.OMDOT.value is not None
+            m_back = convert_binary(mdd, "ELL1")
+        assert float(m_back.EPS1DOT.value) == pytest.approx(3e-17,
+                                                            rel=1e-9)
+        assert float(m_back.EPS2DOT.value) == pytest.approx(-1e-17,
+                                                            rel=1e-9)
+
+    def test_ell1_to_ell1k(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        par = PAR_ELL1 + "EPS1DOT 3e-17\nEPS2DOT -1e-17\n"
+        m = load(par)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mk = convert_binary(m, "ELL1K")
+            assert mk.BINARY.value == "ELL1K"
+            assert mk.OMDOT.value is not None
+            assert mk.LNEDOT.value is not None
+            m_back = convert_binary(mk, "ELL1")
+        assert float(m_back.EPS1DOT.value) == pytest.approx(3e-17,
+                                                            rel=1e-9)
+
+    def test_h3_h4_mode_converts(self):
+        from pint_tpu import Tsun
+        from pint_tpu.binaryconvert import convert_binary
+
+        sini, m2 = 0.99, 0.25
+        cbar = np.sqrt(1 - sini**2)
+        stig = sini / (1 + cbar)
+        h3 = Tsun * m2 * stig**3
+        par = PAR_ELL1.replace("M2 0.25\nSINI 0.99\n", "") \
+            .replace("BINARY ELL1", "BINARY ELL1H") + \
+            f"H3 {h3:.15g}\nH4 {h3 * stig:.15g}\n"
+        m = load(par)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mdd = convert_binary(m, "DD")
+        assert float(mdd.M2.value) == pytest.approx(m2, rel=1e-9)
+        assert float(mdd.SINI.value) == pytest.approx(sini, rel=1e-9)
+
+    def test_h3_only_rejected(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        par = PAR_ELL1.replace("M2 0.25\nSINI 0.99\n", "") \
+            .replace("BINARY ELL1", "BINARY ELL1H") + "H3 2.7e-7\n"
+        m = load(par)
+        with pytest.raises(ValueError, match="H3 alone"):
+            convert_binary(m, "DD")
+
+
+class TestSimulationNoise:
+    def test_correlated_noise_realization(self):
+        from pint_tpu.toa import merge_TOAs
+
+        par = PAR_ELL1 + "ECORR -fe R1 1.5\n"
+        model = load(par)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            # ECORR needs observing epochs (>=2 TOAs within seconds);
+            # merge two interleaved sets 0.5 s apart
+            t1 = make_fake_toas_uniform(54900, 55100, 20, model,
+                                        obs="gbt", add_noise=False)
+            t2 = make_fake_toas_uniform(54900 + 0.5 / 86400,
+                                        55100 + 0.5 / 86400, 20, model,
+                                        obs="gbt", add_noise=False)
+            toas = merge_TOAs([t1, t2])
+            for fl in toas.flags:
+                fl["fe"] = "R1"
+            toas = add_correlated_noise(toas, model, seed=2)
+            r = Residuals(toas, model)
+        rms_us = np.std(r.time_resids) * 1e6
+        # ECORR of 1.5 us should produce ~us-level structure
+        assert 0.2 < rms_us < 6.0
+
+    def test_random_models_spread_matches_covariance(self):
+        from pint_tpu.fitter import WLSFitter
+
+        model = load(PAR_ELL1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(54900, 55100, 40, model,
+                                          obs="gbt", error_us=1.0,
+                                          add_noise=True, seed=8)
+            f = WLSFitter(toas, model)
+            f.fit_toas(maxiter=3)
+            dt, draws = calculate_random_models(f, toas, Nmodels=60,
+                                                seed=3, return_time=True)
+        assert dt.shape == (60, toas.ntoas)
+        # deviations should be comparable to the residual uncertainties:
+        # ~1 us within the fitted span
+        spread_us = np.std(dt, axis=0).mean() * 1e6
+        assert 0.05 < spread_us < 10.0
