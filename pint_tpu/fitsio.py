@@ -1,0 +1,189 @@
+"""Minimal from-scratch FITS reader: headers + binary tables.
+
+The reference reads photon-event files through `astropy.io.fits`
+(`/root/reference/src/pint/event_toas.py:195`); this environment has no
+astropy, and event files only need a small subset of FITS: 2880-byte
+blocks of 80-character header cards, then big-endian binary-table (or
+image) data.  Supports the TFORM codes mission event files use
+(L/B/I/J/K/E/D, with repeat counts) plus header-only access for the
+timing keywords (MJDREF*, TIMESYS, TIMEZERO, TELESCOP, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["read_fits", "FITSHDU"]
+
+BLOCK = 2880
+CARD = 80
+
+#: TFORM letter -> (numpy big-endian dtype, bytes)
+_TFORM = {
+    "L": (">u1", 1), "B": (">u1", 1), "I": (">i2", 2), "J": (">i4", 4),
+    "K": (">i8", 8), "E": (">f4", 4), "D": (">f8", 8),
+}
+
+#: element widths of codes we can skip over but not decode
+_SKIP_WIDTH = {"X": None, "C": 8, "M": 16, "P": 8, "Q": 16}
+
+
+def _column_bytes(repeat: int, code: str) -> int:
+    if code == "A":
+        return repeat
+    if code == "X":                  # bit array: ceil(repeat/8) bytes
+        return (repeat + 7) // 8
+    if code in _SKIP_WIDTH:
+        return repeat * _SKIP_WIDTH[code]
+    if code in _TFORM:
+        return repeat * _TFORM[code][1]
+    raise ValueError(f"unsupported FITS TFORM code {code!r}")
+
+
+class FITSHDU:
+    """One header-data unit: header dict + (for BINTABLE) column arrays."""
+
+    def __init__(self, header: Dict[str, object],
+                 data: Optional[Dict[str, np.ndarray]] = None):
+        self.header = header
+        self.data = data or {}
+
+    @property
+    def name(self) -> str:
+        return str(self.header.get("EXTNAME", "")).strip()
+
+    def __getitem__(self, col: str) -> np.ndarray:
+        return self.data[col.upper()]
+
+    def __contains__(self, col: str) -> bool:
+        return col.upper() in self.data
+
+
+def _parse_card(card: bytes):
+    """One 80-byte header card -> (key, value) or None."""
+    s = card.decode("ascii", errors="replace")
+    key = s[:8].strip()
+    if not key or key in ("COMMENT", "HISTORY", "END"):
+        return None
+    if s[8:10] != "= ":
+        return None
+    body = s[10:]
+    # strip inline comment (outside quoted strings)
+    if body.lstrip().startswith("'"):
+        start = body.index("'")
+        end = body.index("'", start + 1)
+        # FITS doubles quotes inside strings; rare in practice
+        while end + 1 < len(body) and body[end + 1] == "'":
+            end = body.index("'", end + 2)
+        val: object = body[start + 1:end].rstrip()
+    else:
+        body = body.split("/")[0].strip()
+        if body in ("T", "F"):
+            val = body == "T"
+        else:
+            try:
+                val = int(body)
+            except ValueError:
+                try:
+                    val = float(body)
+                except ValueError:
+                    val = body
+    return key, val
+
+
+def _read_header(f) -> Optional[Dict[str, object]]:
+    header: Dict[str, object] = {}
+    while True:
+        block = f.read(BLOCK)
+        if len(block) < BLOCK:
+            return None if not header else header
+        for i in range(0, BLOCK, CARD):
+            card = block[i:i + CARD]
+            if card.startswith(b"END"):
+                return header
+            kv = _parse_card(card)
+            if kv:
+                header[kv[0]] = kv[1]
+
+
+def _data_size(header) -> int:
+    naxis = int(header.get("NAXIS", 0))
+    if naxis == 0:
+        return 0
+    size = abs(int(header.get("BITPIX", 8))) // 8
+    for i in range(1, naxis + 1):
+        size *= int(header.get(f"NAXIS{i}", 0))
+    size *= int(header.get("GCOUNT", 1))
+    size += int(header.get("PCOUNT", 0))
+    return size
+
+
+def _parse_tform(tform: str) -> Tuple[int, str]:
+    tform = str(tform).strip()
+    i = 0
+    while i < len(tform) and tform[i].isdigit():
+        i += 1
+    repeat = int(tform[:i]) if i else 1
+    return repeat, tform[i] if i < len(tform) else tform[0]
+
+
+def _read_bintable(header, raw: bytes) -> Dict[str, np.ndarray]:
+    nrow = int(header["NAXIS2"])
+    rowbytes = int(header["NAXIS1"])
+    nfields = int(header["TFIELDS"])
+    cols: List[Tuple[str, int, str, int]] = []   # (name, repeat, code, off)
+    off = 0
+    for i in range(1, nfields + 1):
+        name = str(header.get(f"TTYPE{i}", f"COL{i}")).strip().upper()
+        repeat, code = _parse_tform(header[f"TFORM{i}"])
+        cols.append((name, repeat, code, off))
+        off += _column_bytes(repeat, code)
+    if off != rowbytes:
+        raise ValueError(
+            f"binary table row size mismatch: {off} != NAXIS1={rowbytes}")
+    table = np.frombuffer(raw[:nrow * rowbytes], dtype=np.uint8)
+    table = table.reshape(nrow, rowbytes)
+    out = {}
+    for name, repeat, code, off in cols:
+        if code == "A":
+            chunk = table[:, off:off + repeat]
+            out[name] = np.array(
+                [bytes(r).decode("ascii", "replace").rstrip()
+                 for r in chunk])
+            continue
+        if code in _SKIP_WIDTH:
+            # bit arrays / complex / variable-length descriptors: skipped
+            # (row layout stays intact so the other columns still parse)
+            continue
+        dtype, width = _TFORM[code]
+        chunk = table[:, off:off + repeat * width].copy()
+        arr = chunk.view(dtype).reshape(nrow, repeat)
+        if code == "L":              # FITS logicals are ASCII 'T'/'F'
+            arr = arr == ord("T")
+        out[name] = arr[:, 0] if repeat == 1 else arr
+    return out
+
+
+def read_fits(path: str) -> List[FITSHDU]:
+    """Read all HDUs; binary-table extensions get parsed column data."""
+    hdus = []
+    with open(path, "rb") as f:
+        while True:
+            header = _read_header(f)
+            if header is None:
+                break
+            size = _data_size(header)
+            padded = ((size + BLOCK - 1) // BLOCK) * BLOCK
+            raw = f.read(padded)
+            if len(raw) < padded and size > 0:
+                raise ValueError("truncated FITS data unit")
+            xt = str(header.get("XTENSION", "")).strip()
+            if xt == "BINTABLE":
+                hdus.append(FITSHDU(header, _read_bintable(header, raw)))
+            else:
+                hdus.append(FITSHDU(header))
+    if not hdus:
+        raise ValueError(f"{path} is not a FITS file (no HDUs)")
+    return hdus

@@ -42,9 +42,10 @@ def parse_number(s: str) -> float:
 
 def _fmt(x: float) -> str:
     """Repr-exact but compact float formatting for par output."""
-    if x == int(x) and abs(x) < 1e16:
+    x = float(x)
+    if math.isfinite(x) and x == int(x) and abs(x) < 1e16:
         return str(int(x)) + ".0"
-    return repr(float(x))
+    return repr(x)
 
 
 class Param:

@@ -1,0 +1,128 @@
+"""Optimize timing parameters against photon events with a template.
+
+Reference: `event_optimize`
+(`/root/reference/src/pint/scripts/event_optimize.py`): sample the
+posterior of the timing parameters where the likelihood is the photon
+template density at each event's phase, via emcee.  Here the
+photon-phase log-likelihood is a single jitted function of the parameter
+vector — template lookup included — and the device ensemble sampler
+(`pint_tpu.mcmc`) replaces emcee.
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main", "build_photon_lnpost"]
+
+
+def build_photon_lnpost(model, toas, template, weights=None):
+    """Jit-pure ``lnpost(dx) -> float`` over free-parameter offsets (par
+    units): sum_i ln( w f(phi_i) + 1-w ) with phi from the full timing
+    model, plus the priors from `default_prior_info`."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pint_tpu import qs
+    from pint_tpu.bayesian import default_prior_info, BayesianTiming
+    from pint_tpu.residuals import Residuals
+
+    bt_info = default_prior_info(model)
+    bt = BayesianTiming(model, toas, prior_info=bt_info)
+    r = bt.resids
+    calc = model.calc
+    names = bt.param_labels
+    units = jnp.asarray(bt._units)
+    p0 = r.pdict
+    batch = r.batch
+    w = jnp.ones(batch.ntoas) if weights is None else \
+        jnp.asarray(np.asarray(weights, np.float64))
+    tmpl_fn = template._eval_fn()
+    x_tmpl = jnp.asarray(template.get_parameters())
+    lnprior = bt.lnprior_fn
+    refs = jnp.asarray(bt.start_point())
+
+    def lnpost(dx):
+        p = model.with_x(p0, dx * units, names)
+        ph = calc.phase(p, batch)
+        _, frac = qs.round_nearest(ph)
+        phases = qs.to_f64(frac) % 1.0
+        vals = tmpl_fn(phases, x_tmpl)
+        ll = jnp.sum(jnp.log(w * vals + (1.0 - w)))
+        lp = lnprior(refs + dx)
+        return jnp.where(jnp.isfinite(lp), ll + lp, -jnp.inf)
+
+    return lnpost, bt
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu photon-event timing sampler "
+                    "(cf. event_optimize)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("eventfile")
+    parser.add_argument("parfile")
+    parser.add_argument("gaussfile", nargs="?", default=None,
+                        help="optional: fit a 1-Gaussian template if "
+                             "absent")
+    parser.add_argument("--nwalkers", type=int, default=16)
+    parser.add_argument("--nsteps", type=int, default=500)
+    parser.add_argument("--burn", type=int, default=250)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--outfile", default=None,
+                        help="write the post-fit par here")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    import numpy as np
+
+    from pint_tpu import qs
+    from pint_tpu.event_toas import get_event_TOAs
+    from pint_tpu.mcmc import ensemble_sample
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.templates import LCGaussian, LCTemplate, fit_template
+
+    model = get_model(args.parfile)
+    toas = get_event_TOAs(args.eventfile)
+    print(f"Read {toas.ntoas} photons")
+
+    r = Residuals(toas, model, subtract_mean=False)
+    ph = model.calc.phase(r.pdict, r.batch)
+    _, frac = qs.round_nearest(ph)
+    phases = np.asarray(qs.to_f64(frac)) % 1.0
+    template = LCTemplate([LCGaussian(float(np.median(phases)), 0.05)],
+                          [0.5])
+    template, lnl = fit_template(template, phases)
+    print(f"Template: peak at {template.primitives[0].loc:.4f}, width "
+          f"{template.primitives[0].width:.4f}, lnL={lnl:.1f}")
+
+    lnpost, bt = build_photon_lnpost(model, toas, template)
+    rng = np.random.default_rng(args.seed)
+    nw = args.nwalkers + (args.nwalkers % 2)
+    dx0 = rng.standard_normal((nw, bt.nparams)) * \
+        bt.scales()[None, :] * 0.1
+    res = ensemble_sample(lnpost, dx0, args.nsteps, seed=args.seed)
+    flat = res.chain[args.burn:].reshape(-1, bt.nparams)
+    refs = bt.start_point()
+    print(f"acceptance {res.acceptance:.2f}")
+    for i, n in enumerate(bt.param_labels):
+        mean = refs[i] + flat[:, i].mean()
+        std = flat[:, i].std()
+        par = model[n]
+        if hasattr(par, "set_value"):
+            par.set_value(float(mean))
+        else:
+            par.value = float(mean)
+        par.uncertainty = float(std)
+        print(f"  {n:12s} {mean:.12g} +/- {std:.3g}")
+    if args.outfile:
+        model.write_parfile(args.outfile)
+        print(f"Wrote {args.outfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Compute model phases for photon events.
+
+Reference: `photonphase` (`/root/reference/src/pint/scripts/photonphase.py`):
+load an event file + par file, compute each photon's pulse phase, report
+the H-test, optionally write phases out.
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu photon phases (cf. photonphase)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("eventfile", help="FITS event file (barycentered "
+                                          "or geocentric)")
+    parser.add_argument("parfile")
+    parser.add_argument("--ephem", default="DE421")
+    parser.add_argument("--planets", action="store_true")
+    parser.add_argument("--minMJD", type=float, default=None)
+    parser.add_argument("--maxMJD", type=float, default=None)
+    parser.add_argument("--outfile", default=None,
+                        help="write 'MJD phase' rows to this file")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    import numpy as np
+
+    from pint_tpu import qs
+    from pint_tpu.event_toas import get_event_TOAs
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.templates import hm, sf_hm
+
+    model = get_model(args.parfile)
+    kw = {}
+    if args.minMJD is not None:
+        kw["minmjd"] = args.minMJD
+    if args.maxMJD is not None:
+        kw["maxmjd"] = args.maxMJD
+    toas = get_event_TOAs(args.eventfile, ephem=args.ephem,
+                          planets=args.planets, **kw)
+    print(f"Read {toas.ntoas} photons from {args.eventfile}")
+    r = Residuals(toas, model, subtract_mean=False)
+    ph = model.calc.phase(r.pdict, r.batch)
+    _, frac = qs.round_nearest(ph)
+    phases = np.asarray(qs.to_f64(frac)) % 1.0
+    h = hm(phases)
+    print(f"Htest: {h:.2f} (sig ~ {sf_hm(h):.3g})")
+    if args.outfile:
+        mjds = np.asarray(toas.utc.mjd_float)
+        with open(args.outfile, "w") as f:
+            f.write("# MJD phase\n")
+            for m, p in zip(mjds, phases):
+                f.write(f"{m:.12f} {p:.9f}\n")
+        print(f"Wrote phases to {args.outfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -360,6 +360,39 @@ class TOAs:
             return None
         return np.array([float(f.get("pn", np.nan)) for f in self.flags])
 
+    @classmethod
+    def from_columns(cls, utc: MJD, error_us, freq_mhz, obs,
+                     flags: Optional[List[Dict[str, str]]] = None,
+                     filename: Optional[str] = None) -> "TOAs":
+        """Column-wise construction, bypassing per-row TOA objects —
+        photon-event files carry 1e6-1e7 rows where the per-row path
+        costs minutes of pure python."""
+        self = cls.__new__(cls)
+        self.filename = filename
+        self.commands = []
+        self.ephem = None
+        self.planets = False
+        self.clock_corr_info = {}
+        n = len(utc.day)
+        self.utc = MJD(np.asarray(utc.day, np.int64),
+                       np.asarray(utc.frac, np.float64))
+        self.error_us = np.broadcast_to(
+            np.asarray(error_us, np.float64), (n,)).copy()
+        self.freq_mhz = np.broadcast_to(
+            np.asarray(freq_mhz, np.float64), (n,)).copy()
+        self.obs = (np.full(n, obs) if isinstance(obs, str)
+                    else np.asarray(obs))
+        self.flags = flags if flags is not None else [{} for _ in range(n)]
+        if len(self.flags) != n:
+            raise ValueError("flags list length mismatch")
+        self.tdb = None
+        self.ssb_obs_pos = None
+        self.ssb_obs_vel = None
+        self.obs_sun_pos = None
+        self.obs_planet_pos = {}
+        self.index = np.arange(n)
+        return self
+
     @property
     def is_wideband(self) -> bool:
         """True when any TOA carries a ``-pp_dm`` wideband DM measurement
