@@ -110,15 +110,12 @@ def load_fits_TOAs(eventfile: str, extname: str = "EVENTS",
     if len(idx) == 0:
         raise ValueError("no events inside [minmjd, maxmjd]")
     sel = mjdmod.MJD(np.asarray(times.day)[idx], np.asarray(times.frac)[idx])
-    flags: list = [{} for _ in idx]
-    if energies is not None:
-        for f, e in zip(flags, energies[idx]):
-            f["energy"] = repr(float(e))
-    if weights is not None:
-        for f, w in zip(flags, weights[idx]):
-            f["weight"] = repr(float(w))
-    return TOAs.from_columns(sel, 0.0, np.inf, obs, flags=flags,
-                             filename=eventfile)
+    out = TOAs.from_columns(sel, 0.0, np.inf, obs, filename=eventfile)
+    # per-photon columns stay vectorized (a dict-of-strings per photon
+    # would cost minutes + GBs at 1e7 events); TOAs.select carries them
+    out.energies = None if energies is None else energies[idx]
+    out.weights = None if weights is None else weights[idx]
+    return out
 
 
 def load_event_TOAs(eventfile: str, mission: str = "",

@@ -48,6 +48,8 @@ from pint_tpu.models import (  # noqa: F401  isort:skip
     solar_system_shapiro,
     solar_wind,
     spindown,
+    transient_events,
+    troposphere,
     wave,
 )
 from pint_tpu.models.model_builder import (  # noqa: F401  isort:skip

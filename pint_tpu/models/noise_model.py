@@ -342,6 +342,7 @@ class PLRedNoise(NoiseComponent):
     category = "pl_red_noise"
     introduces_correlated_errors = True
     is_time_correlated = True
+    _TSPAN = "TNREDTSPAN"
 
     def __init__(self):
         super().__init__()
@@ -383,8 +384,9 @@ class PLRedNoise(NoiseComponent):
 
     def _freqs(self, toas) -> np.ndarray:
         t = np.asarray(toas.tdb.mjd_float) * SECS_PER_DAY
-        if self.TNREDTSPAN.value is not None:
-            T = self.TNREDTSPAN.value * 365.25 * SECS_PER_DAY
+        tspan = self.params[self._TSPAN].value
+        if tspan is not None:
+            T = tspan * 365.25 * SECS_PER_DAY
         else:
             T = t.max() - t.min()
         return np.arange(1, self.nmodes() + 1) / T
@@ -392,6 +394,11 @@ class PLRedNoise(NoiseComponent):
     @property
     def freqs_pytree_name(self) -> str:
         return f"__noisefreqs_{type(self).__name__}__"
+
+    def chromatic_scale(self, toas) -> np.ndarray:
+        """Per-TOA basis scaling; 1 for achromatic red noise, overridden
+        by the DM/chromatic flavors."""
+        return np.ones(toas.ntoas)
 
     def basis_entries(self, toas) -> dict:
         """Fourier design matrix (sin/cos alternating, reference
@@ -401,13 +408,14 @@ class PLRedNoise(NoiseComponent):
         in place)."""
         t = np.asarray(toas.tdb.mjd_float) * SECS_PER_DAY
         key = (toas.ntoas, hash(t.tobytes()), self.nmodes(),
-               self.TNREDTSPAN.value)
+               self.params[self._TSPAN].value)
         if self._basis_cache and self._basis_cache[0] == key:
             return self._basis_cache[1]
         f = self._freqs(toas)
         F = np.zeros((toas.ntoas, 2 * len(f)))
         F[:, 0::2] = np.sin(2.0 * math.pi * t[:, None] * f)
         F[:, 1::2] = np.cos(2.0 * math.pi * t[:, None] * f)
+        F *= self.chromatic_scale(toas)[:, None]
         out = {self.basis_pytree_name: F, self.freqs_pytree_name: f}
         self._basis_cache = (key, out)
         return out
@@ -421,3 +429,81 @@ class PLRedNoise(NoiseComponent):
         df = jnp.diff(jnp.concatenate([jnp.zeros(1), f]))
         psd = powerlaw_psd(jnp.repeat(f, 2), amp, gam)
         return psd * jnp.repeat(df, 2)
+
+
+class _PLChromaticBase(PLRedNoise):
+    """Shared machinery for DM/chromatic power-law Gaussian-process noise:
+    the same Fourier time basis, with columns scaled per TOA by
+    (1400 MHz / f)^alpha so the amplitude is referenced to 1400 MHz
+    (reference `PLDMNoise`/`PLChromNoise`,
+    `/root/reference/src/pint/models/noise_model.py:441,590`)."""
+
+    register = False
+    #: (amp, gamma, nmodes, tspan) parameter spellings per flavor
+    _AMP = "TNDMAMP"
+    _GAM = "TNDMGAM"
+    _C = "TNDMC"
+    _TSPAN = "TNDMTSPAN"
+
+    def __init__(self):
+        Component.__init__(self)
+        self.add_param(FloatParam(self._AMP, units="",
+                                  description="log10 GP amplitude"))
+        self.add_param(FloatParam(self._GAM, units="",
+                                  description="GP spectral index"))
+        self.add_param(IntParam(self._C, value=30, units="",
+                                description="number of Fourier modes"))
+        self.add_param(FloatParam(self._TSPAN, units="yr",
+                                  description="fundamental-period override"))
+        self._basis_cache = ()
+
+    def validate(self):
+        if self.params[self._AMP].value is None or \
+                self.params[self._GAM].value is None:
+            from pint_tpu.exceptions import MissingParameter
+
+            raise MissingParameter(
+                f"{type(self).__name__} needs {self._AMP} and {self._GAM}")
+
+    def nmodes(self) -> int:
+        v = self.params[self._C].value
+        return int(v) if v is not None else 30
+
+    def amp_gamma(self, p: dict):
+        return 10.0 ** pv(p, self._AMP), pv(p, self._GAM)
+
+    def chromatic_alpha(self) -> float:
+        return 2.0
+
+    def chromatic_scale(self, toas) -> np.ndarray:
+        f = np.asarray(toas.freq_mhz, np.float64)
+        finite = np.isfinite(f)
+        out = np.zeros(toas.ntoas)
+        out[finite] = (1400.0 / f[finite]) ** self.chromatic_alpha()
+        return out
+
+
+class PLDMNoise(_PLChromaticBase):
+    """Power-law DM noise (amplitude referenced to 1400 MHz; reference
+    `PLDMNoise`, `noise_model.py:441`)."""
+
+    register = True
+    category = "pl_dm_noise"
+    _AMP, _GAM, _C = "TNDMAMP", "TNDMGAM", "TNDMC"
+    _TSPAN = "TNDMTSPAN"
+
+
+class PLChromNoise(_PLChromaticBase):
+    """Power-law chromatic noise with index TNCHROMIDX from the model's
+    ChromaticCM (reference `PLChromNoise`, `noise_model.py:590`)."""
+
+    register = True
+    category = "pl_chrom_noise"
+    _AMP, _GAM, _C = "TNCHROMAMP", "TNCHROMGAM", "TNCHROMC"
+    _TSPAN = "TNCHROMTSPAN"
+
+    def chromatic_alpha(self) -> float:
+        if self._parent is not None and "TNCHROMIDX" in self._parent and \
+                self._parent.TNCHROMIDX.value is not None:
+            return float(self._parent.TNCHROMIDX.value)
+        return 4.0

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 
 from pint_tpu import AU, c as C
 from pint_tpu.models.dispersion import dispersion_delay
+import numpy as np
+
 from pint_tpu.models.parameter import FloatParam, MJDParam, prefixParameter, split_prefix
 from pint_tpu.models.timing_model import DelayComponent, epoch_days, pv
 from pint_tpu.toabatch import TOABatch
@@ -116,6 +118,118 @@ class SolarWindDispersion(DelayComponent):
         psr_dir = self._astrometry().psr_dir(p, batch)
         geom = solar_wind_geometry_pc(batch.obs_sun_pos_ls, psr_dir)
         return self.ne_sw_value(p, batch) * geom
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return dispersion_delay(self.dm_value(p, batch), batch.freq_mhz)
+
+
+#: J2000 mean obliquity [rad] — the ecliptic pole for elongation extremes
+_ECL_POLE = (0.0, -0.3977771559319137, 0.9174820620691818)
+
+
+class SolarWindDispersionX(DelayComponent):
+    """Piecewise solar-wind DM amplitudes over MJD ranges (SWXDM_####/
+    SWXP_####/SWXR1/SWXR2; reference `SolarWindDispersionX`,
+    `/root/reference/src/pint/models/solar_wind_dispersion.py:608`).
+
+    Each range scales the normalized solar-wind geometry so SWXDM is the
+    maximum (conjunction-to-opposition) DM excursion in that window:
+
+        DM(t) = SWXDM * (g(t) - g_opp) / (g_conj - g_opp)
+
+    Only SWXP = 2 (the spherically-symmetric 1/r^2 wind) is supported,
+    like the base component.  The conjunction/opposition geometries follow
+    from the pulsar's ecliptic latitude, computed on device from the
+    astrometry direction — differentiable in the position parameters.
+    """
+
+    register = True
+    category = "solar_windx"
+
+    def prefix_families(self):
+        return ["SWXDM_", "SWXP_", "SWXR1_", "SWXR2_"]
+
+    def swx_names(self):
+        return [p.name for p in self.prefix_params("SWXDM_")]
+
+    def add_swx_range(self, index: int, r1_mjd, r2_mjd, swxdm=0.0,
+                      swxp=2.0, frozen=True):
+        self.add_param(prefixParameter("float", f"SWXDM_{index:04d}",
+                                       units="pc cm^-3", value=swxdm,
+                                       frozen=frozen))
+        self.add_param(prefixParameter("float", f"SWXP_{index:04d}",
+                                       units="", value=swxp))
+        self.add_param(prefixParameter("mjd", f"SWXR1_{index:04d}",
+                                       value=r1_mjd))
+        self.add_param(prefixParameter("mjd", f"SWXR2_{index:04d}",
+                                       value=r2_mjd))
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == "SWXDM_":
+            return prefixParameter("float", name, units="pc cm^-3")
+        if prefix == "SWXP_":
+            return prefixParameter("float", name, units="")
+        if prefix in ("SWXR1_", "SWXR2_"):
+            return prefixParameter("mjd", name)
+        return None
+
+    def validate(self):
+        for n in self.swx_names():
+            idx = n.split("_")[1]
+            for stem in ("SWXR1_", "SWXR2_"):
+                if f"{stem}{idx}" not in self.params:
+                    raise ValueError(f"{n} needs {stem}{idx}")
+            pp = self.params.get(f"SWXP_{idx}")
+            if pp is not None and pp.value not in (None, 2.0):
+                raise ValueError(
+                    f"SWXP_{idx}={pp.value} is not supported (only p=2)")
+
+    def mask_entries(self, toas):
+        out = super().mask_entries(toas)
+        m = toas.utc.mjd_float
+        for n in self.swx_names():
+            idx = n.split("_")[1]
+            r1 = self.params[f"SWXR1_{idx}"].mjd_float
+            r2 = self.params[f"SWXR2_{idx}"].mjd_float
+            out[f"{n}__rangemask"] = ((m >= r1) & (m <= r2)).astype(np.float64)
+        return out
+
+    def _astrometry(self):
+        for comp in self._parent.components.values():
+            if hasattr(comp, "psr_dir"):
+                return comp
+        raise AttributeError(
+            "SolarWindDispersionX needs an astrometry component")
+
+    def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        names = self.swx_names()
+        if not names:
+            return jnp.zeros(batch.ntoas)
+        psr_dir = self._astrometry().psr_dir(p, batch)
+        g = solar_wind_geometry_pc(batch.obs_sun_pos_ls, psr_dir)
+        # elongation extremes from the ecliptic latitude (r = 1 au)
+        pole = jnp.asarray(_ECL_POLE)
+        sinb = jnp.clip(jnp.sum(psr_dir * pole, axis=1), -1.0, 1.0)
+        beta = jnp.abs(jnp.arcsin(sinb))
+        beta = jnp.clip(beta, 1e-6, jnp.pi / 2)
+
+        def geom_at(rho):
+            return AU_LS * rho / jnp.sin(rho) / PC_LS
+
+        g_conj = geom_at(jnp.pi - beta)
+        g_opp = geom_at(beta)
+        norm = (g - g_opp) / (g_conj - g_opp)
+        total = jnp.zeros(batch.ntoas)
+        for n in names:
+            mask = p["mask"].get(f"{n}__rangemask")
+            if mask is None:
+                continue
+            total = total + pv(p, n) * norm * mask
+        return total
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         return dispersion_delay(self.dm_value(p, batch), batch.freq_mhz)

@@ -63,6 +63,7 @@ DEFAULT_ORDER = [
     "troposphere",
     "solar_system_shapiro",
     "solar_wind",
+    "solar_windx",
     "dispersion_constant",
     "dispersion_dmx",
     "dispersion_jump",
@@ -70,6 +71,8 @@ DEFAULT_ORDER = [
     "chromatic_constant",
     "chromatic_cmx",
     "cmwavex",
+    "expdip",
+    "chromgauss",
     "pulsar_system",
     "frequency_dependent",
     "fdjump",

@@ -1,0 +1,194 @@
+"""Transient chromatic events: exponential dips and Gaussian bumps.
+
+Reference: `SimpleExponentialDip` / `ChromaticGaussianEvent`
+(`/root/reference/src/pint/models/transient_events.py:12,308`).  Both are
+frequency-scaled localized delay features (J1713+0747-style dip
+modeling):
+
+* exponential dip i:  -A_i (f/fref)^gamma_i S(t; tau_i, eps) with S a
+  smoothed one-sided exponential (logistic turn-on of width EXPDIPEPS,
+  peak normalized to 1);
+* Gaussian event i:  sign_i 10^logA_i exp(-dt^2/2 sigma_i^2)
+  (f/fref)^(-idx_i).
+
+Everything is closed-form jnp and differentiable in the amplitudes,
+timescales, and indices (the reference hand-writes five derivative
+functions per event type).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from pint_tpu.models.parameter import FloatParam, prefixParameter, split_prefix
+from pint_tpu.models.timing_model import DelayComponent, epoch_days, pv
+from pint_tpu.toabatch import TOABatch
+
+_DIP_FAMILIES = {
+    "EXPDIPEP_": ("mjd", "d"),
+    "EXPDIPAMP_": ("float", "s"),
+    "EXPDIPIDX_": ("float", ""),
+    "EXPDIPTAU_": ("float", "d"),
+}
+
+_GAUSS_FAMILIES = {
+    "CHROMGAUSS_EPOCH_": ("mjd", "d"),
+    "CHROMGAUSS_LOGAMP_": ("float", "log10(s)"),
+    "CHROMGAUSS_LOGSIG_": ("float", "log10(d)"),
+    "CHROMGAUSS_CHROMIDX_": ("float", ""),
+    "CHROMGAUSS_SIGN_": ("float", ""),
+}
+
+
+def _ffac(batch: TOABatch, fref_mhz):
+    finite = jnp.isfinite(batch.freq_mhz)
+    f = jnp.where(finite, batch.freq_mhz, fref_mhz)
+    return jnp.where(finite, f / fref_mhz, 1.0), finite
+
+
+class SimpleExponentialDip(DelayComponent):
+    """Chromatic exponential dip(s) in the residuals."""
+
+    register = True
+    category = "expdip"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(FloatParam(
+            "EXPDIPEPS", value=0.01, units="d",
+            description="dip turn-on smoothing timescale"))
+        self.add_param(FloatParam(
+            "EXPDIPFREF", value=1400.0, units="MHz",
+            description="reference frequency for dip amplitudes"))
+
+    def prefix_families(self):
+        return list(_DIP_FAMILIES)
+
+    def dip_indices(self) -> List[int]:
+        return sorted(p.index for p in self.prefix_params("EXPDIPEP_"))
+
+    def add_dip(self, index: int, epoch, amp=0.0, idx=2.0, tau=10.0,
+                frozen=True):
+        self.add_param(prefixParameter("mjd", f"EXPDIPEP_{index}",
+                                       value=epoch))
+        for stem, v in (("EXPDIPAMP_", amp), ("EXPDIPIDX_", idx),
+                        ("EXPDIPTAU_", tau)):
+            kind, units = _DIP_FAMILIES[stem]
+            self.add_param(prefixParameter(kind, f"{stem}{index}",
+                                           units=units, value=v,
+                                           frozen=frozen))
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        fam = _DIP_FAMILIES.get(prefix)
+        if fam is None:
+            return None
+        return prefixParameter(fam[0], name, units=fam[1])
+
+    def validate(self):
+        for i in self.dip_indices():
+            for stem in ("EXPDIPAMP_", "EXPDIPTAU_"):
+                par = self.params.get(f"{stem}{i}")
+                if par is None or par.value is None:
+                    raise ValueError(f"EXPDIPEP_{i} needs {stem}{i}")
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        total = jnp.zeros(batch.ntoas)
+        idx = self.dip_indices()
+        if not idx:
+            return total
+        ffac, _ = _ffac(batch, pv(p, "EXPDIPFREF"))
+        eps = pv(p, "EXPDIPEPS")
+        t = batch.tdb_day + batch.tdb_frac
+        for i in idx:
+            dt = t - epoch_days(p, f"EXPDIPEP_{i}")
+            A = pv(p, f"EXPDIPAMP_{i}")
+            gamma = pv(p, f"EXPDIPIDX_{i}")
+            tau = pv(p, f"EXPDIPTAU_{i}")
+            # overflow-safe smoothed one-sided exponential
+            # (reference transient_events.py:229-235)
+            pos = dt >= 0.0
+            dtp = jnp.where(pos, dt, 0.0)
+            dtn = jnp.where(pos, 0.0, dt)
+            expfac = jnp.where(
+                pos,
+                jnp.exp(-dtp / tau) / (1.0 + jnp.exp(-dtp / eps)),
+                jnp.exp(dtn * (tau - eps) / (tau * eps)) /
+                (1.0 + jnp.exp(dtn / eps)))
+            peak_norm = (tau / eps) ** (eps / tau) * \
+                (tau / (tau - eps)) ** ((tau - eps) / tau)
+            total = total - A * ffac**gamma * peak_norm * expfac
+        return total
+
+
+class ChromaticGaussianEvent(DelayComponent):
+    """Chromatic Gaussian bump(s) in the residuals."""
+
+    register = True
+    category = "chromgauss"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(FloatParam(
+            "CHROMGAUSSFREF", value=1400.0, units="MHz",
+            description="reference frequency for event amplitudes"))
+
+    def prefix_families(self):
+        return list(_GAUSS_FAMILIES)
+
+    def event_indices(self) -> List[int]:
+        return sorted(p.index
+                      for p in self.prefix_params("CHROMGAUSS_EPOCH_"))
+
+    def add_event(self, index: int, epoch, log10_amp=-6.0, log10_sig=1.0,
+                  chromidx=2.0, sign=1.0, frozen=True):
+        self.add_param(prefixParameter("mjd", f"CHROMGAUSS_EPOCH_{index}",
+                                       value=epoch))
+        for stem, v in (("CHROMGAUSS_LOGAMP_", log10_amp),
+                        ("CHROMGAUSS_LOGSIG_", log10_sig),
+                        ("CHROMGAUSS_CHROMIDX_", chromidx),
+                        ("CHROMGAUSS_SIGN_", sign)):
+            kind, units = _GAUSS_FAMILIES[stem]
+            self.add_param(prefixParameter(kind, f"{stem}{index}",
+                                           units=units, value=v,
+                                           frozen=frozen))
+
+    def make_param(self, name):
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        fam = _GAUSS_FAMILIES.get(prefix)
+        if fam is None:
+            return None
+        return prefixParameter(fam[0], name, units=fam[1])
+
+    def validate(self):
+        for i in self.event_indices():
+            for stem in ("CHROMGAUSS_LOGAMP_", "CHROMGAUSS_LOGSIG_"):
+                par = self.params.get(f"{stem}{i}")
+                if par is None or par.value is None:
+                    raise ValueError(
+                        f"CHROMGAUSS_EPOCH_{i} needs {stem}{i}")
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        total = jnp.zeros(batch.ntoas)
+        idx = self.event_indices()
+        if not idx:
+            return total
+        ffac, _ = _ffac(batch, pv(p, "CHROMGAUSSFREF"))
+        t = batch.tdb_day + batch.tdb_frac
+        for i in idx:
+            dt = t - epoch_days(p, f"CHROMGAUSS_EPOCH_{i}")
+            sigma = 10.0 ** pv(p, f"CHROMGAUSS_LOGSIG_{i}")
+            amp = 10.0 ** pv(p, f"CHROMGAUSS_LOGAMP_{i}")
+            sign = pv(p, f"CHROMGAUSS_SIGN_{i}")
+            chromidx = pv(p, f"CHROMGAUSS_CHROMIDX_{i}")
+            total = total + sign * amp * \
+                jnp.exp(-0.5 * (dt / sigma) ** 2) * ffac ** (-chromidx)
+        return total

@@ -1,0 +1,199 @@
+"""Tropospheric propagation delay for topocentric TOAs.
+
+Reference: `TroposphereDelay`
+(`/root/reference/src/pint/models/troposphere_delay.py:20`):
+
+* Davis et al. (1985, App. A) hydrostatic zenith delay from the US
+  Standard Atmosphere pressure at the site altitude;
+* Niell (1996, eq. 4) hydrostatic mapping function — the continued-
+  fraction "Herring map" with latitude- and season-dependent
+  coefficients plus a height correction — scaling the zenith delay to
+  the source altitude;
+* wet zenith delay = 0 by default, exactly as the reference (and tempo2).
+
+The source altitude depends on time, site, and the (host) astrometry
+values, and is a pure geometry precompute: the per-TOA delay is built
+host-side in ``mask_entries`` and shipped as a pytree array — the
+reference caches the same quantity in its TOA table for the same reason
+(its calculation is slow and fit-independent, ibid:44-50).  The Niell
+coefficient tables are published geophysical data.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import c as C_LIGHT
+from pint_tpu.models.parameter import BoolParam
+from pint_tpu.models.timing_model import DelayComponent
+from pint_tpu.toabatch import TOABatch
+
+#: Niell (1996) hydrostatic coefficients at LAT grid (padded at poles)
+_LAT = np.array([0.0, 15.0, 30.0, 45.0, 60.0, 75.0, 90.0])
+_A_AVG = np.array([1.2769934, 1.2769934, 1.2683230, 1.2465397, 1.2196049,
+                   1.2045996, 1.2045996]) * 1e-3
+_B_AVG = np.array([2.9153695, 2.9153695, 2.9152299, 2.9288445, 2.9022565,
+                   2.9024912, 2.9024912]) * 1e-3
+_C_AVG = np.array([62.610505, 62.610505, 62.837393, 63.721774, 63.824265,
+                   64.258455, 64.258455]) * 1e-3
+_A_AMP = np.array([0.0, 0.0, 1.2709626, 2.6523662, 3.4000452, 4.1202191,
+                   4.1202191]) * 1e-5
+_B_AMP = np.array([0.0, 0.0, 2.1414979, 3.0160779, 7.2562722, 11.723375,
+                   11.723375]) * 1e-5
+_C_AMP = np.array([0.0, 0.0, 9.0128400, 4.3497037, 84.795348, 170.37206,
+                   170.37206]) * 1e-5
+_A_HT, _B_HT, _C_HT = 2.53e-5, 5.49e-3, 1.14e-3
+#: Niell wet coefficients (no seasonal term)
+_AW = np.array([5.8021897, 5.8021897, 5.6794847, 5.8118019, 5.9727542,
+                6.1641693, 6.1641693]) * 1e-4
+_BW = np.array([1.4275268, 1.4275268, 1.5138625, 1.4572752, 1.5007428,
+                1.7599082, 1.7599082]) * 1e-3
+_CW = np.array([4.3472961, 4.3472961, 4.6729510, 4.3908931, 4.4626982,
+                5.4736038, 5.4736038]) * 1e-2
+
+_DOY_OFFSET = -28.0     # phase of the seasonal term (reference ibid:96)
+_EARTH_R = 6356766.0    # m, US Standard Atmosphere reference radius
+
+
+def itrf_to_geodetic(xyz_m: np.ndarray):
+    """(lat_rad, lon_rad, height_m) from ITRF cartesian (WGS84,
+    iterative inverse of `pint_tpu.earth.geodetic_to_itrf`)."""
+    a = 6378137.0
+    f = 1.0 / 298.257223563
+    e2 = f * (2 - f)
+    x, y, z = xyz_m
+    lon = math.atan2(y, x)
+    p = math.hypot(x, y)
+    lat = math.atan2(z, p * (1 - e2))
+    for _ in range(5):
+        N = a / math.sqrt(1 - e2 * math.sin(lat) ** 2)
+        h = p / math.cos(lat) - N
+        lat = math.atan2(z, p * (1 - e2 * N / (N + h)))
+    N = a / math.sqrt(1 - e2 * math.sin(lat) ** 2)
+    h = p / math.cos(lat) - N
+    return lat, lon, h
+
+
+def _herring(sin_alt, a, b, c):
+    """Niell eq. 4 continued fraction, normalized to 1 at zenith."""
+    top = 1.0 + a / (1.0 + b / (1.0 + c))
+    bot = sin_alt + a / (sin_alt + b / (sin_alt + c))
+    return top / bot
+
+
+def _interp_lat(table: np.ndarray, abs_lat_deg: np.ndarray) -> np.ndarray:
+    return np.interp(abs_lat_deg, _LAT, table)
+
+
+def zenith_delay_sec(lat_rad: float, height_m: float) -> float:
+    """Davis hydrostatic zenith delay [s] from the standard-atmosphere
+    pressure at the site (reference ibid:255-268)."""
+    H = height_m
+    gph = _EARTH_R * H / (_EARTH_R + H)
+    T = 288.15 - 0.0065 * gph
+    p_kpa = 101.325 * (288.15 / T) ** -5.25575
+    return (p_kpa / 43.921) / (
+        C_LIGHT * (1 - 0.00266 * math.cos(2 * lat_rad)
+                   - 0.00028 * (H / 1000.0)))
+
+
+def niell_hydrostatic_map(alt_rad, lat_deg, height_m, year_frac):
+    """Niell hydrostatic mapping function with seasonal + height terms."""
+    abs_lat = np.abs(np.asarray(lat_deg, np.float64))
+    season = np.cos(2.0 * np.pi * year_frac) * np.where(
+        np.asarray(lat_deg) < 0, -1.0, 1.0)   # antiphase hemispheres
+    a = _interp_lat(_A_AVG, abs_lat) + _interp_lat(_A_AMP, abs_lat) * season
+    b = _interp_lat(_B_AVG, abs_lat) + _interp_lat(_B_AMP, abs_lat) * season
+    c = _interp_lat(_C_AVG, abs_lat) + _interp_lat(_C_AMP, abs_lat) * season
+    s = np.sin(np.asarray(alt_rad, np.float64))
+    s = np.clip(s, 0.05, None)          # guard below-horizon pathologies
+    m = _herring(s, a, b, c)
+    # height correction (Niell eq. 6)
+    dm = (1.0 / s - _herring(s, _A_HT, _B_HT, _C_HT)) * (height_m / 1000.0)
+    return m + dm
+
+
+def niell_wet_map(alt_rad, lat_deg):
+    abs_lat = np.abs(np.asarray(lat_deg, np.float64))
+    s = np.clip(np.sin(np.asarray(alt_rad, np.float64)), 0.05, None)
+    return _herring(s, _interp_lat(_AW, abs_lat),
+                    _interp_lat(_BW, abs_lat), _interp_lat(_CW, abs_lat))
+
+
+class TroposphereDelay(DelayComponent):
+    register = True
+    category = "troposphere"
+
+    PYTREE_NAME = "__tropo_delay__"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(BoolParam(
+            "CORRECT_TROPOSPHERE", value=True,
+            description="Enable the troposphere delay model"))
+
+    def _source_dir(self) -> np.ndarray:
+        """Unit vector to the source (GCRS) from the parent astrometry's
+        host values."""
+        for comp in self._parent.components.values():
+            if hasattr(comp, "psr_dir"):
+                # host-side evaluation: RAJ/DECJ (or ecliptic) radians
+                if "RAJ" in comp.params and comp.RAJ.value is not None:
+                    ra, dec = float(comp.RAJ.value), float(comp.DECJ.value)
+                    return np.array([math.cos(dec) * math.cos(ra),
+                                     math.cos(dec) * math.sin(ra),
+                                     math.sin(dec)])
+        raise AttributeError(
+            "TroposphereDelay needs equatorial astrometry (RAJ/DECJ)")
+
+    def mask_entries(self, toas) -> Dict[str, np.ndarray]:
+        """Per-TOA tropospheric delay [s], host-precomputed (the source
+        altitude geometry is fit-independent, as in the reference's TOA-
+        table cache)."""
+        from pint_tpu import mjd as mjdmod
+        from pint_tpu.earth import itrf_to_gcrs_matrix
+        from pint_tpu.observatory import get_observatory
+
+        out = super().mask_entries(toas)
+        n = toas.ntoas
+        delay = np.zeros(n)
+        src = self._source_dir()
+        tt = mjdmod.utc_to_tt(toas.utc).mjd_float
+        ut1 = toas.utc.mjd_float            # UT1 ~ UTC well within 1 s
+        # day-of-year fraction anchored at J2000 with the Niell -28 d
+        # phase offset (reference `_get_year_fraction_fast`,
+        # troposphere_delay.py:384)
+        year_frac = ((tt - 51544.5 + _DOY_OFFSET) % 365.25) / 365.25
+        for obsname in toas.observatories:
+            site = get_observatory(obsname)
+            itrf = getattr(site, "itrf_xyz", None)
+            if itrf is None:
+                continue                # barycenter/geocenter: no air
+            sel = np.flatnonzero(toas.obs == obsname)
+            lat, lon, h = itrf_to_geodetic(np.asarray(itrf, np.float64))
+            up_itrf = np.array([math.cos(lat) * math.cos(lon),
+                                math.cos(lat) * math.sin(lon),
+                                math.sin(lat)])
+            R = itrf_to_gcrs_matrix(tt[sel], ut1[sel])
+            up_gcrs = np.einsum("nij,j->ni", R, up_itrf)
+            alt = np.arcsin(np.clip(up_gcrs @ src, -1.0, 1.0))
+            lat_deg = math.degrees(lat)
+            zd = zenith_delay_sec(lat, h)
+            delay[sel] = zd * niell_hydrostatic_map(
+                alt, lat_deg, h, year_frac[sel])
+            # wet zenith delay is 0 (reference ibid:270-275); the wet map
+            # is exercised only when a wet delay is supplied
+        out[self.PYTREE_NAME] = delay
+        return out
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        if not self.CORRECT_TROPOSPHERE.value:
+            return jnp.zeros(batch.ntoas)
+        d = p["mask"].get(self.PYTREE_NAME)
+        if d is None:                   # e.g. a batch built without masks
+            return jnp.zeros(batch.ntoas)
+        return jnp.asarray(d)

@@ -35,6 +35,8 @@ def build_photon_lnpost(model, toas, template, weights=None):
     units = jnp.asarray(bt._units)
     p0 = r.pdict
     batch = r.batch
+    if weights is None:
+        weights = getattr(toas, "weights", None)
     w = jnp.ones(batch.ntoas) if weights is None else \
         jnp.asarray(np.asarray(weights, np.float64))
     tmpl_fn = template._eval_fn()

@@ -461,6 +461,11 @@ class TOAs:
         out.obs = self.obs[mask]
         idx = np.arange(self.ntoas)[mask] if mask.dtype == bool else mask
         out.flags = [dict(self.flags[i]) for i in idx]
+        # optional photon-event columns (see event_toas.load_fits_TOAs)
+        for attr in ("energies", "weights"):
+            col = getattr(self, attr, None)
+            if col is not None:
+                setattr(out, attr, np.asarray(col)[idx])
         out.index = self.index[mask]
         out.tdb = None if self.tdb is None else MJD(self.tdb.day[mask],
                                                     self.tdb.frac[mask])
@@ -603,13 +608,32 @@ class TOAs:
         )
 
 
+def _toa_cache_key(timfile: str, ephem, planets, include_bipm,
+                   bipm_version, limits) -> str:
+    """Content hash of the tim file + preparation settings (reference
+    caches on file hashes the same way, `toa.py:334-404`)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(timfile, "rb") as f:
+        h.update(f.read())
+    h.update(repr((ephem, planets, include_bipm, bipm_version, limits,
+                   3)).encode())        # trailing int = cache format rev
+    return h.hexdigest()
+
+
 def get_TOAs(timfile, ephem="DE421", planets=False, include_bipm=False,
-             bipm_version="BIPM2021", model=None, limits="warn") -> TOAs:
+             bipm_version="BIPM2021", model=None, limits="warn",
+             usepickle=False, picklefilename=None) -> TOAs:
     """Load, clock-correct, and barycenter-prepare TOAs from a tim file.
 
     Equivalent of the reference's `get_TOAs`
     (`/root/reference/src/pint/toa.py:110`).  If ``model`` is given, EPHEM /
-    CLOCK / PLANET_SHAPIRO defaults are taken from it.
+    CLOCK / PLANET_SHAPIRO defaults are taken from it.  ``usepickle``
+    caches the fully-prepared TOAs next to the tim file, keyed on a
+    content hash of the file + preparation settings (reference
+    `load_pickle`/`save_pickle`, `toa.py:334-404`); a stale or
+    incompatible cache is silently rebuilt.
     """
     if model is not None:
         if getattr(model, "EPHEM", None) and model.EPHEM.value:
@@ -622,6 +646,22 @@ def get_TOAs(timfile, ephem="DE421", planets=False, include_bipm=False,
             v = clk.value.upper().replace("TT(", "").replace(")", "")
             if v != "BIPM":
                 bipm_version = v
+    cachefile = None
+    if usepickle and isinstance(timfile, str):
+        import gzip
+        import pickle
+
+        cachefile = picklefilename or timfile + ".pint_tpu_pickle.gz"
+        key = _toa_cache_key(timfile, ephem, planets, include_bipm,
+                             bipm_version, limits)
+        if os.path.exists(cachefile):
+            try:
+                with gzip.open(cachefile, "rb") as f:
+                    stored_key, t = pickle.load(f)
+                if stored_key == key:
+                    return t
+            except Exception:
+                pass  # unreadable/incompatible cache: rebuild below
     toalist, commands = read_tim(timfile)
     t = TOAs(toalist, commands=commands,
              filename=timfile if isinstance(timfile, str) else None)
@@ -629,6 +669,12 @@ def get_TOAs(timfile, ephem="DE421", planets=False, include_bipm=False,
                               bipm_version=bipm_version, limits=limits)
     t.compute_TDBs(ephem=ephem)
     t.compute_posvels(ephem=ephem, planets=planets)
+    if cachefile is not None:
+        import gzip
+        import pickle
+
+        with gzip.open(cachefile, "wb") as f:
+            pickle.dump((key, t), f)
     return t
 
 

@@ -1,0 +1,287 @@
+"""Troposphere, SWX, transient events, PLDM/PLChrom noise, logging,
+TOA cache.
+
+Mirrors the reference's `tests/test_troposphere_model.py`,
+`test_solar_wind.py` (SWX part), `test_transient_events.py`,
+`test_plrednoise.py` (DM/chrom flavors), `test_logging.py`,
+`test_pickle.py`.
+"""
+
+import logging as pylogging
+import io
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BASE = """
+PSR AUXTEST
+RAJ 07:40:45.79 1
+DECJ 66:20:33.5 1
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def build(extra="", ntoas=24, add_noise=False, seed=5, obs="gbt"):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model((BASE + extra).strip().splitlines())
+        toas = make_fake_toas_uniform(
+            54700, 55300, ntoas, model, obs=obs, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 800.0], ntoas // 2),
+            add_noise=add_noise, seed=seed)
+    return model, toas
+
+
+class TestTroposphere:
+    def test_magnitude_and_structure(self):
+        model, toas = build("CORRECT_TROPOSPHERE Y\n")
+        r = Residuals(toas, model)
+        d = np.asarray(r.pdict["mask"]["__tropo_delay__"])
+        # zenith hydrostatic delay is ~7.7 ns; mapped delays larger
+        assert np.all(d > 5e-9)
+        assert np.all(d < 3e-7)   # still finite near the horizon guard
+        # delay component returns exactly the precomputed array
+        import jax.numpy as jnp
+
+        comp = model.components["TroposphereDelay"]
+        out = np.asarray(comp.delay(r.pdict, r.batch, jnp.zeros(toas.ntoas)))
+        assert np.array_equal(out, d)
+
+    def test_disabled(self):
+        model, toas = build("CORRECT_TROPOSPHERE N\n")
+        import jax.numpy as jnp
+
+        r = Residuals(toas, model)
+        comp = model.components["TroposphereDelay"]
+        out = np.asarray(comp.delay(r.pdict, r.batch, jnp.zeros(toas.ntoas)))
+        assert np.all(out == 0.0)
+
+    def test_itrf_geodetic_roundtrip(self):
+        from pint_tpu.earth import geodetic_to_itrf
+        from pint_tpu.models.troposphere import itrf_to_geodetic
+
+        xyz = geodetic_to_itrf(38.4331, -79.8398, 807.0)
+        lat, lon, h = itrf_to_geodetic(np.asarray(xyz, np.float64))
+        assert np.degrees(lat) == pytest.approx(38.4331, abs=1e-9)
+        assert np.degrees(lon) == pytest.approx(-79.8398, abs=1e-9)
+        assert h == pytest.approx(807.0, abs=1e-5)
+
+
+class TestSWX:
+    def test_ranges_and_normalization(self):
+        model, toas = build(
+            "SWXDM_0001 2e-3\nSWXP_0001 2\nSWXR1_0001 54700\n"
+            "SWXR2_0001 55000\nSWXDM_0002 1e-3\nSWXP_0002 2\n"
+            "SWXR1_0002 55000\nSWXR2_0002 55300\n", ntoas=40)
+        r = Residuals(toas, model)
+        comp = model.components["SolarWindDispersionX"]
+        dm = np.asarray(comp.dm_value(r.pdict, r.batch))
+        m = np.asarray(r.batch.tdbld)
+        # normalized geometry is within [0, 1]: |dm| <= SWXDM per range
+        assert np.all(dm[m < 55000] <= 2e-3 + 1e-12)
+        assert np.all(dm[m >= 55000] <= 1e-3 + 1e-12)
+        assert np.all(dm >= -1e-12)
+        assert dm.max() > 0.0
+
+    def test_bad_swxp_rejected(self):
+        with pytest.raises(ValueError, match="SWXP"):
+            build("SWXDM_0001 1e-3\nSWXP_0001 3\nSWXR1_0001 54700\n"
+                  "SWXR2_0001 55300\n")
+
+
+class TestTransientEvents:
+    def test_expdip_shape(self):
+        model, toas = build(
+            "EXPDIPEP_1 55000\nEXPDIPAMP_1 1e-5\nEXPDIPIDX_1 2\n"
+            "EXPDIPTAU_1 30\n", ntoas=60)
+        import jax.numpy as jnp
+
+        r = Residuals(toas, model)
+        comp = model.components["SimpleExponentialDip"]
+        d = np.asarray(comp.delay(r.pdict, r.batch, jnp.zeros(toas.ntoas)))
+        t = np.asarray(r.batch.tdbld)
+        freq = np.asarray(r.batch.freq_mhz)
+        # dip: negative delay, deepest just after the epoch, ~zero before
+        assert np.all(d <= 1e-15)
+        assert np.min(d) < -5e-6
+        assert np.all(np.abs(d[t < 54990]) < 1e-7)
+        # amplitude larger at the lower frequency (gamma=2, (f/fref)^2
+        # means HIGHER f => larger: check frequency dependence exists)
+        after = (t > 55000) & (t < 55060)
+        if after.sum() >= 2:
+            d_hi = d[after & (freq > 1000)]
+            d_lo = d[after & (freq < 1000)]
+            if len(d_hi) and len(d_lo):
+                assert not np.allclose(np.mean(d_hi), np.mean(d_lo))
+
+    def test_expdip_peak_amplitude(self):
+        # peak of the normalized dip equals the amplitude at f = fref
+        model, toas = build(
+            "EXPDIPEP_1 55000\nEXPDIPAMP_1 1e-5\nEXPDIPIDX_1 2\n"
+            "EXPDIPTAU_1 30\n", ntoas=24)
+        import jax.numpy as jnp
+
+        from pint_tpu.toa import get_TOAs_array
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            dense = get_TOAs_array(
+                np.linspace(54990.0, 55060.0, 400), obs="gbt",
+                errors_us=1.0, freqs_mhz=np.full(400, 1400.0),
+                ephem="DE421")
+        r = Residuals(dense, model)
+        comp = model.components["SimpleExponentialDip"]
+        d = np.asarray(comp.delay(r.pdict, r.batch, jnp.zeros(400)))
+        assert np.min(d) == pytest.approx(-1e-5, rel=2e-2)
+
+    def test_chromgauss(self):
+        model, toas = build(
+            "CHROMGAUSS_EPOCH_1 55000\nCHROMGAUSS_LOGAMP_1 -5\n"
+            "CHROMGAUSS_LOGSIG_1 1.3\nCHROMGAUSS_CHROMIDX_1 2\n"
+            "CHROMGAUSS_SIGN_1 1\n", ntoas=60)
+        import jax.numpy as jnp
+
+        r = Residuals(toas, model)
+        comp = model.components["ChromaticGaussianEvent"]
+        d = np.asarray(comp.delay(r.pdict, r.batch, jnp.zeros(toas.ntoas)))
+        t = np.asarray(r.batch.tdbld)
+        freq = np.asarray(r.batch.freq_mhz)
+        assert np.all(d >= 0.0)
+        near = np.abs(t - 55000) < 40   # 60 TOAs over 600 d: ~10 d apart
+        far = np.abs(t - 55000) > 150
+        assert d[near].max() > 10 * (d[far].max() + 1e-30)
+        # (f/fref)^(-2): the 800 MHz points sit higher
+        peak = np.abs(t - 55000) < 30
+        assert np.mean(d[peak & (freq < 1000)]) > \
+            np.mean(d[peak & (freq > 1000)])
+
+    def test_derivative(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.fitter import build_resid_sec_fn
+
+        model, toas = build(
+            "EXPDIPEP_1 55000\nEXPDIPAMP_1 1e-5 1\nEXPDIPIDX_1 2\n"
+            "EXPDIPTAU_1 30\n", ntoas=30)
+        r = Residuals(toas, model)
+        fn = build_resid_sec_fn(model, r.batch, ["EXPDIPAMP_1"],
+                                r.track_mode)
+        col = np.asarray(jax.jacfwd(fn)(jnp.zeros(1), r.pdict))[:, 0]
+        h = 1e-6
+        num = (np.asarray(fn(jnp.array([h]), r.pdict)) -
+               np.asarray(fn(jnp.array([-h]), r.pdict))) / (2 * h)
+        assert np.allclose(col, num, atol=1e-6 * np.max(np.abs(col)) + 1e-12)
+
+
+class TestPLFlavors:
+    def test_pldm_basis_scaling(self):
+        model, toas = build("TNDMAMP -13\nTNDMGAM 3\nTNDMC 8\n")
+        r = Residuals(toas, model)
+        U = np.asarray(model.noise_basis(r.pdict))
+        assert U.shape == (toas.ntoas, 16)
+        freq = np.asarray(r.batch.freq_mhz)
+        # 800-MHz rows are (1400/800)^2 times the 1400-MHz rows in scale
+        norm_hi = np.linalg.norm(U[freq > 1000], axis=1).mean()
+        norm_lo = np.linalg.norm(U[freq < 1000], axis=1).mean()
+        assert norm_lo / norm_hi == pytest.approx((1400 / 800) ** 2,
+                                                  rel=0.2)
+        phi = np.asarray(model.noise_weights(r.pdict))
+        assert phi.shape == (16,) and np.all(phi > 0)
+
+    def test_plchrom_uses_model_index(self):
+        model, toas = build(
+            "CM 0.01\nTNCHROMIDX 4\nTNCHROMAMP -13\nTNCHROMGAM 3\n"
+            "TNCHROMC 6\n")
+        r = Residuals(toas, model)
+        comp = model.components["PLChromNoise"]
+        assert comp.chromatic_alpha() == 4.0
+        U = np.asarray(model.noise_basis(r.pdict))
+        freq = np.asarray(r.batch.freq_mhz)
+        norm_hi = np.linalg.norm(U[freq > 1000], axis=1).mean()
+        norm_lo = np.linalg.norm(U[freq < 1000], axis=1).mean()
+        assert norm_lo / norm_hi == pytest.approx((1400 / 800) ** 4,
+                                                  rel=0.2)
+
+    def test_gls_fit_runs(self):
+        from pint_tpu.fitter import GLSFitter
+
+        model, toas = build("TNDMAMP -12\nTNDMGAM 3\nTNDMC 8\n",
+                            ntoas=30, add_noise=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f = GLSFitter(toas, model)
+            chi2 = f.fit_toas(maxiter=2)
+        assert np.isfinite(chi2)
+
+
+class TestLogging:
+    def test_dedup(self):
+        from pint_tpu.logging import DedupFilter, log, setup
+
+        buf = io.StringIO()
+        filt = setup("INFO", stream=buf, capture_warnings=False)
+        log.warning("repeated message")
+        log.warning("repeated message")
+        log.warning("other message")
+        out = buf.getvalue()
+        assert out.count("repeated message") == 1
+        assert out.count("other message") == 1
+        filt.reset()
+        log.warning("repeated message")
+        assert buf.getvalue().count("repeated message") == 2
+
+    def test_capture_warnings(self):
+        from pint_tpu.logging import setup, log
+
+        buf = io.StringIO()
+        setup("INFO", stream=buf, capture_warnings=True)
+        warnings.warn("a stray warning")
+        assert "a stray warning" in buf.getvalue()
+        setup("INFO", stream=buf, capture_warnings=False)
+
+
+class TestTOACache:
+    def test_pickle_roundtrip(self, tmp_path):
+        from pint_tpu.toa import get_TOAs, write_tim
+
+        model, toas = build(ntoas=10)
+        tim = str(tmp_path / "c.tim")
+        write_tim(tim, toas)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t1 = get_TOAs(tim, model=model, usepickle=True)
+            assert os.path.exists(tim + ".pint_tpu_pickle.gz")
+            t2 = get_TOAs(tim, model=model, usepickle=True)
+        assert np.array_equal(t1.utc.frac, t2.utc.frac)
+        assert np.array_equal(np.asarray(t1.ssb_obs_pos),
+                              np.asarray(t2.ssb_obs_pos))
+
+    def test_stale_cache_rebuilt(self, tmp_path):
+        from pint_tpu.toa import get_TOAs, write_tim
+
+        model, toas = build(ntoas=10)
+        tim = str(tmp_path / "c.tim")
+        write_tim(tim, toas)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t1 = get_TOAs(tim, model=model, usepickle=True)
+            # modify the tim file: cache key changes, cache is rebuilt
+            body = open(tim).read().replace("1.000", "2.000")
+            open(tim, "w").write(body)
+            t2 = get_TOAs(tim, model=model, usepickle=True)
+        assert not np.array_equal(t1.error_us, t2.error_us)

@@ -140,7 +140,10 @@ class TestEventTOAs:
         assert toas.ntoas == 3
         assert np.allclose(toas.utc.mjd_float, [56000.0, 56000.5, 56001.0])
         assert all(t == "barycenter" for t in toas.obs)
-        assert toas.flags[0]["energy"] == repr(30.0)
+        assert np.array_equal(toas.energies, [30.0, 40.0, 50.0])
+        # the photon columns survive row selection
+        sub = toas.select(np.array([True, False, True]))
+        assert np.array_equal(sub.energies, [30.0, 50.0])
 
     def test_local_frame_rejected(self, tmp_path):
         from pint_tpu.event_toas import load_fits_TOAs
