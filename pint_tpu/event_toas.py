@@ -30,7 +30,8 @@ from pint_tpu import mjd as mjdmod
 from pint_tpu.fitsio import read_fits
 from pint_tpu.toa import TOAs
 
-__all__ = ["load_event_TOAs", "load_fits_TOAs", "get_event_TOAs"]
+__all__ = ["load_event_TOAs", "load_fits_TOAs", "get_event_TOAs",
+           "load_FPorbit", "get_satellite_observatory"]
 
 #: missions whose event files this loader understands (reference keeps a
 #: HEASOFT-derived mission db, `event_toas.py:75-168`)
@@ -135,3 +136,50 @@ def get_event_TOAs(eventfile: str, ephem: str = "DE421",
     toas.compute_TDBs(ephem=ephem)
     toas.compute_posvels(ephem=ephem, planets=planets)
     return toas
+
+
+def load_FPorbit(orbit_filename: str):
+    """Parse an FPorbit-style FITS orbit file (NICER/RXTE) into
+    ``(mjd_tt, pos_m, vel_ms)`` arrays (reference `load_FPorbit`,
+    `/root/reference/src/pint/observatory/satellite_obs.py:87`)."""
+    hdus = read_fits(orbit_filename)
+    orb = None
+    for h in hdus:
+        if "X" in h and "TIME" in h:
+            orb = h
+            break
+    if orb is None:
+        raise ValueError(f"no orbit table (TIME/X/Y/Z) in {orbit_filename}")
+    hdr = orb.header
+    timesys = str(hdr.get("TIMESYS", "TT")).strip().upper()
+    if timesys != "TT":
+        warnings.warn(f"orbit file TIMESYS={timesys}; treating as TT")
+    day0, frac0 = _mjdref(hdr)
+    tz = float(hdr.get("TIMEZERO", 0.0))
+    t_sec = np.asarray(orb["TIME"], np.float64) + tz
+    mjd_tt = day0 + frac0 + t_sec / 86400.0
+    pos = np.stack([np.asarray(orb[c], np.float64)
+                    for c in ("X", "Y", "Z")], axis=-1)
+    # sort FIRST: differentiation needs monotonic time
+    order = np.argsort(mjd_tt)
+    mjd_tt, t_sec, pos = mjd_tt[order], t_sec[order], pos[order]
+    if "VX" in orb:
+        vel = np.stack([np.asarray(orb[c], np.float64)
+                        for c in ("VX", "VY", "VZ")], axis=-1)[order]
+    else:
+        # central differences; matches the reference fallback for FT2
+        # files without velocity columns (satellite_obs.py:60-70)
+        vel = np.gradient(pos, t_sec, axis=0)
+    return mjd_tt, pos, vel
+
+
+def get_satellite_observatory(name: str, orbit_filename: str,
+                              overwrite: bool = True):
+    """Create + register a SatelliteObs from an orbit file (reference
+    `get_satellite_observatory`, `satellite_obs.py:500`)."""
+    from pint_tpu.observatory import SatelliteObs, register
+
+    mjd_tt, pos, vel = load_FPorbit(orbit_filename)
+    obs = SatelliteObs(name, mjd_tt, pos, vel)
+    register(obs, overwrite=overwrite)
+    return obs

@@ -222,3 +222,57 @@ class DispersionJump(DelayComponent):
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         return jnp.zeros(batch.ntoas)
+
+
+class FDJumpDM(DelayComponent):
+    """System-dependent DM offsets for narrowband data (``FDJUMPDM`` mask
+    parameters; reference `FDJumpDM`,
+    `/root/reference/src/pint/models/dispersion_model.py:808`).  Unlike
+    DMJUMP (wideband, measured-DM side, zero delay), FDJUMPDM is a real
+    dispersion delay over its TOA selection."""
+
+    register = True
+    category = "fdjumpdm"
+
+    def mask_families(self):
+        return ["FDJUMPDM"]
+
+    @property
+    def fdjumps(self):
+        return [par for par in self.params.values()
+                if isinstance(par, MaskParam)]
+
+    def add_fdjumpdm(self, index=None, key=None, key_value=(), value=0.0,
+                     frozen=True) -> MaskParam:
+        if index is None:
+            index = 1 + max([par.index or 0 for par in self.fdjumps],
+                            default=0)
+        par = MaskParam("FDJUMPDM", index=index, key=key,
+                        key_value=key_value, value=value, frozen=frozen,
+                        units="pc cm^-3")
+        return self.add_param(par)
+
+    def make_param(self, name):
+        if name == "FDJUMPDM":
+            idx = 1 + max([par.index or 0 for par in self.fdjumps],
+                          default=0)
+            return MaskParam("FDJUMPDM", index=idx, units="pc cm^-3")
+        try:
+            prefix, index = split_prefix(name)
+        except ValueError:
+            return None
+        if prefix == "FDJUMPDM":
+            return MaskParam("FDJUMPDM", index=index, units="pc cm^-3")
+        return None
+
+    def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        total = jnp.zeros(batch.ntoas)
+        for par in self.fdjumps:
+            m = p["mask"].get(par.mask_pytree_name)
+            if m is None:
+                continue
+            total = total + pv(p, par.name) * m
+        return total
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        return dispersion_delay(self.dm_value(p, batch), batch.freq_mhz)

@@ -407,15 +407,16 @@ class PLRedNoise(NoiseComponent):
         self-consistent.  Cached on TDB content (TOAs objects are mutated
         in place)."""
         t = np.asarray(toas.tdb.mjd_float) * SECS_PER_DAY
+        scale = self.chromatic_scale(toas)
         key = (toas.ntoas, hash(t.tobytes()), self.nmodes(),
-               self.params[self._TSPAN].value)
+               self.params[self._TSPAN].value, hash(scale.tobytes()))
         if self._basis_cache and self._basis_cache[0] == key:
             return self._basis_cache[1]
         f = self._freqs(toas)
         F = np.zeros((toas.ntoas, 2 * len(f)))
         F[:, 0::2] = np.sin(2.0 * math.pi * t[:, None] * f)
         F[:, 1::2] = np.cos(2.0 * math.pi * t[:, None] * f)
-        F *= self.chromatic_scale(toas)[:, None]
+        F *= scale[:, None]
         out = {self.basis_pytree_name: F, self.freqs_pytree_name: f}
         self._basis_cache = (key, out)
         return out

@@ -67,6 +67,7 @@ DEFAULT_ORDER = [
     "dispersion_constant",
     "dispersion_dmx",
     "dispersion_jump",
+    "fdjumpdm",
     "dmwavex",
     "chromatic_constant",
     "chromatic_cmx",

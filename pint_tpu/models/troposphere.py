@@ -138,17 +138,28 @@ class TroposphereDelay(DelayComponent):
 
     def _source_dir(self) -> np.ndarray:
         """Unit vector to the source (GCRS) from the parent astrometry's
-        host values."""
+        host values (equatorial or ecliptic)."""
         for comp in self._parent.components.values():
-            if hasattr(comp, "psr_dir"):
-                # host-side evaluation: RAJ/DECJ (or ecliptic) radians
-                if "RAJ" in comp.params and comp.RAJ.value is not None:
-                    ra, dec = float(comp.RAJ.value), float(comp.DECJ.value)
-                    return np.array([math.cos(dec) * math.cos(ra),
-                                     math.cos(dec) * math.sin(ra),
-                                     math.sin(dec)])
+            if not hasattr(comp, "psr_dir"):
+                continue
+            if "RAJ" in comp.params and comp.RAJ.value is not None:
+                ra, dec = float(comp.RAJ.value), float(comp.DECJ.value)
+                return np.array([math.cos(dec) * math.cos(ra),
+                                 math.cos(dec) * math.sin(ra),
+                                 math.sin(dec)])
+            if "ELONG" in comp.params and comp.ELONG.value is not None:
+                lam, beta = float(comp.ELONG.value), float(comp.ELAT.value)
+                eps = float(comp.obliquity())
+                x = math.cos(beta) * math.cos(lam)
+                y_e = math.cos(beta) * math.sin(lam)
+                z_e = math.sin(beta)
+                # rotate ecliptic -> equatorial about x by -obliquity
+                return np.array([
+                    x,
+                    y_e * math.cos(eps) - z_e * math.sin(eps),
+                    y_e * math.sin(eps) + z_e * math.cos(eps)])
         raise AttributeError(
-            "TroposphereDelay needs equatorial astrometry (RAJ/DECJ)")
+            "TroposphereDelay needs an astrometry component")
 
     def mask_entries(self, toas) -> Dict[str, np.ndarray]:
         """Per-TOA tropospheric delay [s], host-precomputed (the source
@@ -161,6 +172,9 @@ class TroposphereDelay(DelayComponent):
         out = super().mask_entries(toas)
         n = toas.ntoas
         delay = np.zeros(n)
+        if not self.CORRECT_TROPOSPHERE.value:
+            out[self.PYTREE_NAME] = delay     # disabled: skip the geometry
+            return out
         src = self._source_dir()
         tt = mjdmod.utc_to_tt(toas.utc).mjd_float
         ut1 = toas.utc.mjd_float            # UT1 ~ UTC well within 1 s

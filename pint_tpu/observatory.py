@@ -166,6 +166,45 @@ class GeocenterObs(Observatory):
         return PosVel(z, z.copy())
 
 
+class T2SpacecraftObs(Observatory):
+    """Spacecraft whose GCRS position rides in per-TOA tim flags
+    (tempo2 convention: ``-telx -tely -telz`` [km], optionally
+    ``-vx -vy -vz`` [km/s]); reference `T2SpacecraftObs`,
+    `/root/reference/src/pint/observatory/special_locations.py:161`."""
+
+    #: compute_posvels must source the geometry from the TOA flags
+    needs_flag_positions = True
+
+    def posvel_gcrs_from_flags(self, flags_list) -> PosVel:
+        try:
+            pos = np.array([[float(f["telx"]), float(f["tely"]),
+                             float(f["telz"])] for f in flags_list]) * 1e3
+        except KeyError as e:
+            raise ObservatoryError(
+                "spacecraft TOAs need -telx/-tely/-telz flags (GCRS "
+                f"position in km); missing {e}")
+        have_v = ["vx" in f for f in flags_list]
+        if all(have_v):
+            vel = np.array([[float(f["vx"]), float(f["vy"]),
+                             float(f["vz"])] for f in flags_list]) * 1e3
+        elif any(have_v):
+            raise ObservatoryError(
+                "some spacecraft TOAs carry -vx/-vy/-vz velocity flags "
+                "and some do not; supply them for all TOAs or none")
+        else:
+            import warnings as _w
+
+            _w.warn("spacecraft TOAs have no -vx/-vy/-vz flags; GCRS "
+                    "velocities set to zero (Doppler terms omitted)")
+            vel = np.zeros_like(pos)
+        return PosVel(pos, vel)
+
+    def posvel_gcrs(self, tt_mjd, ut1_mjd=None, eop=null_eop):
+        raise ObservatoryError(
+            "spacecraft positions come from TOA flags; use "
+            "posvel_gcrs_from_flags")
+
+
 class SatelliteObs(Observatory):
     """An orbiting observatory whose GCRS posvel comes from an orbit table.
 
@@ -218,6 +257,7 @@ def _load_defaults():
         )
     register(BarycenterObs("barycenter", aliases=["bat", "ssb", "bary", "@"]))
     register(GeocenterObs("geocenter", aliases=["coe", "geo"]))
+    register(T2SpacecraftObs("stl_geo", aliases=["spacecraft"]))
 
 
 def get_observatory(name: str) -> Observatory:

@@ -557,7 +557,11 @@ class TOAs:
                 if site.is_geocenter:
                     ssb_obs = earth
                 else:
-                    geo = site.posvel_gcrs(tt.mjd_float[sel])
+                    if getattr(site, "needs_flag_positions", False):
+                        geo = site.posvel_gcrs_from_flags(
+                            [self.flags[i] for i in sel])
+                    else:
+                        geo = site.posvel_gcrs(tt.mjd_float[sel])
                     ssb_obs = PosVel(earth.pos + geo.pos, earth.vel + geo.vel)
                     # topocentric TDB-TT term (v_earth·r_obs)/c², ~2 us
                     # diurnal (tdbseries.py:180); the FB90 series applied in
@@ -615,8 +619,23 @@ def _toa_cache_key(timfile: str, ephem, planets, include_bipm,
     import hashlib
 
     h = hashlib.sha256()
-    with open(timfile, "rb") as f:
-        h.update(f.read())
+
+    def feed(path):
+        with open(path, "rb") as f:
+            data = f.read()
+        h.update(data)
+        # INCLUDEd tim files are part of the content (read_tim recurses)
+        basedir = os.path.dirname(os.path.abspath(path))
+        for line in data.decode("ascii", "replace").splitlines():
+            fields = line.split()
+            if fields and fields[0].upper() == "INCLUDE" and len(fields) > 1:
+                sub = fields[1]
+                if not os.path.isabs(sub):
+                    sub = os.path.join(basedir, sub)
+                if os.path.exists(sub):
+                    feed(sub)
+
+    feed(timfile)
     h.update(repr((ephem, planets, include_bipm, bipm_version, limits,
                    3)).encode())        # trailing int = cache format rev
     return h.hexdigest()
@@ -730,6 +749,14 @@ def merge_TOAs(toas_list: Sequence[TOAs]) -> TOAs:
     out.ephem = toas_list[0].ephem
     out.planets = all(t.planets for t in toas_list)
     out.clock_corr_info = dict(toas_list[0].clock_corr_info)
+    # photon-event columns: propagate when every input carries them
+    for attr in ("energies", "weights"):
+        cols = [getattr(t, attr, None) for t in toas_list]
+        if all(c is not None for c in cols):
+            setattr(out, attr, np.concatenate([np.asarray(c) for c in cols]))
+        elif any(c is not None for c in cols):
+            warnings.warn(f"merge_TOAs: only some inputs carry {attr}; "
+                          "the merged TOAs drops the column")
     # re-deriving the prepared columns keeps merge simple and exact
     if all(t.tdb is not None for t in toas_list):
         out.compute_TDBs(ephem=out.ephem)

@@ -1,0 +1,250 @@
+"""Accuracy self-consistency + spacecraft/satellite observatories +
+FDJUMPDM.
+
+The accuracy tests implement VERDICT's reproducibility chain: with no DE
+kernel on disk, absolute ephemeris accuracy is bounded elsewhere
+(`tests/test_astronomy.py` checks the SPK reader against synthetic
+kernels); what must hold unconditionally is that the phase pipeline is
+deterministic and representation-independent: jit vs eager, full-batch vs
+row-subset, and TZR-referenced phase differences must agree to ~1e-9
+cycles (the quad-single design budget).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu import qs
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR ACCTEST
+RAJ 07:40:45.79 1
+DECJ 66:20:33.5 1
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+BINARY ELL1
+PB 4.76694461
+A1 3.9775561
+TASC 55000.3
+EPS1 -5.7e-6
+EPS2 -1.89e-5
+M2 0.25
+SINI 0.99
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def dataset(ntoas=30, seed=6):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(PAR.strip().splitlines())
+        toas = make_fake_toas_uniform(
+            54700, 55300, ntoas, model, obs="gbt", error_us=1.0,
+            freq_mhz=np.tile([1400.0, 800.0], ntoas // 2),
+            add_noise=True, seed=seed)
+    return model, toas
+
+
+class TestSelfConsistency:
+    """VERDICT #7c: phase reproducibility < 1e-9 cycles across
+    representations."""
+
+    def test_jit_vs_eager(self):
+        model, toas = dataset()
+        r = Residuals(toas, model)
+        calc = model.calc
+
+        def phases(p, batch):
+            ph = calc.phase(p, batch)
+            i, f = qs.round_nearest(ph)
+            return jnp.asarray(i) + qs.to_f64(f)
+
+        eager = np.asarray(phases(r.pdict, r.batch))
+        jitted = np.asarray(jax.jit(phases)(r.pdict, r.batch))
+        assert np.max(np.abs(eager - jitted)) < 1e-9
+
+    def test_batch_subset_invariance(self):
+        model, toas = dataset(ntoas=30)
+        r = Residuals(toas, model)
+        calc = model.calc
+        ph_full = calc.phase(r.pdict, r.batch)
+        i_full = np.asarray(qs.round_nearest(ph_full)[0])
+        f_full = np.asarray(qs.to_f64(qs.round_nearest(ph_full)[1]))
+
+        sub = r.batch.select(np.arange(7, 21))
+        ph_sub = calc.phase(r.pdict, sub)
+        i_sub = np.asarray(qs.round_nearest(ph_sub)[0])
+        f_sub = np.asarray(qs.to_f64(qs.round_nearest(ph_sub)[1]))
+        d = (i_sub - i_full[7:21]) + (f_sub - f_full[7:21])
+        assert np.max(np.abs(d)) < 1e-9
+
+    def test_pdict_rebuild_invariance(self):
+        model, toas = dataset()
+        r1 = Residuals(toas, model)
+        a = r1.time_resids
+        r1.update()
+        b = r1.time_resids
+        assert np.array_equal(a, b)
+
+    def test_tzr_reference_subtraction(self):
+        # shifting every parameter delta by zero and rebuilding the TZR
+        # pipeline must not move residuals (cache-key regression guard)
+        model, toas = dataset()
+        r = Residuals(toas, model)
+        a = r.phase_resids.copy()
+        model.attach_tzr(toas)
+        r2 = Residuals(toas, model)
+        assert np.max(np.abs(a - r2.phase_resids)) < 1e-9
+
+    def test_time_scale_chain_golden(self):
+        """UTC->TT->TDB at a fixed epoch against independently computed
+        values (leap seconds = 34 at MJD 55000; TT-TAI = 32.184 s)."""
+        from pint_tpu import mjd as mjdmod
+
+        utc = mjdmod.from_string("55000.125")
+        tt = mjdmod.utc_to_tt(utc)
+        dt = mjdmod.diff_sec(tt, utc)
+        assert float(dt.hi) == pytest.approx(66.184, abs=1e-9)
+        tdb = mjdmod.tt_to_tdb(tt)
+        dtdb = float(mjdmod.diff_sec(tdb, tt).hi)
+        # FB90 series amplitude is +-1.66 ms around zero
+        assert abs(dtdb) < 2e-3
+
+
+class TestSpacecraftObs:
+    def test_flags_positions(self):
+        from pint_tpu.toa import TOA, TOAs
+        from pint_tpu import mjd as mjdmod
+
+        # geostationary-ish position, 35786 km altitude along +x
+        flags = {"telx": "42164.0", "tely": "0.0", "telz": "0.0",
+                 "vx": "0.0", "vy": "3.07", "vz": "0.0"}
+        toalist = [TOA(mjd=mjdmod.from_mjd_float(55000.0 + i * 0.01),
+                       error_us=1.0, freq_mhz=1400.0, obs="stl_geo",
+                       flags=dict(flags)) for i in range(4)]
+        toas = TOAs(toalist)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas.apply_clock_corrections()
+            toas.compute_TDBs(ephem="DE421")
+            toas.compute_posvels(ephem="DE421")
+        # SSB position = earth + spacecraft GCRS: check the spacecraft
+        # part by differencing against a geocenter load of the same times
+        geolist = [TOA(mjd=mjdmod.from_mjd_float(55000.0 + i * 0.01),
+                       error_us=1.0, freq_mhz=1400.0, obs="geocenter")
+                   for i in range(4)]
+        geo = TOAs(geolist)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            geo.apply_clock_corrections()
+            geo.compute_TDBs(ephem="DE421")
+            geo.compute_posvels(ephem="DE421")
+        d = toas.ssb_obs_pos - geo.ssb_obs_pos
+        assert np.allclose(np.linalg.norm(d, axis=1), 42164e3, rtol=1e-9)
+
+    def test_missing_flags_error(self):
+        from pint_tpu.exceptions import ObservatoryError
+        from pint_tpu.toa import TOA, TOAs
+        from pint_tpu import mjd as mjdmod
+
+        toalist = [TOA(mjd=mjdmod.from_mjd_float(55000.0), error_us=1.0,
+                       freq_mhz=1400.0, obs="stl_geo")]
+        toas = TOAs(toalist)
+        with pytest.raises(ObservatoryError, match="telx"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                toas.apply_clock_corrections()
+                toas.compute_TDBs(ephem="DE421")
+                toas.compute_posvels(ephem="DE421")
+
+
+class TestSatelliteObs:
+    def test_fporbit_roundtrip(self, tmp_path):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_events import _card, _header_block
+
+        # hand-build an ORBIT FITS file: circular orbit, radius 7000 km
+        n = 200
+        t_sec = np.linspace(0.0, 6000.0, n)
+        om = 2 * np.pi / 5700.0
+        pos = np.stack([7.0e6 * np.cos(om * t_sec),
+                        7.0e6 * np.sin(om * t_sec),
+                        np.zeros(n)], axis=-1)
+        vel = np.stack([-7.0e6 * om * np.sin(om * t_sec),
+                        7.0e6 * om * np.cos(om * t_sec),
+                        np.zeros(n)], axis=-1)
+        cols = [("TIME", t_sec), ("X", pos[:, 0]), ("Y", pos[:, 1]),
+                ("Z", pos[:, 2]), ("VX", vel[:, 0]), ("VY", vel[:, 1]),
+                ("VZ", vel[:, 2])]
+        rowbytes = 8 * len(cols)
+        cards = [
+            _card("XTENSION", "BINTABLE"), _card("BITPIX", 8),
+            _card("NAXIS", 2), _card("NAXIS1", rowbytes),
+            _card("NAXIS2", n), _card("PCOUNT", 0), _card("GCOUNT", 1),
+            _card("TFIELDS", len(cols)), _card("EXTNAME", "ORBIT"),
+            _card("TIMESYS", "TT"), _card("MJDREFI", 55000),
+            _card("MJDREFF", 0.0), _card("TIMEZERO", 0.0),
+        ]
+        for i, (name, _) in enumerate(cols, 1):
+            cards += [_card(f"TTYPE{i}", name), _card(f"TFORM{i}", "D")]
+        rows = np.zeros(n, dtype=[(nm, ">f8") for nm, _ in cols])
+        for nm, arr in cols:
+            rows[nm] = arr
+        data = rows.tobytes()
+        primary = _header_block([_card("SIMPLE", True), _card("BITPIX", 8),
+                                 _card("NAXIS", 0)])
+        fn = str(tmp_path / "orbit.fits")
+        with open(fn, "wb") as f:
+            f.write(primary + _header_block(cards) + data +
+                    b"\x00" * ((-len(data)) % 2880))
+
+        from pint_tpu.event_toas import get_satellite_observatory
+        from pint_tpu.observatory import get_observatory
+
+        get_satellite_observatory("testsat", fn)
+        obs = get_observatory("testsat")
+        pv = obs.posvel_gcrs(np.array([55000.0 + 3000.0 / 86400.0]))
+        # interpolated radius stays ~7000 km
+        assert np.linalg.norm(pv.pos[0]) == pytest.approx(7.0e6, rel=1e-3)
+        assert np.linalg.norm(pv.vel[0]) == pytest.approx(7.0e6 * om,
+                                                          rel=1e-2)
+
+
+class TestFDJumpDM:
+    def test_masked_dispersion(self):
+        par = PAR + "FDJUMPDM -fe R2 0.003 1\n"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.strip().splitlines())
+            toas = make_fake_toas_uniform(
+                54900, 55100, 20, model, obs="gbt", error_us=1.0,
+                freq_mhz=np.tile([1400.0, 800.0], 10), add_noise=False)
+        for i, fl in enumerate(toas.flags):
+            fl["fe"] = "R2" if i % 2 else "R1"
+        r = Residuals(toas, model)
+        comp = model.components["FDJumpDM"]
+        d = np.asarray(comp.delay(r.pdict, r.batch,
+                                  jnp.zeros(toas.ntoas)))
+        from pint_tpu import DMconst
+
+        freq = np.asarray(r.batch.freq_mhz)
+        expect = np.where(np.arange(20) % 2 == 1,
+                          DMconst * 0.003 / freq**2, 0.0)
+        assert np.allclose(d, expect, rtol=1e-12)
+        # unlike DMJUMP, FDJUMPDM is a genuine delay AND a DM contribution
+        dmv = np.asarray(comp.dm_value(r.pdict, r.batch))
+        assert np.allclose(dmv[1::2], 0.003)

@@ -70,6 +70,27 @@ class TestTroposphere:
         out = np.asarray(comp.delay(r.pdict, r.batch, jnp.zeros(toas.ntoas)))
         assert np.all(out == 0.0)
 
+    def test_ecliptic_astrometry_supported(self):
+        # regression: ELONG/ELAT models must work (and N must skip the
+        # geometry entirely)
+        par = BASE.replace("RAJ 07:40:45.79 1\nDECJ 66:20:33.5 1",
+                           "ELONG 110.5 1\nELAT 43.0 1")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model((par + "CORRECT_TROPOSPHERE Y\n")
+                              .strip().splitlines())
+            toas = make_fake_toas_uniform(54900, 55100, 10, model,
+                                          obs="gbt", add_noise=False)
+        r = Residuals(toas, model)
+        d = np.asarray(r.pdict["mask"]["__tropo_delay__"])
+        assert np.all(d > 5e-9)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model2 = get_model((par + "CORRECT_TROPOSPHERE N\n")
+                               .strip().splitlines())
+            r2 = Residuals(toas, model2)
+        assert np.all(np.asarray(r2.pdict["mask"]["__tropo_delay__"]) == 0)
+
     def test_itrf_geodetic_roundtrip(self):
         from pint_tpu.earth import geodetic_to_itrf
         from pint_tpu.models.troposphere import itrf_to_geodetic
@@ -216,6 +237,17 @@ class TestPLFlavors:
         norm_lo = np.linalg.norm(U[freq < 1000], axis=1).mean()
         assert norm_lo / norm_hi == pytest.approx((1400 / 800) ** 4,
                                                   rel=0.2)
+
+    def test_chrom_basis_cache_invalidation(self):
+        # regression: changing TNCHROMIDX must rebuild the scaled basis
+        model, toas = build(
+            "CM 0.01\nTNCHROMIDX 4\nTNCHROMAMP -13\nTNCHROMGAM 3\n"
+            "TNCHROMC 6\n")
+        comp = model.components["PLChromNoise"]
+        U4 = np.array(comp.basis_entries(toas)[comp.basis_pytree_name])
+        model.TNCHROMIDX.value = 2.0
+        U2 = np.array(comp.basis_entries(toas)[comp.basis_pytree_name])
+        assert not np.array_equal(U4, U2)
 
     def test_gls_fit_runs(self):
         from pint_tpu.fitter import GLSFitter
