@@ -37,9 +37,9 @@ from pint_tpu.toabatch import TOABatch
 from pint_tpu.utils import normalize_designmatrix, woodbury_dot
 
 __all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
-           "DownhillGLSFitter", "WidebandTOAFitter",
-           "WidebandDownhillFitter", "fit_wls_svd", "build_wls_step",
-           "build_gls_step"]
+           "DownhillGLSFitter", "PowellFitter", "LMFitter",
+           "WidebandTOAFitter", "WidebandDownhillFitter", "fit_wls_svd",
+           "build_wls_step", "build_gls_step"]
 
 
 def fit_wls_svd(M, r_sec, sigma_sec, threshold: Optional[float] = None):
@@ -116,6 +116,26 @@ def build_whitened_assembly(model: TimingModel, batch: TOABatch,
         return r, M, sigma, offc
 
     return assemble
+
+
+def build_chi2_fn(model: TimingModel, batch: TOABatch,
+                  fit_params: Sequence[str], track_mode: str,
+                  include_offset: bool):
+    """Jitted chi2-only evaluation ``(x, p) -> float`` — no jacobian, no
+    factorization; the cheap trial-point metric for Powell/LM."""
+    resid_sec = build_resid_sec_fn(model, batch, list(fit_params),
+                                   track_mode)
+
+    @jax.jit
+    def chi2(x, p):
+        r = resid_sec(x, p)
+        sigma = model.scaled_toa_uncertainty(p, batch) * 1e-6
+        if include_offset:
+            w = 1.0 / sigma**2
+            r = r - jnp.sum(r * w) / jnp.sum(w)
+        return jnp.sum((r / sigma) ** 2)
+
+    return chi2
 
 
 def build_wideband_assembly(model: TimingModel, batch: TOABatch,
@@ -563,6 +583,132 @@ class DownhillGLSFitter(DownhillWLSFitter, GLSFitter):
     """Downhill line search over the GLS step (reference
     `DownhillGLSFitter`, `/root/reference/src/pint/fitter.py:1386`):
     fit_toas from the downhill base, _make_step from GLSFitter."""
+
+
+class PowellFitter(Fitter):
+    """Derivative-free Powell minimization of chi2 (reference
+    `PowellFitter`, `/root/reference/src/pint/fitter.py:1659`, built on
+    scipy fmin_powell).  Each chi2 evaluation is the jitted device
+    pipeline; useful when the Gauss-Newton step misbehaves (strong
+    nonlinearity, poor starting point)."""
+
+    def fit_toas(self, maxiter: int = 2000, **kw) -> float:
+        from scipy.optimize import minimize
+
+        m = self.model
+        names = self.fit_params
+        p = self.resids.pdict
+        include_offset = "PhaseOffset" not in m.components
+        step = self._make_step(names, None, include_offset)
+        # optimize in units of the parameter UNCERTAINTIES so Powell's
+        # line searches see O(1) coordinates for every parameter (the
+        # initial Gauss-Newton step can be ~0 for a parameter already at
+        # its conditional optimum, which must not freeze it)
+        out0 = step(jnp.zeros(len(names)), p)
+        unc = np.sqrt(np.maximum(np.diag(denormalize_covariance(
+            out0["Sigma_n"], out0["norms"])), 0.0))
+        scale = np.maximum(unc, np.abs(np.asarray(out0["dx"])))
+        scale = np.where(scale > 0, scale, 1.0)
+        chi2_fn = build_chi2_fn(m, self.resids.batch, names,
+                                self.track_mode, include_offset)
+
+        def chi2(z):
+            return float(chi2_fn(jnp.asarray(z * scale), p))
+
+        res = minimize(chi2, np.zeros(len(names)), method="Powell",
+                       options={"maxiter": maxiter, "xtol": 1e-10,
+                                "ftol": 1e-12})
+        x = res.x * scale
+        final = step(jnp.asarray(x), p)
+        Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
+        self._store_noise(final, p)
+        self._finalize(p, x, Sigma, names)
+        self.fitresult = FitSummary(float(final["chi2"]), self.resids.dof,
+                                    int(res.nit), bool(res.success))
+        return float(final["chi2"])
+
+
+class LMFitter(Fitter):
+    """Levenberg-Marquardt: the Gauss-Newton normal matrix damped by
+    ``lambda * diag`` with adaptive damping (reference `LMFitter`,
+    `/root/reference/src/pint/fitter.py:2313`).  The damped solve runs on
+    device from the same whitened assembly as WLS."""
+
+    def fit_toas(self, maxiter: int = 50, lam0: float = 1e-3,
+                 lam_decrease: float = 3.0, lam_increase: float = 5.0,
+                 tol_chi2: float = 1e-8, threshold=None) -> float:
+        m = self.model
+        names = self.fit_params
+        p = self.resids.pdict
+        include_offset = "PhaseOffset" not in m.components
+        assemble = build_whitened_assembly(m, self.resids.batch, names,
+                                          self.track_mode, include_offset)
+
+        @jax.jit
+        def damped_step(x, lam):
+            r, M, sigma, offc = assemble(x, p)
+            Mw = M / sigma[:, None]
+            rw = r / sigma
+            cmax = jnp.max(jnp.abs(Mw), axis=0)
+            cmax = jnp.where(cmax == 0.0, 1.0, cmax)
+            Mn, nc = normalize_designmatrix(Mw / cmax)
+            norms = cmax * nc
+            A = Mn.T @ Mn
+            A = A + lam * jnp.diag(jnp.diag(A))
+            # eigh, not LU: TPU's PJRT implements no f64 LuDecomposition
+            # (A is symmetric positive-definite here)
+            e, V = jnp.linalg.eigh(A)
+            bad = e <= jnp.finfo(jnp.float64).eps * A.shape[0] * e[-1]
+            einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
+            dx = (V @ (einv * (V.T @ (Mn.T @ rw)))) / norms
+            if offc is not None:
+                w = offc / sigma**2
+                off = jnp.sum(r * w) / jnp.sum(w * offc)
+                chi2 = jnp.sum(((r - off * offc) / sigma) ** 2)
+            else:
+                chi2 = jnp.sum(rw**2)
+            return dx[:len(names)], chi2
+
+        chi2_fn = build_chi2_fn(m, self.resids.batch, names,
+                                self.track_mode, include_offset)
+        x = np.zeros(len(names))
+        lam = lam0
+        chi2 = float(chi2_fn(jnp.asarray(x), p))
+        converged = False
+        it = 0
+        for it in range(maxiter):
+            dx, _ = damped_step(jnp.asarray(x), lam)
+            x_try = x + np.asarray(dx)
+            chi2_try = float(chi2_fn(jnp.asarray(x_try), p))
+            if np.isfinite(chi2_try) and chi2_try < chi2:
+                improvement = chi2 - chi2_try
+                x, chi2 = x_try, chi2_try
+                lam = max(lam / lam_decrease, 1e-12)
+                if improvement < tol_chi2:
+                    converged = True
+                    break
+            else:
+                if np.isfinite(chi2_try) and \
+                        abs(chi2_try - chi2) < tol_chi2:
+                    # the rejected trial changed chi2 by less than the
+                    # tolerance: we are at the minimum
+                    converged = True
+                    break
+                lam *= lam_increase
+                if lam > 1e12:
+                    warnings.warn(
+                        "LM damping diverged (lambda overflow); returning "
+                        "the best point found")
+                    break
+        # covariance from the undamped step at the solution
+        step = self._make_step(names, threshold, include_offset)
+        final = step(jnp.asarray(x), p)
+        Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
+        self._store_noise(final, p)
+        self._finalize(p, x, Sigma, names)
+        self.fitresult = FitSummary(chi2, self.resids.dof, it + 1,
+                                    converged)
+        return chi2
 
 
 class WidebandTOAFitter(GLSFitter):

@@ -24,7 +24,8 @@ from pint_tpu.fitter import Fitter, build_wls_step
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.residuals import Residuals
 
-__all__ = ["grid_chisq", "grid_chisq_flat", "build_grid_fit_fn",
+__all__ = ["grid_chisq", "grid_chisq_flat", "grid_chisq_derived",
+           "build_grid_fit_fn",
            "stack_grid_pdict", "grid_in_axes"]
 
 
@@ -123,3 +124,24 @@ def grid_chisq(fitter: Fitter, parnames: Sequence[str],
     flat = {n: g.ravel() for n, g in zip(parnames, grids)}
     chi2 = grid_chisq_flat(fitter, flat, maxiter=maxiter)
     return chi2.reshape(grids[0].shape), grids
+
+
+def grid_chisq_derived(fitter: Fitter, parnames: Sequence[str],
+                       parfuncs: Sequence, gridvalues: Sequence[np.ndarray],
+                       maxiter: int = 2):
+    """chi2 over a grid of DERIVED quantities (reference
+    `grid_chisq_derived`, `/root/reference/src/pint/gridutils.py:395`):
+    each model parameter ``parnames[i]`` is set to
+    ``parfuncs[i](*gridpoint)`` — e.g. grid over (Mp, Mc) while fitting
+    models parameterized by (M2, SINI).  Returns ``(chi2, parvalues)``
+    with shapes matching the outer product of ``gridvalues``."""
+    grids = np.meshgrid(*[np.asarray(v) for v in gridvalues],
+                        indexing="ij")
+    flatpts = [g.ravel() for g in grids]
+    out = {}
+    for name, func in zip(parnames, parfuncs):
+        out[name] = np.asarray([func(*vals) for vals in zip(*flatpts)],
+                               np.float64)
+    chi2 = grid_chisq_flat(fitter, out, maxiter=maxiter)
+    parvalues = [out[n].reshape(grids[0].shape) for n in parnames]
+    return chi2.reshape(grids[0].shape), parvalues

@@ -271,7 +271,10 @@ class FDJumpDM(DelayComponent):
             m = p["mask"].get(par.mask_pytree_name)
             if m is None:
                 continue
-            total = total + pv(p, par.name) * m
+            # NEGATIVE, matching the reference convention (`fdjump_dm`,
+            # dispersion_model.py:877) and DMJump above — par files are
+            # interchangeable only with this sign
+            total = total - pv(p, par.name) * m
         return total
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:

@@ -183,14 +183,16 @@ class T2SpacecraftObs(Observatory):
             raise ObservatoryError(
                 "spacecraft TOAs need -telx/-tely/-telz flags (GCRS "
                 f"position in km); missing {e}")
-        have_v = ["vx" in f for f in flags_list]
+        have_v = [all(k in f for k in ("vx", "vy", "vz"))
+                  for f in flags_list]
+        some_v = ["vx" in f or "vy" in f or "vz" in f for f in flags_list]
         if all(have_v):
             vel = np.array([[float(f["vx"]), float(f["vy"]),
                              float(f["vz"])] for f in flags_list]) * 1e3
-        elif any(have_v):
+        elif any(some_v):
             raise ObservatoryError(
-                "some spacecraft TOAs carry -vx/-vy/-vz velocity flags "
-                "and some do not; supply them for all TOAs or none")
+                "spacecraft TOA velocity flags are incomplete: supply all "
+                "of -vx/-vy/-vz on every TOA, or none at all")
         else:
             import warnings as _w
 

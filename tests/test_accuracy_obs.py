@@ -242,9 +242,11 @@ class TestFDJumpDM:
         from pint_tpu import DMconst
 
         freq = np.asarray(r.batch.freq_mhz)
+        # reference sign convention: FDJUMPDM SUBTRACTS from the model DM
+        # (`fdjump_dm`, dispersion_model.py:877), like DMJUMP
         expect = np.where(np.arange(20) % 2 == 1,
-                          DMconst * 0.003 / freq**2, 0.0)
+                          -DMconst * 0.003 / freq**2, 0.0)
         assert np.allclose(d, expect, rtol=1e-12)
         # unlike DMJUMP, FDJUMPDM is a genuine delay AND a DM contribution
         dmv = np.asarray(comp.dm_value(r.pdict, r.batch))
-        assert np.allclose(dmv[1::2], 0.003)
+        assert np.allclose(dmv[1::2], -0.003)

@@ -218,3 +218,79 @@ class TestWLSKernel:
         assert int(nbad) == 1
         # minimum-norm solution still reproduces r
         np.testing.assert_allclose(M @ np.asarray(dx), r, atol=1e-8)
+
+
+class TestPowellAndLM:
+    """PowellFitter / LMFitter / grid_chisq_derived (reference
+    `fitter.py:1659,2313`, `gridutils.py:395`)."""
+
+    def _dataset(self):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(PAR.strip().splitlines())
+            toas = make_fake_toas_uniform(
+                53650, 53850, 30, model, obs="gbt", error_us=1.0,
+                freq_mhz=np.tile([1400.0, 800.0], 15), add_noise=True,
+                seed=12)
+        return model, toas
+
+    def test_powell_matches_wls(self):
+        from pint_tpu.fitter import PowellFitter
+
+        model, toas = self._dataset()
+        f_ref = WLSFitter(toas, model)
+        f_ref.fit_toas(maxiter=3)
+        wls = {n: (float(model[n].value), float(model[n].uncertainty))
+               for n in f_ref.fit_params}
+        model2, _ = self._dataset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f = PowellFitter(toas, model2)
+            chi2 = f.fit_toas()
+        assert chi2 == pytest.approx(f_ref.fitresult.chi2, rel=1e-3)
+        for n, (v, u) in wls.items():
+            assert abs(float(model2[n].value) - v) < 3 * u
+
+    def test_lm_matches_wls(self):
+        from pint_tpu.fitter import LMFitter
+
+        model, toas = self._dataset()
+        f_ref = WLSFitter(toas, model)
+        f_ref.fit_toas(maxiter=3)
+        chi2_ref = f_ref.fitresult.chi2
+        model2, _ = self._dataset()
+        model2.F0.value = float(model2.F0.value) + 2e-10
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f = LMFitter(toas, model2)
+            chi2 = f.fit_toas()
+        assert f.fitresult.converged
+        assert chi2 == pytest.approx(chi2_ref, rel=1e-6)
+        assert float(model2.F0.value) == pytest.approx(
+            float(model.F0.value), abs=5 * float(model.F0.uncertainty))
+
+    def test_grid_chisq_derived(self):
+        from pint_tpu.gridutils import grid_chisq_derived
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            from pint_tpu.examples import simulate_j0740_class
+
+            model, toas = simulate_j0740_class(ntoas=30, span_days=400.0)
+            model.M2.frozen = True
+            model.SINI.frozen = True
+            f = WLSFitter(toas, model)
+            # grid over (Mp, cos i); M2/SINI derived from them
+            import math
+
+            mp = np.array([1.8, 2.0])
+            cosi = np.array([0.10, 0.14])
+            chi2, parvals = grid_chisq_derived(
+                f, ["SINI", "M2"],
+                [lambda mp, ci: math.sqrt(1 - ci**2),
+                 lambda mp, ci: 0.25 + 0.0 * mp],
+                [mp, cosi], maxiter=2)
+        assert chi2.shape == (2, 2)
+        assert np.all(np.isfinite(chi2))
+        assert parvals[0].shape == (2, 2)
+        assert parvals[0][0, 0] == pytest.approx(math.sqrt(1 - 0.01))
