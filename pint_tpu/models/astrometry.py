@@ -20,6 +20,7 @@ from __future__ import annotations
 import math
 
 import jax.numpy as jnp
+import numpy as np
 
 from pint_tpu.models.parameter import AngleParam, FloatParam, MJDParam
 from pint_tpu.models.timing_model import DelayComponent, pv
@@ -53,6 +54,34 @@ class Astrometry(DelayComponent):
     """Shared Roemer/parallax machinery; subclasses provide L̂(t)."""
 
     category = "astrometry"
+    #: the two sky-angle parameter names, in (lon, lat) order
+    _angle_names = ()
+
+    def derived_device_entries(self):
+        """Ship HOST-exact sin/cos of the reference angles: TPU's
+        emulated-f64 trig is only ~27-bit accurate on O(1)-radian
+        arguments (~1e-8 rad direction error = microseconds of Roemer
+        delay); device trig is applied only to the tiny fit offsets,
+        where its relative error gives a harmless absolute error."""
+        out = {}
+        for nm in self._angle_names:
+            par = self.params.get(nm)
+            if par is not None and par.value is not None:
+                v = float(par.device_value)
+                out[nm + "__sincos"] = np.array([math.sin(v),
+                                                 math.cos(v)])
+        return out
+
+    @staticmethod
+    def _sincos(p: dict, name: str):
+        """(sin, cos) of angle ``name`` = host-exact reference rotated by
+        the traced fit offset (angle-addition identities)."""
+        from pint_tpu.models.timing_model import dv
+
+        sc = p["const"][name + "__sincos"]
+        d = dv(p, name)
+        sd_, cd_ = jnp.sin(d), jnp.cos(d)
+        return sc[0] * cd_ + sc[1] * sd_, sc[1] * cd_ - sc[0] * sd_
 
     def __init__(self):
         super().__init__()
@@ -97,6 +126,7 @@ class AstrometryEquatorial(Astrometry):
     """ICRS RAJ/DECJ astrometry (reference `astrometry.py:406`)."""
 
     register = True
+    _angle_names = ("RAJ", "DECJ")
 
     def __init__(self):
         super().__init__()
@@ -117,10 +147,8 @@ class AstrometryEquatorial(Astrometry):
         self.require("RAJ", "DECJ")
 
     def psr_dir(self, p: dict, batch: TOABatch) -> jnp.ndarray:
-        a = pv(p, "RAJ")
-        d = pv(p, "DECJ")
-        sa, ca = jnp.sin(a), jnp.cos(a)
-        sd, cd = jnp.sin(d), jnp.cos(d)
+        sa, ca = self._sincos(p, "RAJ")
+        sd, cd = self._sincos(p, "DECJ")
         n0 = jnp.stack(jnp.broadcast_arrays(cd * ca, cd * sa, sd), axis=-1)
         n0 = jnp.broadcast_to(n0, (batch.ntoas, 3))
         ep = self.pos_epoch_name()
@@ -148,6 +176,7 @@ class AstrometryEcliptic(Astrometry):
     (default IERS2010, from the reference's `ecliptic.dat`)."""
 
     register = True
+    _angle_names = ("ELONG", "ELAT")
 
     def __init__(self):
         super().__init__()
@@ -177,10 +206,8 @@ class AstrometryEcliptic(Astrometry):
             raise ValueError(f"unknown ecliptic convention ECL={ecl}")
 
     def psr_dir(self, p: dict, batch: TOABatch) -> jnp.ndarray:
-        lon = pv(p, "ELONG")
-        lat = pv(p, "ELAT")
-        sl, cl = jnp.sin(lon), jnp.cos(lon)
-        sb, cb = jnp.sin(lat), jnp.cos(lat)
+        sl, cl = self._sincos(p, "ELONG")
+        sb, cb = self._sincos(p, "ELAT")
         n0 = jnp.stack(jnp.broadcast_arrays(cb * cl, cb * sl, sb), axis=-1)
         e_lon = jnp.stack(jnp.broadcast_arrays(-sl, cl, jnp.zeros_like(sl)),
                           axis=-1)
@@ -206,3 +233,134 @@ class AstrometryEcliptic(Astrometry):
         y = n[:, 1] * ce - n[:, 2] * se
         z = n[:, 1] * se + n[:, 2] * ce
         return jnp.stack([x, y, z], axis=-1)
+
+
+# -- frame conversion ---------------------------------------------------------
+def _rot_eq_to_ecl(eps: float) -> np.ndarray:
+    """Equatorial -> ecliptic rotation (about x by +obliquity)."""
+    c, s_ = math.cos(eps), math.sin(eps)
+    return np.array([[1.0, 0.0, 0.0], [0.0, c, s_], [0.0, -s_, c]])
+
+
+def _sph_dir(lon: float, lat: float) -> np.ndarray:
+    return np.array([math.cos(lat) * math.cos(lon),
+                     math.cos(lat) * math.sin(lon), math.sin(lat)])
+
+
+def _tangent_basis(lon: float, lat: float):
+    """(e_lon, e_lat) unit vectors of the local tangent plane."""
+    e_lon = np.array([-math.sin(lon), math.cos(lon), 0.0])
+    e_lat = np.array([-math.sin(lat) * math.cos(lon),
+                      -math.sin(lat) * math.sin(lon), math.cos(lat)])
+    return e_lon, e_lat
+
+
+def convert_astrometry(model, target: str, ecl: str = "IERS2010"):
+    """Return a NEW model with the astrometry component converted between
+    equatorial (RAJ/DECJ/PMRA/PMDEC) and ecliptic (ELONG/ELAT/PMELONG/
+    PMELAT) parameterizations — or between ecliptic obliquity conventions
+    (reference `Astrometry.as_ECL/as_ICRS`,
+    `/root/reference/src/pint/models/astrometry.py:840-1540`).  Position
+    and proper-motion vectors rotate exactly; uncertainties rotate by the
+    tangent-basis position angle (diagonal approximation, like the
+    reference's fake-proper-motion trick); PX and POSEPOCH carry over.
+    """
+    from pint_tpu.models import get_model
+    from pint_tpu.models.parameter import AngleParam
+
+    target = target.upper()
+    if target not in ("ECL", "ICRS"):
+        raise ValueError("target must be 'ECL' or 'ICRS'")
+    is_ecl = "ELONG" in model
+    if is_ecl:
+        current_ecl = model.ECL.value or "IERS2010"
+        if target == "ECL" and current_ecl == ecl:
+            return get_model(model.as_parfile().splitlines())
+        if target == "ECL":
+            # convention change: route through the equatorial frame
+            return convert_astrometry(
+                convert_astrometry(model, "ICRS"), "ECL", ecl=ecl)
+    elif target == "ICRS":
+        return get_model(model.as_parfile().splitlines())
+
+    if is_ecl:  # ECL -> ICRS
+        lon, lat = float(model.ELONG.value), float(model.ELAT.value)
+        pm_lon = float(model.PMELONG.value or 0.0)
+        pm_lat = float(model.PMELAT.value or 0.0)
+        R = _rot_eq_to_ecl(
+            model.components["AstrometryEcliptic"].obliquity()).T
+        drop = {"ELONG", "ELAT", "PMELONG", "PMELAT", "ECL"}
+        src_names = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+        new_names = ("RAJ", "DECJ", "PMRA", "PMDEC")
+    else:       # ICRS -> ECL
+        lon, lat = float(model.RAJ.value), float(model.DECJ.value)
+        pm_lon = float(model.PMRA.value or 0.0)
+        pm_lat = float(model.PMDEC.value or 0.0)
+        R = _rot_eq_to_ecl(_OBLIQUITY[ecl])
+        drop = {"RAJ", "DECJ", "PMRA", "PMDEC"}
+        src_names = ("RAJ", "DECJ", "PMRA", "PMDEC")
+        new_names = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+
+    n = R @ _sph_dir(lon, lat)
+    e_lon, e_lat = _tangent_basis(lon, lat)
+    mu = R @ (e_lon * pm_lon + e_lat * pm_lat)
+    lat2 = math.asin(max(-1.0, min(1.0, n[2])))
+    lon2 = math.atan2(n[1], n[0]) % (2 * math.pi)
+    e_lon2, e_lat2 = _tangent_basis(lon2, lat2)
+    pm_lon2, pm_lat2 = float(mu @ e_lon2), float(mu @ e_lat2)
+    # tangent-basis position angle between the frames at this sky point
+    cos_chi = float((R @ e_lon) @ e_lon2)
+    sin_chi = float((R @ e_lon) @ e_lat2)
+
+    # serialize the new angles through AngleParam (carry-safe sexagesimal)
+    units_of = {"RAJ": "H:M:S", "DECJ": "D:M:S",
+                "ELONG": "deg", "ELAT": "deg"}
+    vals = dict(zip(new_names, (lon2, lat2, pm_lon2, pm_lat2)))
+    add = []
+    for nm in new_names[:2]:
+        par = AngleParam(nm, units=units_of[nm])
+        par.value = vals[nm]
+        add.append((nm, par.value_as_string()))
+    add += [(new_names[2], f"{vals[new_names[2]]:.10f}"),
+            (new_names[3], f"{vals[new_names[3]]:.10f}")]
+    if target == "ECL":
+        add.append(("ECL", ecl))
+
+    lines = []
+    for line in model.as_parfile().splitlines():
+        key = line.split()[0].upper() if line.split() else ""
+        if key in drop:
+            continue
+        lines.append(line)
+    for (nm, valstr), src in zip(add, src_names + ("",)):
+        flag = " 1" if (src and src in model and
+                        not model[src].frozen) else ""
+        lines.append(f"{nm} {valstr}{flag}")
+    out = get_model(lines)
+
+    # rotate uncertainties (diagonal approximation): tangent-plane sigmas
+    # transform by the position angle chi; longitude coordinates carry
+    # their cos(lat) metric factor in and out
+    def ang_unc(par):
+        return par.device_uncertainty
+
+    s_lon = ang_unc(model[src_names[0]])
+    s_lat = ang_unc(model[src_names[1]])
+    if s_lon is not None or s_lat is not None:
+        s_lon = (s_lon or 0.0) * abs(math.cos(lat))
+        s_lat = s_lat or 0.0
+        s_lon2 = math.hypot(cos_chi * s_lon, sin_chi * s_lat)
+        s_lat2 = math.hypot(sin_chi * s_lon, cos_chi * s_lat)
+        out[new_names[0]].set_device_uncertainty(
+            s_lon2 / max(abs(math.cos(lat2)), 1e-12))
+        out[new_names[1]].set_device_uncertainty(s_lat2)
+    s_pml = model[src_names[2]].uncertainty
+    s_pmb = model[src_names[3]].uncertainty
+    if s_pml is not None or s_pmb is not None:
+        s_pml = s_pml or 0.0
+        s_pmb = s_pmb or 0.0
+        out[new_names[2]].uncertainty = math.hypot(cos_chi * s_pml,
+                                                   sin_chi * s_pmb)
+        out[new_names[3]].uncertainty = math.hypot(sin_chi * s_pml,
+                                                   cos_chi * s_pmb)
+    return out

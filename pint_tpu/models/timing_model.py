@@ -196,6 +196,12 @@ class Component:
                 out[p.name] = p.device_value
         return out
 
+    def derived_device_entries(self) -> Dict[str, np.ndarray]:
+        """Extra pytree constants computed from parameter values (host
+        side); default none.  Kept separate from ``device_entries`` so
+        build_pdict does not rebuild every raw value twice."""
+        return {}
+
     def mask_entries(self, toas) -> Dict[str, np.ndarray]:
         """Host-computed TOA-mask arrays for this component's MaskParams."""
         out = {}
@@ -471,6 +477,9 @@ class TimingModel:
                         const[par.name + "__qs"] = np.stack(
                             [np.float32(x) for x in w.words])
                     delta[par.name] = np.zeros_like(np.asarray(dv, np.float64))
+            # derived device constants beyond raw parameter values
+            # (e.g. astrometry's host-exact __sincos entries)
+            const.update(c.derived_device_entries())
             if toas is not None:
                 mask.update(c.mask_entries(toas))
                 if getattr(c, "introduces_correlated_errors", False):
@@ -648,6 +657,20 @@ class TimingModel:
                 planets=self.planets_flag,
                 toas=toas)
         return self.tzr_batch
+
+    def as_ECL(self, ecl: str = "IERS2010") -> "TimingModel":
+        """New model with ecliptic astrometry (reference `as_ECL`,
+        `/root/reference/src/pint/models/astrometry.py:858`)."""
+        from pint_tpu.models.astrometry import convert_astrometry
+
+        return convert_astrometry(self, "ECL", ecl=ecl)
+
+    def as_ICRS(self, ecl: str = "IERS2010") -> "TimingModel":
+        """New model with equatorial astrometry (reference `as_ICRS`,
+        `/root/reference/src/pint/models/astrometry.py:840`)."""
+        from pint_tpu.models.astrometry import convert_astrometry
+
+        return convert_astrometry(self, "ICRS", ecl=ecl)
 
     # -- par output -------------------------------------------------------
     def as_parfile(self, comment: Optional[str] = None) -> str:

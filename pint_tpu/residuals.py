@@ -184,6 +184,45 @@ class Residuals:
         dot, logdet = self._gaussian_quadratic(r)
         return float(-0.5 * (dot + logdet + len(r) * np.log(2.0 * np.pi)))
 
+    def calc_whitened_resids(self) -> np.ndarray:
+        """Dimensionless whitened residuals (reference
+        `calc_whitened_resids`, `/root/reference/src/pint/residuals.py:571`):
+        the conditional-mean correlated-noise realization is subtracted and
+        the result scaled by the white uncertainties; ~N(0,1) when the
+        model is adequate."""
+        r = np.asarray(self.time_resids, np.float64)
+        sigma = np.asarray(self.get_data_error(), np.float64) * 1e-6
+        if not self.model.has_correlated_errors:
+            return r / sigma
+        U = np.asarray(self.model.noise_basis(self.pdict), np.float64)
+        phi = np.asarray(self.model.noise_weights(self.pdict), np.float64)
+        keep = phi > 0
+        U, phi = U[:, keep], phi[keep]
+        # conditional-mean amplitudes a_hat = Phi U^T C^-1 r, via the
+        # Woodbury identity: a_hat = Phi (I + G Phi)^-1 b with
+        # G = U^T N^-1 U, b = U^T N^-1 r
+        b = U.T @ (r / sigma**2)
+        G = U.T @ (U / sigma[:, None]**2)
+        a_hat = phi * np.linalg.solve(
+            np.eye(len(phi)) + G * phi[None, :], b)
+        return (r - U @ a_hat) / sigma
+
+    def normality(self, test: str = "ks"):
+        """Normality statistic of the whitened residuals (reference
+        pattern `tests/test_residuals.py` + scipy): "ks" returns the
+        Kolmogorov-Smirnov (stat, pvalue) against N(0,1); "ad" the
+        Anderson-Darling statistic and critical values."""
+        from scipy import stats
+
+        w = self.calc_whitened_resids()
+        if test == "ks":
+            res = stats.kstest(w, "norm")
+            return float(res.statistic), float(res.pvalue)
+        if test == "ad":
+            res = stats.anderson(w, "norm")
+            return float(res.statistic), np.asarray(res.critical_values)
+        raise ValueError(f"unknown normality test {test!r}")
+
     @property
     def dof(self) -> int:
         return self.toas.ntoas - len(self.model.free_params) - \

@@ -189,3 +189,35 @@ def open_or_use(path_or_file, mode="r"):
     if isinstance(path_or_file, (str, bytes, os.PathLike)):
         return open(path_or_file, mode)
     return contextlib.nullcontext(path_or_file)
+
+
+def dmxparse(fitter):
+    """Summarize the DMX model of a fitted model (reference `dmxparse`,
+    `/root/reference/src/pint/utils.py:1085`): returns a dict with the
+    DMX epochs, values, (fit) uncertainties, range bounds, and the
+    mean-subtracted values conventionally plotted."""
+    import numpy as np
+
+    model = fitter.model
+    comp = model.components.get("DispersionDMX")
+    if comp is None:
+        raise ValueError("model has no DispersionDMX component")
+    names = comp.dmx_names()
+    vals = np.array([float(comp.params[n].value) for n in names])
+    errs = np.array([
+        float(comp.params[n].uncertainty)
+        if comp.params[n].uncertainty is not None else np.nan
+        for n in names])
+    r1 = np.array([comp.params[f"DMXR1_{n.split('_')[1]}"].mjd_float
+                   for n in names])
+    r2 = np.array([comp.params[f"DMXR2_{n.split('_')[1]}"].mjd_float
+                   for n in names])
+    eps = 0.5 * (r1 + r2)
+    # variance-weighted mean subtraction (reference ibid: mean_dmx)
+    w = 1.0 / np.where(np.isfinite(errs) & (errs > 0), errs, np.inf) ** 2
+    mean = np.sum(vals * w) / np.sum(w) if np.any(w > 0) else vals.mean()
+    return {
+        "dmxs": vals, "dmx_verrs": errs, "dmxeps": eps,
+        "r1s": r1, "r2s": r2, "bins": names,
+        "mean_dmx": mean, "dmxs_sub": vals - mean,
+    }

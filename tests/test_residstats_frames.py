@@ -1,0 +1,218 @@
+"""Whitened residuals + normality, dmxparse, astrometry frame conversion.
+
+Mirrors the reference's `tests/test_residuals.py` (whitened/normality),
+`test_dmxparse.py`, and `test_astrometry_conversion.py`.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR FRAMETEST
+RAJ 07:40:45.79 1
+DECJ 66:20:33.5 1
+PMRA -9.6 1
+PMDEC -31.1 1
+PX 0.5
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def dataset(extra="", ntoas=40, seed=21, **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model((PAR + extra).strip().splitlines())
+        toas = make_fake_toas_uniform(
+            54700, 55300, ntoas, model, obs="gbt", error_us=1.0,
+            freq_mhz=np.tile([1400.0, 800.0], ntoas // 2),
+            add_noise=True, seed=seed, **kw)
+    return model, toas
+
+
+class TestWhitenedResids:
+    def test_white_case_unit_variance(self):
+        model, toas = dataset()
+        r = Residuals(toas, model)
+        w = r.calc_whitened_resids()
+        assert w.shape == (toas.ntoas,)
+        assert 0.5 < np.std(w) < 2.0
+
+    def test_correlated_case_whitens(self):
+        from pint_tpu.simulation import add_correlated_noise
+        from pint_tpu.toa import merge_TOAs
+
+        par = PAR + "ECORR -fe R1 2.0\n"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.strip().splitlines())
+            t1 = make_fake_toas_uniform(54700, 55300, 25, model, obs="gbt",
+                                        add_noise=False)
+            t2 = make_fake_toas_uniform(54700 + 0.5 / 86400,
+                                        55300 + 0.5 / 86400, 25, model,
+                                        obs="gbt", add_noise=False)
+            toas = merge_TOAs([t1, t2])
+            for fl in toas.flags:
+                fl["fe"] = "R1"
+            toas = add_correlated_noise(toas, model, seed=4)
+            # plus white noise at the TOA errors
+            import pint_tpu.mjd as mjdmod
+
+            rng = np.random.default_rng(5)
+            toas.utc = mjdmod.add_sec(toas.utc,
+                                      rng.standard_normal(50) * 1e-6)
+            toas.compute_TDBs(ephem="DE421")
+            toas.compute_posvels(ephem="DE421")
+            r = Residuals(toas, model)
+        raw = r.time_resids / (np.asarray(r.get_data_error()) * 1e-6)
+        white = r.calc_whitened_resids()
+        # subtracting the conditional-mean ECORR realization must shrink
+        # the scatter toward ~1
+        assert np.std(white) < np.std(raw)
+        assert 0.4 < np.std(white) < 1.6
+
+    def test_normality(self):
+        model, toas = dataset()
+        r = Residuals(toas, model)
+        stat, p = r.normality("ks")
+        assert 0 <= stat <= 1 and p > 1e-4   # gaussian sim: not rejected
+        stat_ad, crit = r.normality("ad")
+        assert np.isfinite(stat_ad) and len(crit) >= 3
+        with pytest.raises(ValueError):
+            r.normality("nope")
+
+
+class TestDmxparse:
+    def test_summary(self):
+        extra = ("DMX_0001 0.001 1\nDMXR1_0001 54700\nDMXR2_0001 55000\n"
+                 "DMX_0002 -0.002 1\nDMXR1_0002 55000\nDMXR2_0002 55300\n")
+        model, toas = dataset(extra)
+        f = WLSFitter(toas, model)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f.fit_toas(maxiter=3)
+        from pint_tpu.utils import dmxparse
+
+        out = dmxparse(f)
+        assert out["bins"] == ["DMX_0001", "DMX_0002"]
+        assert out["dmxeps"][0] == pytest.approx(54850.0)
+        assert np.all(np.isfinite(out["dmx_verrs"]))
+        assert np.sum(out["dmxs_sub"] * (1 / out["dmx_verrs"] ** 2)) == \
+            pytest.approx(0.0, abs=1e-8)
+
+    def test_no_dmx_raises(self):
+        model, toas = dataset()
+        f = WLSFitter(toas, model)
+        from pint_tpu.utils import dmxparse
+
+        with pytest.raises(ValueError, match="DMX"):
+            dmxparse(f)
+
+
+class TestFrameConversion:
+    def test_icrs_ecl_roundtrip(self):
+        model, toas = dataset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mecl = model.as_ECL()
+            assert "AstrometryEcliptic" in mecl.components
+            assert not mecl.ELONG.frozen and not mecl.PMELONG.frozen
+            mback = mecl.as_ICRS()
+        assert float(mback.RAJ.value) == pytest.approx(
+            float(model.RAJ.value), abs=1e-12)
+        assert float(mback.DECJ.value) == pytest.approx(
+            float(model.DECJ.value), abs=1e-12)
+        assert float(mback.PMRA.value) == pytest.approx(-9.6, abs=1e-8)
+        assert float(mback.PMDEC.value) == pytest.approx(-31.1, abs=1e-8)
+
+    def test_residuals_frame_invariant(self):
+        # the SAME sky position in either frame must produce identical
+        # residuals (the physics is frame-independent)
+        model, toas = dataset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mecl = model.as_ECL()
+            r_eq = Residuals(toas, model)
+            r_ec = Residuals(toas, mecl)
+        assert np.max(np.abs(r_eq.time_resids - r_ec.time_resids)) < 1e-10
+
+    def test_proper_motion_magnitude_preserved(self):
+        model, toas = dataset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mecl = model.as_ECL()
+        mu_eq = np.hypot(-9.6, -31.1)
+        mu_ec = np.hypot(float(mecl.PMELONG.value),
+                         float(mecl.PMELAT.value))
+        assert mu_ec == pytest.approx(mu_eq, rel=1e-10)
+
+    def test_uncertainties_propagate(self):
+        par = PAR.replace("RAJ 07:40:45.79 1", "RAJ 07:40:45.79 1 0.002") \
+                 .replace("DECJ 66:20:33.5 1", "DECJ 66:20:33.5 1 0.02") \
+                 .replace("PMRA -9.6 1", "PMRA -9.6 1 0.05") \
+                 .replace("PMDEC -31.1 1", "PMDEC -31.1 1 0.08")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.strip().splitlines())
+            mecl = model.as_ECL()
+        # angular error magnitude is rotation-invariant (diagonal approx)
+        import math
+
+        s_lon = model.RAJ.device_uncertainty * \
+            abs(math.cos(float(model.DECJ.value)))
+        s_lat = model.DECJ.device_uncertainty
+        mag_eq = math.hypot(s_lon, s_lat)
+        s_lon2 = mecl.ELONG.device_uncertainty * \
+            abs(math.cos(float(mecl.ELAT.value)))
+        s_lat2 = mecl.ELAT.device_uncertainty
+        assert math.hypot(s_lon2, s_lat2) == pytest.approx(mag_eq,
+                                                           rel=1e-9)
+        mag_pm = math.hypot(0.05, 0.08)
+        assert math.hypot(float(mecl.PMELONG.uncertainty),
+                          float(mecl.PMELAT.uncertainty)) == \
+            pytest.approx(mag_pm, rel=1e-9)
+
+    def test_ecl_convention_conversion(self):
+        par = PAR.replace("RAJ 07:40:45.79 1\nDECJ 66:20:33.5 1",
+                          "ELONG 110.5 1\nELAT 43.0 1") \
+                 .replace("PMRA -9.6 1\nPMDEC -31.1 1",
+                          "PMELONG -9.6 1\nPMELAT -31.1 1") + "ECL DE405\n"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.strip().splitlines())
+            m2 = model.as_ECL("IERS2010")
+        assert m2.ECL.value == "IERS2010"
+        # DE405 vs IERS2010 obliquity differs by ~6 mas: coordinates must
+        # actually move
+        assert float(m2.ELONG.value) != pytest.approx(
+            float(model.ELONG.value), abs=1e-12)
+        # and the sky direction is preserved through the convention change
+        from pint_tpu.residuals import Residuals
+
+        model2, toas = dataset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            r1 = Residuals(toas, model)
+            r2 = Residuals(toas, m2)
+        assert np.max(np.abs(r1.time_resids - r2.time_resids)) < 1e-10
+
+    def test_noop_same_frame(self):
+        model, toas = dataset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m2 = model.as_ICRS()
+        assert float(m2.RAJ.value) == pytest.approx(float(model.RAJ.value))
