@@ -439,6 +439,21 @@ class Fitter:
     def fit_toas(self, maxiter: int = 2, **kw) -> float:
         raise NotImplementedError
 
+    @staticmethod
+    def auto(toas, model: TimingModel, downhill: bool = True,
+             **kw) -> "Fitter":
+        """Pick the appropriate fitter for the data/model combination
+        (reference `Fitter.auto`, `/root/reference/src/pint/fitter.py:255`):
+        wideband TOAs -> wideband fitter; correlated noise -> GLS;
+        otherwise WLS; downhill variants by default."""
+        if toas.is_wideband:
+            cls = WidebandDownhillFitter if downhill else WidebandTOAFitter
+        elif model.has_correlated_errors:
+            cls = DownhillGLSFitter if downhill else GLSFitter
+        else:
+            cls = DownhillWLSFitter if downhill else WLSFitter
+        return cls(toas, model, **kw)
+
     def _make_step(self, names, threshold, include_offset):
         """The jitted Gauss-Newton step; WLS by default, overridden by the
         GLS fitters."""

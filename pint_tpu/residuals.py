@@ -147,6 +147,14 @@ class Residuals:
         mean = np.sum(r * w) / np.sum(w)
         return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))
 
+    def _noise_basis_filtered(self):
+        """(U, phi) with zero-prior-variance columns dropped — the single
+        source for every correlated-noise consumer here."""
+        U = np.asarray(self.model.noise_basis(self.pdict), np.float64)
+        phi = np.asarray(self.model.noise_weights(self.pdict), np.float64)
+        keep = phi > 0  # zero prior variance = column not present
+        return U[:, keep], phi[keep]
+
     def _gaussian_quadratic(self, r):
         """(r^T C^-1 r, logdet C) under the full noise model: white
         diagonal, or Woodbury over the noise basis when correlated
@@ -156,11 +164,8 @@ class Residuals:
         if self.model.has_correlated_errors:
             from pint_tpu.utils import woodbury_dot
 
-            U = np.asarray(self.model.noise_basis(self.pdict), np.float64)
-            phi = np.asarray(self.model.noise_weights(self.pdict),
-                             np.float64)
-            keep = phi > 0  # zero prior variance = column not present
-            return woodbury_dot(sigma_s**2, U[:, keep], phi[keep], r, r)
+            U, phi = self._noise_basis_filtered()
+            return woodbury_dot(sigma_s**2, U, phi, r, r)
         return (np.sum((r / sigma_s) ** 2),
                 2.0 * np.sum(np.log(sigma_s)))
 
@@ -194,10 +199,7 @@ class Residuals:
         sigma = np.asarray(self.get_data_error(), np.float64) * 1e-6
         if not self.model.has_correlated_errors:
             return r / sigma
-        U = np.asarray(self.model.noise_basis(self.pdict), np.float64)
-        phi = np.asarray(self.model.noise_weights(self.pdict), np.float64)
-        keep = phi > 0
-        U, phi = U[:, keep], phi[keep]
+        U, phi = self._noise_basis_filtered()
         # conditional-mean amplitudes a_hat = Phi U^T C^-1 r, via the
         # Woodbury identity: a_hat = Phi (I + G Phi)^-1 b with
         # G = U^T N^-1 U, b = U^T N^-1 r
@@ -219,8 +221,17 @@ class Residuals:
             res = stats.kstest(w, "norm")
             return float(res.statistic), float(res.pvalue)
         if test == "ad":
-            res = stats.anderson(w, "norm")
-            return float(res.statistic), np.asarray(res.critical_values)
+            import warnings as _w
+
+            with _w.catch_warnings():
+                # scipy >= 1.17 deprecates the method-less call; the
+                # result shape differs across versions, so accept both
+                _w.simplefilter("ignore", FutureWarning)
+                res = stats.anderson(w, "norm")
+            crit = getattr(res, "critical_values", None)
+            if crit is None:           # scipy >= 1.19: p-value result
+                return float(res.statistic), float(res.pvalue)
+            return float(res.statistic), np.asarray(crit)
         raise ValueError(f"unknown normality test {test!r}")
 
     @property

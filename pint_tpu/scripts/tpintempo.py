@@ -21,10 +21,12 @@ def main(argv=None):
     parser.add_argument("--fitter", default="auto",
                         choices=["auto", "wls", "gls", "downhill",
                                  "downhill_gls", "wideband",
-                                 "wideband_downhill"],
+                                 "wideband_downhill", "powell", "lm"],
                         help="fitter to use; auto picks GLS/wideband from "
                              "the model and data")
-    parser.add_argument("--maxiter", type=int, default=10)
+    parser.add_argument("--maxiter", type=int, default=None,
+                        help="fit iterations (default: the chosen "
+                             "fitter's own default)")
     parser.add_argument("--outfile", default=None,
                         help="write the post-fit model to this par file")
     parser.add_argument("--plotfile", default=None,
@@ -49,25 +51,20 @@ def main(argv=None):
     toas = get_TOAs(args.timfile, **kw)
     print(f"Read {toas.ntoas} TOAs from {args.timfile}")
 
-    wideband = toas.is_wideband
-    name = args.fitter
-    if name == "auto":
-        if wideband:
-            name = "wideband_downhill"
-        elif model.has_correlated_errors:
-            name = "downhill_gls"
-        else:
-            name = "downhill"
-    cls = {"wls": F.WLSFitter, "gls": F.GLSFitter,
-           "downhill": F.DownhillWLSFitter,
-           "downhill_gls": F.DownhillGLSFitter,
-           "wideband": F.WidebandTOAFitter,
-           "wideband_downhill": F.WidebandDownhillFitter}[name]
-
     prefit = Residuals(toas, model)
     print(f"Pre-fit weighted RMS: {prefit.rms_weighted()*1e6:.4f} us")
-    f = cls(toas, model)
-    f.fit_toas(maxiter=args.maxiter)
+    if args.fitter == "auto":
+        f = F.Fitter.auto(toas, model)
+    else:
+        cls = {"wls": F.WLSFitter, "gls": F.GLSFitter,
+               "downhill": F.DownhillWLSFitter,
+               "downhill_gls": F.DownhillGLSFitter,
+               "wideband": F.WidebandTOAFitter,
+               "wideband_downhill": F.WidebandDownhillFitter,
+               "powell": F.PowellFitter, "lm": F.LMFitter}[args.fitter]
+        f = cls(toas, model)
+    f.fit_toas(**({} if args.maxiter is None
+                  else {"maxiter": args.maxiter}))
     print(f"Fitted with {type(f).__name__}")
     print(f.get_summary())
 
