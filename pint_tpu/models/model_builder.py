@@ -30,6 +30,12 @@ from pint_tpu.models.timing_model import Component, TimingModel
 
 __all__ = ["parse_parfile", "ModelBuilder", "get_model", "get_model_and_toas"]
 
+#: tempo bookkeeping records dropped on read, exactly as the reference
+#: (`/root/reference/src/pint/models/timing_model.py:107,114`:
+#: ignore_params / ignore_prefix)
+IGNORE_PARAMS = {"NITS", "IBOOT", "EPHVER", "DMMODEL", "GAIN"}
+IGNORE_PREFIXES = ("DMXF1_", "DMXF2_", "DMXEP_")
+
 
 def parse_parfile(parfile: Union[str, Sequence[str]]) -> Dict[str, List[List[str]]]:
     """Parse a par file into ``{NAME: [field-list, ...]}`` preserving
@@ -144,6 +150,8 @@ class ModelBuilder:
         unknown = []
         for key, occurrences in pars.items():
             if key in used:
+                continue
+            if key in IGNORE_PARAMS or key.startswith(IGNORE_PREFIXES):
                 continue
             hit = self.all.resolve(key)
             if hit is None:
