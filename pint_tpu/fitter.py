@@ -36,6 +36,16 @@ from pint_tpu.residuals import Residuals, raw_phase_resids
 from pint_tpu.toabatch import TOABatch
 from pint_tpu.utils import normalize_designmatrix, woodbury_dot
 
+
+def _machine_eps() -> float:
+    """Effective f64 epsilon of the active backend: TPU's emulated f64
+    carries ~48 mantissa bits, so degeneracy thresholds tuned to true
+    IEEE eps (2^-52) under-cut it and let near-singular directions leak
+    huge, chi2-flat parameter steps through the solve."""
+    import jax as _jax
+
+    return 2.0 ** -48 if _jax.default_backend() != "cpu" else         float(jnp.finfo(jnp.float64).eps)
+
 __all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
            "DownhillGLSFitter", "PowellFitter", "LMFitter",
            "WidebandTOAFitter", "WidebandDownhillFitter", "fit_wls_svd",
@@ -69,7 +79,7 @@ def fit_wls_svd(M, r_sec, sigma_sec, threshold: Optional[float] = None):
     norms = cmax * nc
     U, S, Vt = jnp.linalg.svd(Mn, full_matrices=False)
     if threshold is None:
-        threshold = jnp.finfo(jnp.float64).eps * max(M.shape)
+        threshold = _machine_eps() * max(M.shape)
     bad = S <= threshold * S[0]
     Sinv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, S))
     dpars = (Vt.T @ (Sinv * (U.T @ rw))) / norms
@@ -245,7 +255,7 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
         # range on TPU (the squared form stays bounded for every column)
         A = Mn.T @ Mn + jnp.diag((jnp.sqrt(phiinv) / norms) ** 2)
         e, V = jnp.linalg.eigh(A)
-        thr = jnp.finfo(jnp.float64).eps * A.shape[0] \
+        thr = _machine_eps() * A.shape[0] \
             if threshold is None else threshold
         bad = e <= thr * e[-1]
         einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
@@ -673,7 +683,7 @@ class LMFitter(Fitter):
             # eigh, not LU: TPU's PJRT implements no f64 LuDecomposition
             # (A is symmetric positive-definite here)
             e, V = jnp.linalg.eigh(A)
-            bad = e <= jnp.finfo(jnp.float64).eps * A.shape[0] * e[-1]
+            bad = e <= _machine_eps() * A.shape[0] * e[-1]
             einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
             dx = (V @ (einv * (V.T @ (Mn.T @ rw)))) / norms
             if offc is not None:

@@ -1,0 +1,192 @@
+"""Interactive timing-model workbench (pintk equivalent).
+
+Reference: `pintk` (`/root/reference/src/pint/pintk/`, a tkinter GUI).
+This environment has no display, so the same workflow runs as a command
+REPL with matplotlib (Agg) plot output:
+
+    fit [maxiter]        run the auto-selected fitter
+    plot [file.png]      pre/post-fit residual plot
+    freeze PAR / thaw PAR
+    select MJD1 MJD2     keep only TOAs in the range
+    reset                restore the full TOA set
+    summary              fit summary
+    write file.par       save the current model
+    quit
+
+Commands can also be piped or given with ``--command`` for scripted use.
+"""
+
+import argparse
+import shlex
+import sys
+import warnings
+
+__all__ = ["main", "PintkSession"]
+
+
+class PintkSession:
+    """The model/TOA state behind the REPL (reference `pintk.plk`
+    widget state)."""
+
+    def __init__(self, parfile: str, timfile: str):
+        import numpy as np
+
+        from pint_tpu.models import get_model
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.toa import get_TOAs
+
+        self.model = get_model(parfile)
+        self.all_toas = get_TOAs(timfile, model=self.model)
+        self.toas = self.all_toas
+        self.fitter = None
+        self.prefit = Residuals(self.toas, self.model)
+        self.postfit = None
+        self._np = np
+
+    # -- commands ----------------------------------------------------------
+    def cmd_fit(self, maxiter: str = "") -> str:
+        from pint_tpu.fitter import Fitter
+
+        self.fitter = Fitter.auto(self.toas, self.model)
+        kw = {"maxiter": int(maxiter)} if maxiter else {}
+        chi2 = self.fitter.fit_toas(**kw)
+        self.postfit = self.fitter.resids
+        r = self.postfit
+        return (f"{type(self.fitter).__name__}: chi2={chi2:.2f} "
+                f"dof={r.dof} rms={r.rms_weighted()*1e6:.3f} us")
+
+    def cmd_plot(self, outfile: str = "tpintk.png") -> str:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        np = self._np
+        mjd = np.asarray(self.prefit.batch.tdbld)
+        err = np.asarray(self.prefit.get_data_error())
+        fig, ax = plt.subplots(figsize=(9, 5))
+        ax.errorbar(mjd, self.prefit.time_resids * 1e6, yerr=err,
+                    fmt=".", ms=4, alpha=0.6, label="pre-fit")
+        if self.postfit is not None:
+            post = self.postfit.toa if hasattr(self.postfit, "toa") \
+                else self.postfit
+            ax.errorbar(np.asarray(post.batch.tdbld),
+                        post.time_resids * 1e6,
+                        yerr=np.asarray(post.get_data_error()),
+                        fmt=".", ms=4, alpha=0.8, label="post-fit")
+        ax.set_xlabel("MJD (TDB)")
+        ax.set_ylabel("residual [us]")
+        ax.axhline(0.0, color="k", lw=0.5)
+        ax.legend()
+        psr = self.model.PSR.value or "PSR"
+        ax.set_title(psr)
+        fig.tight_layout()
+        fig.savefig(outfile, dpi=120)
+        plt.close(fig)
+        return f"wrote {outfile}"
+
+    def cmd_freeze(self, name: str) -> str:
+        self.model[name.upper()].frozen = True
+        return f"{name.upper()} frozen"
+
+    def cmd_thaw(self, name: str) -> str:
+        self.model[name.upper()].frozen = False
+        return f"{name.upper()} free"
+
+    def cmd_select(self, mjd1: str, mjd2: str) -> str:
+        from pint_tpu.residuals import Residuals
+
+        lo, hi = sorted((float(mjd1), float(mjd2)))
+        m = self.all_toas.utc.mjd_float
+        self.toas = self.all_toas.select((m >= lo) & (m <= hi))
+        self.prefit = Residuals(self.toas, self.model)
+        self.postfit = None
+        self.fitter = None      # stale fit stats must not survive
+        return f"selected {self.toas.ntoas} of {self.all_toas.ntoas} TOAs"
+
+    def cmd_reset(self) -> str:
+        from pint_tpu.residuals import Residuals
+
+        self.toas = self.all_toas
+        self.prefit = Residuals(self.toas, self.model)
+        self.postfit = None
+        self.fitter = None
+        return f"restored {self.toas.ntoas} TOAs"
+
+    def cmd_summary(self) -> str:
+        if self.fitter is None:
+            free = ", ".join(self.model.free_params)
+            return (f"{self.toas.ntoas} TOAs, pre-fit rms "
+                    f"{self.prefit.rms_weighted()*1e6:.3f} us; "
+                    f"free: {free}")
+        return self.fitter.get_summary()
+
+    def cmd_write(self, outfile: str) -> str:
+        self.model.write_parfile(outfile)
+        return f"wrote {outfile}"
+
+    def run_command(self, line: str) -> str:
+        parts = shlex.split(line)
+        if not parts:
+            return ""
+        cmd, args = parts[0].lower(), parts[1:]
+        if cmd in ("quit", "exit", "q"):
+            raise EOFError
+        handler = getattr(self, f"cmd_{cmd}", None)
+        if handler is None:
+            return (f"unknown command {cmd!r} (fit/plot/freeze/thaw/"
+                    "select/reset/summary/write/quit)")
+        return handler(*args)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu interactive timing workbench (cf. pintk; "
+                    "REPL + Agg plots instead of a GUI)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("parfile")
+    parser.add_argument("timfile")
+    parser.add_argument("--command", "-c", action="append", default=None,
+                        help="run this command and exit (repeatable)")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    sess = PintkSession(args.parfile, args.timfile)
+    print(f"Loaded {sess.toas.ntoas} TOAs; free params: "
+          f"{', '.join(sess.model.free_params)}")
+
+    failed = [False]
+
+    def run(line):
+        try:
+            out = sess.run_command(line)
+            if out:
+                print(out)
+            return True
+        except EOFError:
+            return False
+        except Exception as e:  # keep the session alive on bad input
+            print(f"error: {e}")
+            failed[0] = True
+            return True
+
+    if args.command:
+        for line in args.command:
+            if not run(line):
+                break
+        # scripted mode: automation must see failures in the exit code
+        return 1 if failed[0] else 0
+    while True:
+        try:
+            line = input("tpintk> ")
+        except EOFError:
+            break
+        if not run(line):
+            break
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

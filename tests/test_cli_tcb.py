@@ -184,3 +184,59 @@ class TestScripts:
         assert rc == 0
         out = capsys.readouterr().out
         assert "DM" in out
+
+
+class TestTpintk:
+    """Scripted tpintk session (the pintk-equivalent REPL)."""
+
+    def test_scripted_session(self, tmp_path):
+        from pint_tpu.scripts import tpintk, tzima
+
+        par = tmp_path / "k.par"
+        par.write_text(PAR_TDB.strip() + "\n")
+        tim = str(tmp_path / "k.tim")
+        tzima.main([str(par), tim, "--ntoa", "20", "--startMJD", "54800",
+                    "--duration", "300", "--addnoise", "--seed", "9",
+                    "--quiet"])
+        png = str(tmp_path / "resid.png")
+        out = str(tmp_path / "post.par")
+        rc = tpintk.main([str(par), tim, "--quiet",
+                          "-c", "freeze F1",
+                          "-c", "select 54800 54950",
+                          "-c", "reset",
+                          "-c", "fit 5",
+                          "-c", f"plot {png}",
+                          "-c", "summary",
+                          "-c", f"write {out}",
+                          "-c", "quit"])
+        assert rc == 0
+        assert os.path.exists(png) and os.path.getsize(png) > 10000
+        m = load(open(out).read())
+        assert m.F1.frozen            # freeze honored through the fit
+        assert m.CHI2.value is not None
+
+    def test_bad_command_keeps_session(self, tmp_path, capsys):
+        from pint_tpu.scripts import tpintk, tzima
+
+        par = tmp_path / "k.par"
+        par.write_text(PAR_TDB.strip() + "\n")
+        tim = str(tmp_path / "k.tim")
+        tzima.main([str(par), tim, "--ntoa", "12", "--startMJD", "54800",
+                    "--duration", "200", "--quiet"])
+        rc = tpintk.main([str(par), tim, "--quiet",
+                          "-c", "bogus", "-c", "thaw DM", "-c", "quit"])
+        assert rc == 0
+        assert "unknown command" in capsys.readouterr().out
+
+    def test_scripted_failure_exit_code(self, tmp_path):
+        from pint_tpu.scripts import tpintk, tzima
+
+        par = tmp_path / "k.par"
+        par.write_text(PAR_TDB.strip() + "\n")
+        tim = str(tmp_path / "k.tim")
+        tzima.main([str(par), tim, "--ntoa", "12", "--startMJD", "54800",
+                    "--duration", "200", "--quiet"])
+        rc = tpintk.main([str(par), tim, "--quiet",
+                          "-c", "write /nonexistent-dir/x.par",
+                          "-c", "quit"])
+        assert rc == 1
