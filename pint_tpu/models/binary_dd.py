@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from pint_tpu import Tsun
 from pint_tpu.models.binary_orbits import (
+    clip_ecc,
     kepler_E,
     orbits_and_freq,
     true_anomaly_continuous,
@@ -131,7 +132,11 @@ class BinaryDDBase(DelayComponent):
         orbits, forb = orbits_and_freq(p, dt, self.fb_names())
         frac = orbits - jnp.floor(orbits)
         M = 2.0 * math.pi * frac
-        e = pv(p, "ECC") + dt * pv(p, "EDOT")
+        # saturate once where e is formed: every downstream expression
+        # (kepler solve, sqrt(1-e^2), nhat = n/(1-e cosE), true anomaly)
+        # must stay finite for out-of-range trial steps; clip_ecc keeps
+        # the ECC gradient alive so fitters can step back into range
+        e = clip_ecc(pv(p, "ECC") + dt * pv(p, "EDOT"))
         E = kepler_E(M, e)
         a1 = pv(p, "A1") + dt * pv(p, "A1DOT")
         n = 2.0 * math.pi * forb
@@ -143,7 +148,8 @@ class BinaryDDBase(DelayComponent):
             nu = true_anomaly_continuous(E, e, orbits, M)
             omega = pv(p, "OM") + pv(p, "OMDOT") * dt
         er = e * (1.0 + self.d_r(p))
-        eth = e * (1.0 + self.d_th(p))
+        # eth can leave [0,1) via DR/DTH trial steps even with e in range
+        eth = clip_ecc(e * (1.0 + self.d_th(p)))
         sinE, cosE = jnp.sin(E), jnp.cos(E)
         alpha = a1 * jnp.sin(omega)
         beta = a1 * jnp.sqrt(1.0 - eth**2) * jnp.cos(omega)
@@ -206,7 +212,9 @@ class BinaryDD(BinaryDDBase):
     def _tm2_sini(self, p):
         if self.M2.value is None or self.SINI.value is None:
             return None, None
-        return pv(p, "M2") * Tsun, pv(p, "SINI")
+        # saturate with a live gradient so out-of-range trial steps keep
+        # a restoring SINI design-matrix column (see clip_unit)
+        return pv(p, "M2") * Tsun, clip_ecc(pv(p, "SINI"))
 
     def shapiro_delay(self, p, e, E, omega):
         """DD eq. [26]."""
@@ -214,10 +222,13 @@ class BinaryDD(BinaryDDBase):
         if tm2 is None:
             return jnp.zeros_like(E)
         sinE, cosE = jnp.sin(E), jnp.cos(E)
-        return -2.0 * tm2 * jnp.log(
-            1.0 - e * cosE - sini * (jnp.sin(omega) * (cosE - e)
-                                     + jnp.sqrt(1.0 - e**2)
-                                     * jnp.cos(omega) * sinE))
+        # with e and sini both saturated into [0, 1) the bracket is
+        # strictly positive; the floor is belt-and-braces against
+        # rounding at extreme conjunctions
+        arg = 1.0 - e * cosE - sini * (jnp.sin(omega) * (cosE - e)
+                                       + jnp.sqrt(1.0 - e**2)
+                                       * jnp.cos(omega) * sinE)
+        return -2.0 * tm2 * jnp.log(jnp.maximum(arg, 1e-12))
 
     def aberration_delay(self, p, e, nu, omega):
         """DD eq. [27].  No value-based short-circuit: A0/B0 default to 0

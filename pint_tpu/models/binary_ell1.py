@@ -31,6 +31,7 @@ from typing import List
 import jax.numpy as jnp
 
 from pint_tpu import Tsun
+from pint_tpu.models.binary_orbits import clip_unit
 from pint_tpu.models.parameter import (
     FloatParam,
     MJDParam,
@@ -228,8 +229,11 @@ class BinaryELL1(BinaryELL1Base):
         if self.M2.value is None or self.SINI.value is None:
             return jnp.zeros_like(Phi)
         tm2 = pv(p, "M2") * Tsun
-        sini = pv(p, "SINI")
-        return -2.0 * tm2 * jnp.log(1.0 - sini * jnp.sin(Phi))
+        # saturated with a live gradient: trial steps past SINI = 1 stay
+        # finite AND keep a restoring design-matrix column (clip_unit)
+        sini = clip_unit(pv(p, "SINI"))
+        return -2.0 * tm2 * jnp.log(
+            jnp.maximum(1.0 - sini * jnp.sin(Phi), 1e-12))
 
 
 class BinaryELL1H(BinaryELL1Base):

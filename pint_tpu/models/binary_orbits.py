@@ -21,12 +21,46 @@ from pint_tpu.models.timing_model import pv
 from pint_tpu.utils import taylor_horner, taylor_horner_deriv
 
 
+#: ceiling for saturating eccentricity/sin-inclination into [0, 1)
+UNIT_MAX = 1.0 - 1e-9
+
+
+@jax.custom_jvp
+def clip_unit(v):
+    """Saturate e or sin(i) into [0, 1) with a straight-through gradient.
+
+    A linear-fit trial step can propose values outside [0, 1) (seen on
+    real B1855+09 data, where the first GLS step overshoots).  A plain
+    clip keeps the delay finite but zeroes the parameter's gradient, so a
+    full-step fitter would silently drop its design-matrix column and
+    converge with the value stuck out of range; passing the tangent
+    through keeps the column alive and pointing back into the physical
+    region."""
+    return jnp.clip(v, 0.0, UNIT_MAX)
+
+
+clip_ecc = clip_unit
+
+
+@clip_unit.defjvp
+def _clip_unit_jvp(primals, tangents):
+    (v,), (dv,) = primals, tangents
+    return clip_unit(v), dv
+
+
 @jax.custom_jvp
 def kepler_E(M, e):
     """Solve E - e sin(E) = M for the eccentric anomaly.
 
     Newton iteration with a fixed count (12 doubles the converged digits
-    each step from the E0 = M + e sinM start; ample for e < 0.95)."""
+    each step from the E0 = M + e sinM start; ample for e < 0.95).
+
+    Defensive API boundary: e is clipped just below 1 so a caller passing
+    an unphysical eccentricity gets a finite (wrong, rejectable) answer
+    instead of the NaN the hyperbolic branch would produce.  Callers in
+    the DD family pre-saturate e with :func:`clip_unit`, so this clip never
+    binds on the fit path."""
+    e = jnp.clip(e, 0.0, UNIT_MAX)
     E = M + e * jnp.sin(M)
     for _ in range(12):
         E = E - (E - e * jnp.sin(E) - M) / (1.0 - e * jnp.cos(E))
@@ -38,7 +72,8 @@ def _kepler_E_jvp(primals, tangents):
     M, e = primals
     dM, de = tangents
     E = kepler_E(M, e)
-    dE = (dM + jnp.sin(E) * de) / (1.0 - e * jnp.cos(E))
+    ec = jnp.clip(e, 0.0, UNIT_MAX)
+    dE = (dM + jnp.sin(E) * de) / (1.0 - ec * jnp.cos(E))
     return E, dE
 
 

@@ -264,3 +264,59 @@ class TestFitRoundtrip:
             else:
                 pull = (par.value - truth[n]) / par.uncertainty
             assert abs(pull) < 5, f"{n} pull {pull}"
+
+
+class TestOutOfRangeRobustness:
+    """Trial fit steps can push SINI past 1 or ECC past 1 (seen on real
+    B1855+09 data where the first GLS step overshoots); the delay must stay
+    finite so a downhill line search can reject the step."""
+
+    def test_sini_above_one_finite(self):
+        dd = _model()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(54990, 55020, 80, dd, obs="@",
+                                          error_us=1.0)
+        r = Residuals(toas, dd)
+        p = r.pdict
+        for bad_sini in (1.001, 1.05, 2.0):
+            p2 = dd.with_x(p, jnp.asarray([bad_sini - float(dd.SINI.value)]), ["SINI"])
+            from pint_tpu.residuals import raw_phase_resids
+            out = np.asarray(raw_phase_resids(dd.calc, p2, r.batch,
+                                              r.track_mode, True, False))
+            assert np.all(np.isfinite(out)), bad_sini
+
+    def test_ecc_above_one_finite(self):
+        dd = _model()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(54990, 55020, 80, dd, obs="@",
+                                          error_us=1.0)
+        r = Residuals(toas, dd)
+        p = r.pdict
+        from pint_tpu.residuals import raw_phase_resids
+        p2 = dd.with_x(p, jnp.asarray([1.02 - float(dd.ECC.value)]), ["ECC"])
+        out = np.asarray(raw_phase_resids(dd.calc, p2, r.batch,
+                                          r.track_mode, True, False))
+        assert np.all(np.isfinite(out))
+
+    def test_out_of_range_gradient_alive(self):
+        """Contract of clip_unit: at ECC/SINI out of range the residuals
+        are finite AND the design-matrix columns stay nonzero (a plain
+        clip would zero them, letting a full-step fitter converge with
+        the value stuck out of range)."""
+        from pint_tpu.fitter import build_resid_sec_fn
+
+        dd = _model()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(54990, 55020, 80, dd, obs="@",
+                                          error_us=1.0)
+        r = Residuals(toas, dd)
+        rf = build_resid_sec_fn(dd, r.batch, ["ECC", "SINI"], r.track_mode)
+        x = jnp.asarray([1.02 - float(dd.ECC.value),
+                         1.05 - float(dd.SINI.value)])
+        J = np.asarray(jax.jacfwd(rf)(x, r.pdict))
+        assert np.all(np.isfinite(J))
+        assert np.any(J[:, 0] != 0.0), "ECC column died at the clip"
+        assert np.any(J[:, 1] != 0.0), "SINI column died at the clip"

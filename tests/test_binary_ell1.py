@@ -258,3 +258,25 @@ class TestFitRoundtrip:
             else:
                 pull = (par.value - truth[n]) / par.uncertainty
             assert abs(pull) < 5, f"{n} pull {pull}"
+
+
+class TestOutOfRangeRobustness:
+    def test_sini_above_one_finite(self):
+        """Trial steps with SINI > 1 must give finite (rejectable)
+        residuals, not NaN — the Shapiro log argument is floored."""
+        import jax.numpy as jnp
+
+        from pint_tpu.residuals import raw_phase_resids
+
+        m = _model()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(54990, 55020, 80, m, obs="@",
+                                          error_us=1.0)
+        r = Residuals(toas, m)
+        p = r.pdict
+        x = jnp.asarray([1.05 - float(m.SINI.value)])
+        out = np.asarray(raw_phase_resids(m.calc, m.with_x(p, x, ["SINI"]),
+                                          r.batch, r.track_mode, True,
+                                          False))
+        assert np.all(np.isfinite(out))
