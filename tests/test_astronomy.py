@@ -157,8 +157,9 @@ class TestSPKReader:
     def test_missing_kernel_falls_back(self, recwarn):
         ephemeris._EPHEM_CACHE.clear()
         eph = ephemeris.load_ephemeris("DE421")
-        assert isinstance(eph, ephemeris.BuiltinEphemeris)
-        assert any("builtin analytic" in str(w.message) for w in recwarn.list)
+        # named-kernel fallback is now the integrated ephemeris
+        assert isinstance(eph, ephemeris.IntegratedEphemeris)
+        assert any("integrated" in str(w.message) for w in recwarn.list)
 
     def test_synthetic_spk_roundtrip(self, tmp_path):
         """Build a tiny type-2 SPK file by hand and read it back."""
@@ -299,3 +300,75 @@ class TestObservatory:
         with pytest.warns(UserWarning):
             c = gbt.clock_corrections(np.array([55000.0]))
         assert np.all(c == 0.0)
+
+
+class TestVSOP87Earth:
+    def test_meeus_worked_example(self):
+        """Meeus, *Astronomical Algorithms*, example 25.b: the Sun's
+        geometric position on 1992 Oct 13.0 TD.  Earth heliocentric
+        longitude = sun's geometric longitude - 180 deg."""
+        from pint_tpu.data import vsop87d_earth as v
+        from pint_tpu.ephemeris import _vsop_series
+
+        tau = np.array([(48908.0 - 51544.5) / 365250.0])
+        L, _ = _vsop_series(v.L_SERIES, tau)
+        B, _ = _vsop_series(v.B_SERIES, tau)
+        R, _ = _vsop_series(v.R_SERIES, tau)
+        assert np.rad2deg(L[0]) % 360 == pytest.approx(19.907372, abs=3e-5)
+        assert np.rad2deg(B[0]) * 3600 == pytest.approx(-0.644, abs=0.02)
+        assert R[0] == pytest.approx(0.99760775, abs=1e-6)
+
+    def test_earth_sun_distance_j2000(self):
+        """Near-perihelion distance at J2000.0 (0.98333 AU)."""
+        from pint_tpu.ephemeris import vsop87_earth_helio_icrs
+
+        p, vel = vsop87_earth_helio_icrs(np.array([51544.5]))
+        au = 149597870700.0
+        assert np.linalg.norm(p[0]) / au == pytest.approx(0.983327,
+                                                          abs=2e-5)
+        # orbital speed near perihelion ~30.29 km/s
+        assert np.linalg.norm(vel[0]) / 1e3 == pytest.approx(30.29,
+                                                             abs=0.02)
+
+
+@pytest.fixture(scope="module")
+def shared_ephem_cache(tmp_path_factory):
+    """One on-disk N-body cache for the whole module: the integration
+    (tens of seconds) builds once and every test reuses it."""
+    d = tmp_path_factory.mktemp("ephem_cache")
+    old = os.environ.get("PINT_TPU_CACHE")
+    os.environ["PINT_TPU_CACHE"] = str(d)
+    yield str(d)
+    if old is None:
+        os.environ.pop("PINT_TPU_CACHE", None)
+    else:
+        os.environ["PINT_TPU_CACHE"] = old
+
+
+class TestIntegratedEphemeris:
+    def test_matches_analytic_and_is_smooth(self, shared_ephem_cache):
+        """The IC-fitted N-body trajectory stays within the analytic
+        theory's own error band (~300 km) and its spline velocity is
+        consistent with finite differences of position."""
+        ieph = ephemeris.IntegratedEphemeris(warn=False)
+        aeph = ephemeris.BuiltinEphemeris(warn=False)
+        mjd = np.linspace(54800.0, 55200.0, 50)
+        pi = ieph.posvel("earth", mjd)
+        pa = aeph.posvel("earth", mjd)
+        dn = np.linalg.norm(pi.pos - pa.pos, axis=1)
+        assert np.max(dn) < 1e6      # < 1000 km (measured: ~200 km max)
+        assert np.median(dn) < 3e5   # < 300 km (measured: ~100 km)
+        # velocity consistency: central difference of the spline position
+        h = 0.05
+        pp = ieph.posvel("earth", mjd + h).pos
+        pm = ieph.posvel("earth", mjd - h).pos
+        v_fd = (pp - pm) / (2 * h * 86400.0)
+        assert np.max(np.abs(v_fd - pi.vel)) < 1.0  # m/s
+
+    def test_sun_from_integration(self, shared_ephem_cache):
+        ieph = ephemeris.IntegratedEphemeris(warn=False)
+        mjd = np.array([55000.0])
+        sun = ieph.posvel("sun", mjd)
+        # Sun-SSB distance is of order the solar radius (0.3-2 R_sun)
+        d = np.linalg.norm(sun.pos[0])
+        assert 1e8 < d < 2.5e9
