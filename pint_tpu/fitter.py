@@ -110,19 +110,32 @@ def build_whitened_assembly(model: TimingModel, batch: TOABatch,
     (offset column appended unless the model carries PHOFF), scaled per-TOA
     uncertainties [s], and the offset regressor column (None when the
     offset is not profiled) — the assembly shared by the WLS and GLS
-    steps."""
+    steps.
+
+    The primal residuals and the jacfwd design matrix are compiled as
+    SEPARATE XLA modules when called eagerly: a single module holding
+    both chains triggers a pathological XLA:CPU optimization pass
+    (minutes-to-hours compile) whenever the jacobian has <= 2 columns
+    that all flow through the quad-single spindown arithmetic (an
+    F0/F1-only fit).  Each chain alone compiles in seconds; under an
+    outer jit/vmap (grids) they inline back into one module."""
     resid_sec = build_resid_sec_fn(model, batch, list(fit_params),
                                    track_mode)
 
+    def primal(x, p):
+        return (resid_sec(x, p),
+                model.scaled_toa_uncertainty(p, batch) * 1e-6)
+
+    primal_j = jax.jit(primal)
+    jac_j = jax.jit(jax.jacfwd(resid_sec))
+
     def assemble(x, p):
-        r = resid_sec(x, p)
-        J = jax.jacfwd(resid_sec)(x, p)
-        M = -J
+        r, sigma = primal_j(x, p)
+        M = -jac_j(x, p)
         offc = None
         if include_offset:
             offc = jnp.ones(M.shape[0])
             M = jnp.concatenate([M, -offc[:, None]], axis=1)
-        sigma = model.scaled_toa_uncertainty(p, batch) * 1e-6
         return r, M, sigma, offc
 
     return assemble
@@ -178,17 +191,25 @@ def build_wideband_assembly(model: TimingModel, batch: TOABatch,
         r_dm = dmv - model.total_dm(p2, batch)[idx]
         return jnp.concatenate([r_t, r_dm])
 
+    def primal(x, p):
+        sigma_t = model.scaled_toa_uncertainty(p, batch) * 1e-6
+        sigma_dm = scaled_dm_sigma_rows(model, p, batch, idx, dme)
+        return combined(x, p), jnp.concatenate([sigma_t, sigma_dm])
+
+    # primal and jacobian in separate XLA modules (see
+    # build_whitened_assembly for the XLA:CPU compile pathology)
+    primal_j = jax.jit(primal)
+    jac_j = jax.jit(jax.jacfwd(combined))
+
     def assemble(x, p):
-        r = combined(x, p)
-        M = -jax.jacfwd(combined)(x, p)
+        r, sigma = primal_j(x, p)
+        M = -jac_j(x, p)
         offc = None
         if include_offset:
             offc = jnp.concatenate(
                 [jnp.ones(nt), jnp.zeros(idx.shape[0])])
             M = jnp.concatenate([M, -offc[:, None]], axis=1)
-        sigma_t = model.scaled_toa_uncertainty(p, batch) * 1e-6
-        sigma_dm = scaled_dm_sigma_rows(model, p, batch, idx, dme)
-        return r, M, jnp.concatenate([sigma_t, sigma_dm]), offc
+        return r, M, sigma, offc
 
     return assemble
 
@@ -207,7 +228,14 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
     columns, where the reference uses enterprise's 1e40 constant), then
     solved by a thresholded eigendecomposition in diagonally
     preconditioned coordinates (the eigencutoff plays the reference's
-    SVD-fallback/degeneracy-warning role, `fitter.py:2639`).  Returned
+    SVD-fallback/degeneracy-warning role, `fitter.py:2639`).  NOTE:
+    ``threshold`` here is an ABSOLUTE eigenvalue cutoff in the
+    unit-column-normalized coordinates (data eigenvalues are O(ncols));
+    this differs from :func:`fit_wls_svd`, whose threshold is relative to
+    the largest singular value — a noise prior can inflate the largest
+    GLS eigenvalue by many orders, so a relative cutoff there would
+    swallow legitimately small timing eigenvalues (see the inline
+    comment at the cutoff).  Returned
     covariance and noise-realization amplitudes are in normalized
     coordinates + norms, denormalized on host (TPU f64 range; see
     `fit_wls_svd`).  chi2 is the Woodbury form r^T C^-1 r with the
@@ -221,8 +249,7 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
                                            include_offset)
 
     @jax.jit
-    def step(x, p):
-        r, M, sigma, offc = assemble(x, p)
+    def solve(r, M, sigma, offc, p):
         U = model.noise_basis(p)
         phi = model.noise_weights(p)
         if U is not None and U.shape[0] != r.shape[0]:
@@ -257,7 +284,16 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
         e, V = jnp.linalg.eigh(A)
         thr = _machine_eps() * A.shape[0] \
             if threshold is None else threshold
-        bad = e <= thr * e[-1]
+        # ABSOLUTE threshold in the normalized coordinates (timing columns
+        # have unit norm, so data-driven eigenvalues are O(ncols) and true
+        # degeneracies sit at rounding level).  A threshold relative to
+        # e[-1] breaks when a strong noise prior dominates: 1/phi for a
+        # tightly-pinned basis mode inflates e[-1] by many orders and the
+        # cutoff then swallows legitimately small timing eigenvalues —
+        # seen on B1855+09, where the deep (1 - rho^2 ~ 1e-10) OM-T0
+        # degeneracy was dropped, collapsing both uncertainties ~1e5x
+        # below tempo2's.
+        bad = e <= thr
         einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
         y = V @ (einv * (V.T @ (Mn.T @ rw)))
         sol = y / norms
@@ -283,6 +319,10 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
                 "noise_ampls": sol[ntm:], "resid_sec": r,
                 "n_bad": jnp.sum(bad)}
 
+    def step(x, p):
+        r, M, sigma, offc = assemble(x, p)
+        return solve(r, M, sigma, offc, p)
+
     return step
 
 
@@ -307,10 +347,8 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
     if assemble is None:
         assemble = build_whitened_assembly(model, batch, names, track_mode,
                                            include_offset)
-
     @jax.jit
-    def step(x, p):
-        r, M, sigma, offc = assemble(x, p)
+    def solve(r, M, sigma, offc):
         dpars, Sigma_n, norms, n_bad = fit_wls_svd(M, r, sigma, threshold)
         # chi2 at x with the offset profiled out (the linear best fit of
         # the offc regressor — ones on TOA rows, zeros on wideband DM rows
@@ -328,7 +366,64 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
                 "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
                 "resid_sec": r, "n_bad": n_bad}
 
+    def step(x, p):
+        r, M, sigma, offc = assemble(x, p)
+        return solve(r, M, sigma, offc)
+
     return step
+
+
+def build_noise_lnlike(model: TimingModel, batch: TOABatch,
+                       noise_names: Sequence[str], track_mode: str,
+                       dm_index=None, dm_data=None, dm_error=None):
+    """Jitted ``(x_noise, p) -> lnlikelihood`` over free *noise* parameters
+    (EFAC/EQUAD/ECORR/red amplitudes...) at fixed timing parameters — the
+    objective the reference's downhill fitters maximize numerically
+    (`DownhillFitter._fit_noise`, `/root/reference/src/pint/fitter.py:1167`).
+    Here it is one jitted expression, so the gradient comes from autodiff
+    instead of finite differences.
+
+    When ``dm_index/dm_data/dm_error`` are given, the wideband DM-residual
+    Gaussian term is added, so DMEFAC/DMEQUAD-class parameters have a live
+    gradient (reference `WidebandDownhillFitter` noise path)."""
+    names = list(noise_names)
+    calc = model.calc
+    log2pi = float(np.log(2.0 * np.pi))
+    wideband = dm_index is not None
+    if wideband:
+        idx = jnp.asarray(np.asarray(dm_index), dtype=jnp.int64)
+        dmv = jnp.asarray(np.asarray(dm_data, np.float64))
+        dme = jnp.asarray(np.asarray(dm_error, np.float64))
+
+    @jax.jit
+    def lnlike(x, p):
+        p2 = model.with_x(p, x, names)
+        r_cyc = raw_phase_resids(calc, p2, batch, track_mode,
+                                 subtract_mean=False, use_weights=False)
+        r = r_cyc / pv(p2, "F0")
+        sigma = model.scaled_toa_uncertainty(p2, batch) * 1e-6
+        w = 1.0 / sigma**2
+        off = jnp.sum(r * w) / jnp.sum(w)
+        r = r - off
+        U = model.noise_basis(p2)
+        phi = model.noise_weights(p2)
+        if phi is not None:
+            phi = jnp.where(phi > 0.0, phi, 1e-30)
+            dot, logdet = woodbury_dot(sigma**2, U, phi, r, r)
+        else:
+            dot = jnp.sum((r / sigma) ** 2)
+            logdet = 2.0 * jnp.sum(jnp.log(sigma))
+        ll = -0.5 * (dot + logdet + r.shape[0] * log2pi)
+        if wideband:
+            r_dm = dmv - model.total_dm(p2, batch)[idx]
+            sdm = model.scaled_dm_uncertainty(
+                p2, batch, jnp.zeros(batch.ntoas).at[idx].set(dme))[idx]
+            ll = ll - 0.5 * (jnp.sum((r_dm / sdm) ** 2)
+                             + 2.0 * jnp.sum(jnp.log(sdm))
+                             + r_dm.shape[0] * log2pi)
+        return ll
+
+    return lnlike
 
 
 def denormalize_covariance(Sigma_n, norms) -> np.ndarray:
@@ -367,27 +462,40 @@ class Fitter:
         self.parameter_covariance_matrix: Optional[np.ndarray] = None
         self.covariance_params: List[str] = []
 
+    #: True for fitters whose ``fit_toas`` maximizes the likelihood over
+    #: free noise parameters (the downhill family)
+    fits_noise = False
+
     # -- fittable parameters ---------------------------------------------
     @property
     def fit_params(self) -> List[str]:
-        """Free parameters this (linear) fitter moves: all free device
-        params except noise-component ones (white-noise parameters are fit
-        by maximum likelihood in the downhill fitters, as in the reference
-        `fitter.py:1040`)."""
-        noise_comps = {type(c).__name__ for c in self.model.noise_components}
+        """Free parameters the linear step moves: all free device params
+        except noise-component ones (those are fit by maximum likelihood
+        in the downhill fitters, as in the reference `fitter.py:1040`)."""
         out = []
         skipped = []
         for n in self.model.free_params:
-            if self.model.param_component(n) in noise_comps:
+            if self.model.param_component(n) in self._noise_comp_names():
                 skipped.append(n)
             else:
                 out.append(n)
-        if skipped:
+        if skipped and not self.fits_noise:
             warnings.warn(
                 f"free noise parameters {skipped} are not fit by "
                 f"{type(self).__name__}; freeze them or use a downhill "
-                "fitter with noise fitting")
+                "fitter (which fits them by maximum likelihood)")
         return out
+
+    def _noise_comp_names(self):
+        return {type(c).__name__ for c in self.model.noise_components}
+
+    @property
+    def free_noise_params(self) -> List[str]:
+        """Free parameters living on noise components (reference
+        `_get_free_noise_params`, `fitter.py:1146`)."""
+        noise_comps = self._noise_comp_names()
+        return [n for n in self.model.free_params
+                if self.model.param_component(n) in noise_comps]
 
     def get_designmatrix(self):
         """(M, names): the design matrix at the current parameter values,
@@ -471,6 +579,17 @@ class Fitter:
                               self.track_mode, threshold=threshold,
                               include_offset=include_offset)
 
+    def _cached_step(self, names, threshold, include_offset):
+        """Reuse one jitted step across repeated timing fits (the
+        noise-alternating loop calls _fit_timing several times; a fresh
+        closure would recompile every time)."""
+        key = (tuple(names), threshold, include_offset)
+        if getattr(self, "_step_cache_key", None) != key:
+            self._step_cache_key = key
+            self._step_cache = self._make_step(names, threshold,
+                                               include_offset)
+        return self._step_cache
+
     def _store_noise(self, out, p):
         """Recover per-component noise realizations from the basis
         amplitudes (reference `fitter.py:1952-1968`)."""
@@ -513,7 +632,7 @@ class WLSFitter(Fitter):
         names = self.fit_params
         p = self.resids.pdict
         include_offset = "PhaseOffset" not in m.components
-        step = self._make_step(names, threshold, include_offset)
+        step = self._cached_step(names, threshold, include_offset)
         x = np.zeros(len(names))
         prev_chi2 = None
         for it in range(maxiter):
@@ -556,16 +675,124 @@ class DownhillWLSFitter(Fitter):
     `DownhillFitter`/`DownhillWLSFitter`,
     `/root/reference/src/pint/fitter.py:915,1268`): a proposed step is
     halved (lambda = 1, 1/2, 1/4, ...) until chi2 decreases; convergence
-    when the step's predicted chi2 improvement is below tolerance."""
+    when the step's predicted chi2 improvement is below tolerance.
 
-    def fit_toas(self, maxiter: int = 20, threshold: Optional[float] = None,
-                 min_lambda: float = 1e-3, required_chi2_decrease: float = 1e-2,
+    Free noise parameters (EFAC/EQUAD/ECORR/red amplitudes) are fit by
+    numerically maximizing the log-likelihood, alternating with the
+    timing fit (reference `DownhillFitter.fit_toas` noise path,
+    `/root/reference/src/pint/fitter.py:1040,1167`) — here with autodiff
+    gradient and Hessian of the jitted likelihood."""
+
+    fits_noise = True
+
+    def fit_toas(self, maxiter: int = 20, noise_fit_niter: int = 2,
+                 threshold: Optional[float] = None,
+                 min_lambda: float = 1e-3,
+                 required_chi2_decrease: float = 1e-2,
                  max_chi2_increase: float = 1e-2) -> float:
+        noise_names = self.free_noise_params
+        if not noise_names:
+            return self._fit_timing(
+                maxiter=maxiter, threshold=threshold, min_lambda=min_lambda,
+                required_chi2_decrease=required_chi2_decrease,
+                max_chi2_increase=max_chi2_increase)
+        for it in range(noise_fit_niter):
+            self._fit_timing(
+                maxiter=maxiter, threshold=threshold, min_lambda=min_lambda,
+                required_chi2_decrease=required_chi2_decrease,
+                max_chi2_increase=max_chi2_increase)
+            self._fit_noise(noise_names,
+                            uncertainty=(it == noise_fit_niter - 1))
+        return self._fit_timing(
+            maxiter=maxiter, threshold=threshold, min_lambda=min_lambda,
+            required_chi2_decrease=required_chi2_decrease,
+            max_chi2_increase=max_chi2_increase)
+
+    def _fit_noise(self, noise_names: List[str],
+                   uncertainty: bool = False) -> None:
+        """Maximize the likelihood over the free noise parameters at the
+        current timing solution (reference `_fit_noise`, `fitter.py:1167`);
+        autodiff gradient, L-BFGS-B, Hessian-based uncertainties."""
+        from scipy.optimize import minimize
+
+        self.resids.update()
+        p = self.resids.pdict
+        m = self.model
+        # cache the jitted likelihood/gradient pair across the alternating
+        # iterations (same reason as _cached_step: a fresh closure would
+        # recompile every time)
+        key = tuple(noise_names)
+        if getattr(self, "_noise_lnlike_key", None) != key:
+            wb = getattr(self.resids, "dm_index", None)
+            kw = {}
+            if wb is not None:
+                # wideband: include the DM-residual Gaussian term so
+                # DMEFAC/DMEQUAD-class parameters have a live gradient
+                kw = dict(dm_index=self.resids.dm_index,
+                          dm_data=self.resids.dm_data,
+                          dm_error=self.resids.dm_error)
+            lnl = build_noise_lnlike(m, self.resids.batch, noise_names,
+                                     self.track_mode, **kw)
+            self._noise_lnlike_key = key
+            self._noise_lnlike = lnl
+            self._noise_grad = jax.jit(jax.grad(lnl))
+        lnlike = self._noise_lnlike
+        grad = self._noise_grad
+        x0 = np.asarray(m.x0(p, noise_names))
+        # an EQUAD-class parameter at exactly 0 is a stationary point of
+        # the likelihood (it enters squared): the gradient there is
+        # identically zero and a quasi-Newton iteration never leaves it.
+        # Nudge zero starts off the saddle.
+        x0 = np.where(x0 == 0.0, 0.05, x0)
+
+        def nll(x):
+            return -float(lnlike(jnp.asarray(x), p))
+
+        def nll_grad(x):
+            return -np.asarray(grad(jnp.asarray(x), p))
+
+        res = minimize(nll, x0, jac=nll_grad, method="L-BFGS-B")
+        x = res.x
+        p2 = m.with_x(p, jnp.asarray(x), noise_names)
+        m.apply_deltas(p2)
+        if uncertainty:
+            # observed information by central differences of the jitted
+            # gradient: forward-over-reverse autodiff of the likelihood
+            # NaNs on TPU's emulated f64, and 2n gradient calls are cheap
+            h = 1e-3 * np.maximum(np.abs(x), 0.1)
+            H = np.zeros((len(x), len(x)))
+            for k in range(len(x)):
+                xp = x.copy()
+                xp[k] += h[k]
+                xm = x.copy()
+                xm[k] -= h[k]
+                H[:, k] = (np.asarray(grad(jnp.asarray(xp), p))
+                           - np.asarray(grad(jnp.asarray(xm), p))) \
+                    / (2.0 * h[k])
+            H = 0.5 * (H + H.T)
+            # covariance = pseudo-inverse observed information (pinv:
+            # flat directions at a boundary give 0 rather than blowing
+            # up the whole matrix)
+            if np.all(np.isfinite(H)):
+                cov = np.linalg.pinv(-H)
+                errs = np.sqrt(np.maximum(np.diag(cov), 0.0))
+            else:
+                errs = np.full(len(noise_names), np.nan)
+            for n, e in zip(noise_names, errs):
+                if np.isfinite(e) and e > 0:
+                    m[n].set_device_uncertainty(float(e))
+        self.resids.update()
+
+    def _fit_timing(self, maxiter: int = 20,
+                    threshold: Optional[float] = None,
+                    min_lambda: float = 1e-3,
+                    required_chi2_decrease: float = 1e-2,
+                    max_chi2_increase: float = 1e-2) -> float:
         m = self.model
         names = self.fit_params
         p = self.resids.pdict
         include_offset = "PhaseOffset" not in m.components
-        step = self._make_step(names, threshold, include_offset)
+        step = self._cached_step(names, threshold, include_offset)
         x = np.zeros(len(names))
         out = step(jnp.asarray(x), p)
         chi2 = float(out["chi2"])
@@ -668,10 +895,8 @@ class LMFitter(Fitter):
         include_offset = "PhaseOffset" not in m.components
         assemble = build_whitened_assembly(m, self.resids.batch, names,
                                           self.track_mode, include_offset)
-
         @jax.jit
-        def damped_step(x, lam):
-            r, M, sigma, offc = assemble(x, p)
+        def damped_solve(r, M, sigma, offc, lam):
             Mw = M / sigma[:, None]
             rw = r / sigma
             cmax = jnp.max(jnp.abs(Mw), axis=0)
@@ -693,6 +918,10 @@ class LMFitter(Fitter):
             else:
                 chi2 = jnp.sum(rw**2)
             return dx[:len(names)], chi2
+
+        def damped_step(x, lam):
+            r, M, sigma, offc = assemble(x, p)
+            return damped_solve(r, M, sigma, offc, lam)
 
         chi2_fn = build_chi2_fn(m, self.resids.batch, names,
                                 self.track_mode, include_offset)
@@ -726,7 +955,7 @@ class LMFitter(Fitter):
                         "the best point found")
                     break
         # covariance from the undamped step at the solution
-        step = self._make_step(names, threshold, include_offset)
+        step = self._cached_step(names, threshold, include_offset)
         final = step(jnp.asarray(x), p)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
         self._store_noise(final, p)

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from pint_tpu import Tsun
 from pint_tpu.models.binary_orbits import (
-    clip_ecc,
+    clip_unit,
     kepler_E,
     orbits_and_freq,
     true_anomaly_continuous,
@@ -134,9 +134,9 @@ class BinaryDDBase(DelayComponent):
         M = 2.0 * math.pi * frac
         # saturate once where e is formed: every downstream expression
         # (kepler solve, sqrt(1-e^2), nhat = n/(1-e cosE), true anomaly)
-        # must stay finite for out-of-range trial steps; clip_ecc keeps
+        # must stay finite for out-of-range trial steps; clip_unit keeps
         # the ECC gradient alive so fitters can step back into range
-        e = clip_ecc(pv(p, "ECC") + dt * pv(p, "EDOT"))
+        e = clip_unit(pv(p, "ECC") + dt * pv(p, "EDOT"))
         E = kepler_E(M, e)
         a1 = pv(p, "A1") + dt * pv(p, "A1DOT")
         n = 2.0 * math.pi * forb
@@ -149,7 +149,7 @@ class BinaryDDBase(DelayComponent):
             omega = pv(p, "OM") + pv(p, "OMDOT") * dt
         er = e * (1.0 + self.d_r(p))
         # eth can leave [0,1) via DR/DTH trial steps even with e in range
-        eth = clip_ecc(e * (1.0 + self.d_th(p)))
+        eth = clip_unit(e * (1.0 + self.d_th(p)))
         sinE, cosE = jnp.sin(E), jnp.cos(E)
         alpha = a1 * jnp.sin(omega)
         beta = a1 * jnp.sqrt(1.0 - eth**2) * jnp.cos(omega)
@@ -214,7 +214,7 @@ class BinaryDD(BinaryDDBase):
             return None, None
         # saturate with a live gradient so out-of-range trial steps keep
         # a restoring SINI design-matrix column (see clip_unit)
-        return pv(p, "M2") * Tsun, clip_ecc(pv(p, "SINI"))
+        return pv(p, "M2") * Tsun, clip_unit(pv(p, "SINI"))
 
     def shapiro_delay(self, p, e, E, omega):
         """DD eq. [26]."""

@@ -39,8 +39,6 @@ def clip_unit(v):
     return jnp.clip(v, 0.0, UNIT_MAX)
 
 
-clip_ecc = clip_unit
-
 
 @clip_unit.defjvp
 def _clip_unit_jvp(primals, tangents):

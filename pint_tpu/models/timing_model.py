@@ -533,11 +533,24 @@ class TimingModel:
     def fit_units(self, names: Optional[Sequence[str]] = None) -> List[float]:
         """d(device)/d(par-file unit) per free param — for reporting
         uncertainties and matching reference design-matrix units."""
+        import math
+
+        from pint_tpu.models.parameter import AngleParam
+
         out = []
         for n in (self.free_params if names is None else names):
             par = self[n]
             if isinstance(par, MJDParam):
                 out.append(1.0)  # fraction-of-day: par unit is days
+            elif isinstance(par, AngleParam):
+                # device radians per par-file unit (matches the
+                # uncertainty conventions in AngleParam)
+                if par.units == "H:M:S":
+                    out.append(math.pi / (12 * 3600))
+                elif par.units == "D:M:S":
+                    out.append(math.pi / (180 * 3600))
+                else:
+                    out.append(math.pi / 180.0)
             else:
                 out.append(par.par2dev)
         return out

@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import List
 
 import jax.numpy as jnp
+import numpy as np
 
 from pint_tpu import qs
 from pint_tpu.models.chromatic import chromatic_delay
@@ -159,7 +160,12 @@ class _WaveXBasis:
             else "PEPOCH"
 
     def basis_sum(self, p: dict, batch: TOABatch, dt_shift_day) -> jnp.ndarray:
-        """sum_i [ SIN_i sin(2 pi f_i dt) + COS_i cos(2 pi f_i dt) ]."""
+        """sum_i [ SIN_i sin(2 pi f_i dt) + COS_i cos(2 pi f_i dt) ].
+
+        Vectorized over components (one (ntoas, nmodes) outer product, not
+        an unrolled per-mode loop): a few hundred modes — the scale needed
+        to whiten ephemeris-level red signals — would otherwise blow up
+        the jaxpr and the jacfwd compile."""
         idx = self.wavex_indices()
         out = jnp.zeros(batch.ntoas)
         if not idx:
@@ -167,11 +173,11 @@ class _WaveXBasis:
         dt = batch.tdb_day + batch.tdb_frac \
             - epoch_days(p, self._epoch_name()) - dt_shift_day
         fs, ss, cs = self.stems
-        for i in idx:
-            arg = 2.0 * jnp.pi * pv(p, f"{fs}{i:04d}") * dt
-            out = out + pv(p, f"{ss}{i:04d}") * jnp.sin(arg) \
-                + pv(p, f"{cs}{i:04d}") * jnp.cos(arg)
-        return out
+        f = jnp.stack([pv(p, f"{fs}{i:04d}") for i in idx])
+        a_s = jnp.stack([pv(p, f"{ss}{i:04d}") for i in idx])
+        a_c = jnp.stack([pv(p, f"{cs}{i:04d}") for i in idx])
+        arg = 2.0 * jnp.pi * dt[:, None] * f[None, :]
+        return jnp.sin(arg) @ a_s + jnp.cos(arg) @ a_c
 
 
 class WaveX(_WaveXBasis, DelayComponent):
@@ -238,3 +244,50 @@ class CMWaveX(_WaveXBasis, DelayComponent):
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         return chromatic_delay(self.cm_value(p, batch),
                                pv(p, "TNCHROMIDX"), batch.freq_mhz)
+
+
+def _wavex_setup(model, cls, T_span_day, freqs=None, n_freqs=None,
+                 freeze_params=False):
+    if (freqs is None) == (n_freqs is None):
+        raise ValueError("give exactly one of freqs or n_freqs")
+    name = cls.__name__
+    if name in model.components:
+        raise ValueError(
+            f"model already has a {name} component; use its "
+            "add_wavex_component method to extend it")
+    comp = cls()
+    model.add_component(comp)
+    if freqs is None:
+        freqs = np.arange(1, n_freqs + 1) / float(T_span_day)
+    indices = []
+    for f in np.atleast_1d(np.asarray(freqs, np.float64)):
+        indices.append(comp.add_wavex_component(float(f),
+                                                frozen=freeze_params))
+    model.validate()
+    return indices
+
+
+def wavex_setup(model, T_span_day, freqs=None, n_freqs=None,
+                freeze_params=False):
+    """Add a WaveX component with harmonic frequencies k/T_span (or the
+    explicit `freqs`, in 1/day), amplitudes zero and free unless
+    `freeze_params` (reference `wavex_setup`,
+    `/root/reference/src/pint/utils.py:1461`)."""
+    return _wavex_setup(model, WaveX, T_span_day, freqs=freqs,
+                        n_freqs=n_freqs, freeze_params=freeze_params)
+
+
+def dmwavex_setup(model, T_span_day, freqs=None, n_freqs=None,
+                  freeze_params=False):
+    """DMWaveX analogue of :func:`wavex_setup` (reference
+    `dmwavex_setup`, `/root/reference/src/pint/utils.py:1555`)."""
+    return _wavex_setup(model, DMWaveX, T_span_day, freqs=freqs,
+                        n_freqs=n_freqs, freeze_params=freeze_params)
+
+
+def cmwavex_setup(model, T_span_day, freqs=None, n_freqs=None,
+                  freeze_params=False):
+    """CMWaveX analogue of :func:`wavex_setup` (reference
+    `cmwavex_setup`, `/root/reference/src/pint/utils.py:1649`)."""
+    return _wavex_setup(model, CMWaveX, T_span_day, freqs=freqs,
+                        n_freqs=n_freqs, freeze_params=freeze_params)
