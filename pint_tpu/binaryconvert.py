@@ -28,7 +28,7 @@ __all__ = ["convert_binary"]
 SECS_PER_YEAR = 365.25 * 86400.0
 
 _ELL1_FAMILY = {"ELL1", "ELL1H", "ELL1K"}
-_DD_FAMILY = {"DD", "DDS", "DDH", "BT"}
+_DD_FAMILY = {"DD", "DDS", "DDH", "BT", "DDK"}
 _SUPPORTED = _ELL1_FAMILY | _DD_FAMILY
 
 
@@ -152,6 +152,14 @@ def convert_binary(model: TimingModel, output: str,
 
     # -- Shapiro parameterization -----------------------------------------
     m2, sini_v = _val(model, "M2"), _val(model, "SINI")
+    if current == "DDK":
+        # the observed inclination is KIN; KOM/K96 have no counterpart
+        # outside DDK (reference `binaryconvert.py` drops them the same
+        # way when leaving DDK)
+        kin_v = _val(model, "KIN")
+        if kin_v is not None:
+            sini_v = math.sin(math.radians(kin_v))
+        drop |= {"KIN", "KOM", "K96"}
     if current == "DDS" and model.SHAPMAX.value is not None:
         sini_v = 1.0 - math.exp(-float(model.SHAPMAX.value))
         drop.add("SHAPMAX")
@@ -174,6 +182,23 @@ def convert_binary(model: TimingModel, output: str,
         if m2 is not None and sini_v is not None:
             h3, stig = _orthometric_from_m2sini(m2, sini_v)
             add += [("H3", f"{h3:.15g}"), ("STIGMA", f"{stig:.15g}")]
+    elif output == "DDK":
+        drop |= {"SINI"}
+        if sini_v is None:
+            raise ValueError(
+                "converting to DDK needs an inclination: the source "
+                "model has no SINI/KIN-equivalent")
+        kin_deg = math.degrees(math.asin(min(sini_v, 1.0)))
+        kom_deg = kwargs.get("KOM", 0.0)
+        if "KOM" not in kwargs:
+            import warnings as _w
+
+            _w.warn("convert_binary to DDK: KOM is not determined by "
+                    "SINI; defaulting to 0 deg (pass KOM=... to set). "
+                    "KIN is the i < 90 deg branch of arcsin(SINI).")
+        add += [("KIN", f"{kin_deg:.12f}"), ("KOM", f"{kom_deg:.12f}")]
+        if m2 is not None and "M2" not in model:
+            add += [("M2", f"{m2:.15g}")]
     elif output == "DDS":
         drop |= {"SINI"}
         if sini_v is not None:

@@ -94,6 +94,30 @@ class Astrometry(DelayComponent):
         """Unit vector SSB→pulsar at each TOA, shape (N, 3)."""
         raise NotImplementedError
 
+    #: (pm_lon_name, pm_lat_name) in this frame — set by subclasses
+    _pm_names = ()
+
+    def _obs_pos_frame(self, batch: TOABatch) -> jnp.ndarray:
+        """SSB→observatory vector [ls] in this astrometry's native frame
+        (identity for equatorial; ecliptic subclass rotates)."""
+        return batch.ssb_obs_pos_ls
+
+    def kopeikin_frame(self, p: dict, batch: TOABatch):
+        """The inputs of the Kopeikin (1995, 1996) annual-orbital-parallax
+        and proper-motion corrections, in this astrometry's native frame
+        (reference `DDK_model.psr_pos`/`obs_pos`,
+        `/root/reference/src/pint/models/stand_alone_psr_binaries/DDK_model.py:106`):
+
+        ``(sin_long, cos_long, sin_lat, cos_lat, mu_long, mu_lat,
+        obs_pos)`` with the proper motions in rad/yr and obs_pos in
+        light-seconds, shape (N, 3)."""
+        lon_name, lat_name = self._angle_names
+        sl, cl = self._sincos(p, lon_name)
+        sb, cb = self._sincos(p, lat_name)
+        mu_lon = pv(p, self._pm_names[0]) * MAS_TO_RAD
+        mu_lat = pv(p, self._pm_names[1]) * MAS_TO_RAD
+        return sl, cl, sb, cb, mu_lon, mu_lat, self._obs_pos_frame(batch)
+
     def pos_epoch_name(self) -> str:
         if self.POSEPOCH.value is not None:
             return "POSEPOCH"
@@ -127,6 +151,7 @@ class AstrometryEquatorial(Astrometry):
 
     register = True
     _angle_names = ("RAJ", "DECJ")
+    _pm_names = ("PMRA", "PMDEC")
 
     def __init__(self):
         super().__init__()
@@ -177,6 +202,7 @@ class AstrometryEcliptic(Astrometry):
 
     register = True
     _angle_names = ("ELONG", "ELAT")
+    _pm_names = ("PMELONG", "PMELAT")
 
     def __init__(self):
         super().__init__()
@@ -204,6 +230,16 @@ class AstrometryEcliptic(Astrometry):
             return _OBLIQUITY[ecl]
         except KeyError:
             raise ValueError(f"unknown ecliptic convention ECL={ecl}")
+
+    def _obs_pos_frame(self, batch: TOABatch) -> jnp.ndarray:
+        """ssb_obs_pos rotated ICRS -> this model's ecliptic frame."""
+        eps = self.obliquity()
+        ce, se = math.cos(eps), math.sin(eps)
+        r = batch.ssb_obs_pos_ls
+        x = r[:, 0]
+        y = ce * r[:, 1] + se * r[:, 2]
+        z = -se * r[:, 1] + ce * r[:, 2]
+        return jnp.stack([x, y, z], axis=-1)
 
     def psr_dir(self, p: dict, batch: TOABatch) -> jnp.ndarray:
         sl, cl = self._sincos(p, "ELONG")

@@ -17,9 +17,11 @@ import math
 from typing import List
 
 import jax.numpy as jnp
+import numpy as np
 
 from pint_tpu import Tsun
 from pint_tpu.models.binary_orbits import (
+    OrbwaveMixin,
     clip_unit,
     kepler_E,
     orbits_and_freq,
@@ -41,7 +43,7 @@ DEG_PER_YEAR = (math.pi / 180.0) / SECS_PER_YEAR
 DEG = math.pi / 180.0
 
 
-class BinaryDDBase(DelayComponent):
+class BinaryDDBase(OrbwaveMixin, DelayComponent):
     """Shared Keplerian machinery (T0/ECC/OM parameterization)."""
 
     category = "pulsar_system"
@@ -80,6 +82,7 @@ class BinaryDDBase(DelayComponent):
             description_template=lambda i:
             f"Orbital frequency derivative {i}" if i else
             "Orbital frequency (alternative to PB)"))
+        self._init_orbwave_params()
 
     def make_param(self, name: str):
         try:
@@ -90,7 +93,14 @@ class BinaryDDBase(DelayComponent):
             return prefixParameter("float", name, units=f"1/s^{index + 1}",
                                    description_template=lambda i:
                                    f"Orbital frequency derivative {i}")
+        made = self._make_orbwave_param(stem, name)
+        if made is not None:
+            return made
         return None
+
+    def prefix_families(self):
+        # ORBWAVEC/S exist only on demand; FB is discoverable via FB0
+        return ["ORBWAVEC", "ORBWAVES"]
 
     def fb_names(self) -> List[str]:
         return [q.name for q in self.prefix_params("FB")
@@ -111,6 +121,7 @@ class BinaryDDBase(DelayComponent):
                     "run 0..k without gaps")
         if not 0.0 <= self.ECC.value < 1.0:
             raise ValueError("ECC must be in [0, 1)")
+        self._validate_orbwaves()
 
     # -- hooks for the model variants -------------------------------------
     def d_r(self, p):
@@ -121,15 +132,31 @@ class BinaryDDBase(DelayComponent):
         """Relativistic deformation of the angular eccentricity (DTH)."""
         return 0.0
 
-    def shapiro_delay(self, p, e, E, omega):
+    def shapiro_delay(self, p, e, E, omega, batch, dt):
         return jnp.zeros_like(E)
 
     def aberration_delay(self, p, e, nu, omega):
         return jnp.zeros_like(nu)
 
+    def a1_val(self, p, batch, dt):
+        """Projected semi-major axis [ls] at each TOA; DDK adds the
+        Kopeikin proper-motion/annual-parallax corrections."""
+        return pv(p, "A1") + dt * pv(p, "A1DOT")
+
+    def omega_extra(self, p, batch, dt):
+        """Additive per-TOA correction to omega [rad] (0 except DDK)."""
+        return 0.0
+
+    def dt_extra(self, p, batch, dt):
+        """Per-TOA adjustment of (t - T0) [s]; identity except for the
+        piecewise models, which re-reference whole MJD ranges to
+        alternative epochs."""
+        return dt
+
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
-        dt = dt_seconds_qs(p, batch, delay, "T0")[1]
-        orbits, forb = orbits_and_freq(p, dt, self.fb_names())
+        dt = self.dt_extra(p, batch, dt_seconds_qs(p, batch, delay, "T0")[1])
+        orbits, forb = self._apply_orbwaves(
+            p, batch, delay, *orbits_and_freq(p, dt, self.fb_names()))
         frac = orbits - jnp.floor(orbits)
         M = 2.0 * math.pi * frac
         # saturate once where e is formed: every downstream expression
@@ -138,7 +165,7 @@ class BinaryDDBase(DelayComponent):
         # the ECC gradient alive so fitters can step back into range
         e = clip_unit(pv(p, "ECC") + dt * pv(p, "EDOT"))
         E = kepler_E(M, e)
-        a1 = pv(p, "A1") + dt * pv(p, "A1DOT")
+        a1 = self.a1_val(p, batch, dt)
         n = 2.0 * math.pi * forb
         if self.omega_from_nu:
             nu = true_anomaly_continuous(E, e, orbits, M)
@@ -147,6 +174,7 @@ class BinaryDDBase(DelayComponent):
         else:
             nu = true_anomaly_continuous(E, e, orbits, M)
             omega = pv(p, "OM") + pv(p, "OMDOT") * dt
+        omega = omega + self.omega_extra(p, batch, dt)
         er = e * (1.0 + self.d_r(p))
         # eth can leave [0,1) via DR/DTH trial steps even with e in range
         eth = clip_unit(e * (1.0 + self.d_th(p)))
@@ -164,7 +192,7 @@ class BinaryDDBase(DelayComponent):
             1.0 - nhat * Drep + (nhat * Drep) ** 2
             + 0.5 * nhat**2 * Dre * Drepp
             - 0.5 * e * sinE / (1.0 - e * cosE) * nhat**2 * Dre * Drep)
-        return delayI + self.shapiro_delay(p, e, E, omega) \
+        return delayI + self.shapiro_delay(p, e, E, omega, batch, dt) \
             + self.aberration_delay(p, e, nu, omega)
 
 
@@ -209,16 +237,16 @@ class BinaryDD(BinaryDDBase):
     def d_th(self, p):
         return pv(p, "DTH")
 
-    def _tm2_sini(self, p):
+    def _tm2_sini(self, p, batch, dt):
         if self.M2.value is None or self.SINI.value is None:
             return None, None
         # saturate with a live gradient so out-of-range trial steps keep
         # a restoring SINI design-matrix column (see clip_unit)
         return pv(p, "M2") * Tsun, clip_unit(pv(p, "SINI"))
 
-    def shapiro_delay(self, p, e, E, omega):
+    def shapiro_delay(self, p, e, E, omega, batch, dt):
         """DD eq. [26]."""
-        tm2, sini = self._tm2_sini(p)
+        tm2, sini = self._tm2_sini(p, batch, dt)
         if tm2 is None:
             return jnp.zeros_like(E)
         sinE, cosE = jnp.sin(E), jnp.cos(E)
@@ -255,7 +283,7 @@ class BinaryDDS(BinaryDD):
         BinaryDDBase.validate(self)
         self.require("SHAPMAX")
 
-    def _tm2_sini(self, p):
+    def _tm2_sini(self, p, batch, dt):
         if self.M2.value is None or self.SHAPMAX.value is None:
             return None, None
         return pv(p, "M2") * Tsun, 1.0 - jnp.exp(-pv(p, "SHAPMAX"))
@@ -281,6 +309,316 @@ class BinaryDDH(BinaryDD):
         BinaryDDBase.validate(self)
         self.require("H3", "STIGMA")
 
-    def _tm2_sini(self, p):
+    def _tm2_sini(self, p, batch, dt):
         h3, sig = pv(p, "H3"), pv(p, "STIGMA")
         return h3 / sig**3, 2.0 * sig / (1.0 + sig**2)
+
+
+class BinaryDDK(BinaryDD):
+    """DD with Kopeikin annual-orbital-parallax and proper-motion
+    corrections (reference `binary_ddk.py:45` +
+    `stand_alone_psr_binaries/DDK_model.py`; Kopeikin 1995 eqs. 15-19,
+    Kopeikin 1996 eqs. 8-10; Damour & Taylor 1992 KIN/KOM convention).
+
+    SINI is replaced by the inclination KIN and the longitude of the
+    ascending node KOM; the observed a1, omega and sin(i) then vary with
+    time through the Earth's orbit (annual-orbital parallax, scale 1/PX)
+    and the pulsar's proper motion (K96 flag, Kopeikin 1996).  The
+    corrections are evaluated in the astrometry component's native frame
+    (equatorial or ecliptic), exactly as the reference does.
+    """
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.remove_param("SINI")
+        self.add_param(FloatParam("KIN", units="deg", par2dev=DEG,
+                                  description="Orbital inclination"))
+        self.add_param(FloatParam("KOM", units="deg", par2dev=DEG,
+                                  description="Longitude of ascending "
+                                              "node (DT92, E through N)"))
+        from pint_tpu.models.parameter import BoolParam
+
+        self.add_param(BoolParam("K96", value=True,
+                                 description="Apply Kopeikin 1996 "
+                                             "proper-motion corrections"))
+
+    def validate(self):
+        BinaryDDBase.validate(self)
+        self.require("KIN", "KOM")
+        if self._parent is not None:
+            if "PX" not in self._parent or \
+                    self._parent.PX.value is None:
+                import warnings as _w
+
+                _w.warn("DDK's annual-orbital-parallax terms need PX; "
+                        "PX is unset (treated as 0: terms disabled)")
+
+    def _astrometry(self):
+        for comp in self._parent.components.values():
+            if hasattr(comp, "kopeikin_frame"):
+                return comp
+        raise AttributeError("BinaryDDK needs an astrometry component")
+
+    def _kopeikin(self, p, batch, dt):
+        """(delta_a1 [ls], delta_omega [rad], kin [rad] per TOA)."""
+        sl, cl, sb, cb, mu_lon, mu_lat, obs = \
+            self._astrometry().kopeikin_frame(p, batch)
+        skom, ckom = jnp.sin(pv(p, "KOM")), jnp.cos(pv(p, "KOM"))
+        kin0 = pv(p, "KIN")
+        tt0_yr = dt / SECS_PER_YEAR
+        # K96 is a host boolean flag (never fit), folded in as a constant
+        k96 = 1.0 if self.K96.value else 0.0
+        # Kopeikin 1996 eq. 10: secular inclination change from PM
+        d_kin = k96 * (-mu_lon * skom + mu_lat * ckom) * tt0_yr
+        kin = kin0 + d_kin
+        sin_kin = jnp.sin(kin)
+        cos_kin = jnp.cos(kin)
+        a1_0 = pv(p, "A1") + dt * pv(p, "A1DOT")
+        # Kopeikin 1996 eqs. 8-9
+        d_a1_pm = a1_0 * d_kin * cos_kin / sin_kin
+        d_om_pm = k96 * (mu_lon * ckom + mu_lat * skom) * tt0_yr / sin_kin
+        # Kopeikin 1995 eqs. 15-19 (annual-orbital parallax); obs in ls,
+        # 1/d expressed as PX/KPC_LS so PX = 0 cleanly disables the terms
+        from pint_tpu.models.astrometry import KPC_LS
+
+        dI0 = -obs[:, 0] * sl + obs[:, 1] * cl
+        dJ0 = -obs[:, 0] * sb * cl - obs[:, 1] * sb * sl + obs[:, 2] * cb
+        inv_d = pv(p, "PX") / KPC_LS
+        d_a1_px = a1_0 * cos_kin / sin_kin * (dI0 * skom - dJ0 * ckom) \
+            * inv_d
+        d_om_px = -(dI0 * ckom + dJ0 * skom) * inv_d / sin_kin
+        return d_a1_pm + d_a1_px, d_om_pm + d_om_px, kin
+
+    # The Kopeikin triple feeds three hooks per delay evaluation;
+    # delay() computes it once and scopes it to the super() call so the
+    # astrometry frame/trig/parallax chain is traced a single time (the
+    # memo holds tracers only while the enclosing trace is alive).
+    _kop_active = None
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        dt = self.dt_extra(p, batch,
+                           dt_seconds_qs(p, batch, delay, "T0")[1])
+        self._kop_active = self._kopeikin(p, batch, dt)
+        try:
+            return super().delay(p, batch, delay)
+        finally:
+            self._kop_active = None
+
+    def a1_val(self, p, batch, dt):
+        d_a1, _, _ = self._kop_active
+        return pv(p, "A1") + dt * pv(p, "A1DOT") + d_a1
+
+    def omega_extra(self, p, batch, dt):
+        _, d_om, _ = self._kop_active
+        return d_om
+
+    def _tm2_sini(self, p, batch, dt):
+        if self.M2.value is None:
+            return None, None
+        _, _, kin = self._kop_active
+        return pv(p, "M2") * Tsun, clip_unit(jnp.sin(kin))
+
+
+class BinaryDDGR(BinaryDD):
+    """DD with general relativity assumed: every post-Keplerian quantity
+    (SINI, GAMMA, OMDOT, PBDOT, DR, DTH) is *derived* from the component
+    masses (reference `binary_dd.py:211` + `DDGR_model.py`; Taylor &
+    Weisberg 1989 eqs. 15-25; tempo's mass2dd).
+
+    Parameters: MTOT (total mass), M2 (companion), plus optional XOMDOT/
+    XPBDOT excesses beyond the GR prediction.  Any SINI/GAMMA/OMDOT/
+    PBDOT/DR/DTH in the par file are read but overridden, exactly like
+    the reference.  The derived quantities are injected as traced offsets
+    in the params pytree, so fits autodiff straight through the GR
+    formulas (d(delay)/d(MTOT) needs no hand-written derivatives, unlike
+    the reference's d_omega_d_MTOT etc.).
+    """
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.remove_param("SINI")
+        self.add_param(FloatParam("MTOT", units="Msun", aliases=["MTOT"],
+                                  description="Total system mass"))
+        self.add_param(FloatParam("XOMDOT", value=0.0, units="deg/yr",
+                                  par2dev=DEG_PER_YEAR,
+                                  description="Excess OMDOT beyond GR"))
+        self.add_param(FloatParam("XPBDOT", value=0.0, units="d/d",
+                                  unit_scale=True,
+                                  description="Excess PBDOT beyond GR"))
+
+    def validate(self):
+        BinaryDDBase.validate(self)
+        self.require("MTOT", "M2")
+
+    def _gr_pk(self, p):
+        """Derived PK quantities from (MTOT, M2, PB, ECC, A1) — Taylor &
+        Weisberg (1989) eqs. 15-25 in c = 1 seconds units
+        (Tsun = GM_sun/c^3)."""
+        mtot = pv(p, "MTOT")
+        m2 = pv(p, "M2")
+        m1 = mtot - m2
+        e = pv(p, "ECC")
+        a1 = pv(p, "A1")
+        fbs = self.fb_names()
+        if fbs:
+            n = 2.0 * math.pi * pv(p, fbs[0])
+        else:
+            n = 2.0 * math.pi / pv(p, "PB")
+        gm = Tsun * mtot                      # [s]
+        arr0 = (gm / n**2) ** (1.0 / 3.0)     # [s] non-relativistic
+        # relativistic Kepler (TW89 eq. 15), fixed-count iteration: the
+        # correction is O(Tsun*M/arr) ~ 1e-6, so each pass squares the
+        # residual -- 4 is ample
+        corr = m1 * m2 / mtot**2 - 9.0
+        arr = arr0
+        for _ in range(4):
+            arr = arr0 * (1.0 + corr * gm / (2.0 * arr)) ** (2.0 / 3.0)
+        ar = arr * m2 / mtot
+        sini = a1 / ar                        # TW89 eq. 20
+        gamma = e * Tsun * m2 * (m1 + 2.0 * m2) / (n * arr0 * mtot)
+        fe = (1.0 + (73.0 / 24.0) * e**2 + (37.0 / 96.0) * e**4) \
+            * (1.0 - e**2) ** -3.5            # TW89 eq. 19
+        # TW89 eq. 18, dimensionless (masses in Msun, Tsun carries GM/c^3)
+        pbdot = (-192.0 * math.pi / 5.0) * (n * Tsun) ** (5.0 / 3.0) \
+            * m1 * m2 * mtot ** (-1.0 / 3.0) * fe
+        k = 3.0 * gm / (arr0 * (1.0 - e**2))  # TW89 eq. 16, per-orbit/2pi
+        dr = Tsun * (3.0 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) \
+            / (mtot * arr)                    # TW89 eq. 24
+        dth = Tsun * (3.5 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) \
+            / (mtot * arr)                    # TW89 eq. 25
+        return {"sini": sini, "gamma": gamma, "pbdot": pbdot, "k": k,
+                "dr": dr, "dth": dth, "n": n}
+
+    def _with_gr(self, p):
+        """Pytree with the GR-derived PK values injected as offsets, so
+        the base DD machinery (and autodiff) sees them as parameters."""
+        pk = self._gr_pk(p)
+        # omega = OM + (OMDOT/n) nu in the base class; the GR advance is
+        # k nu with k per-radian-of-nu, plus the XOMDOT excess
+        omdot = pk["k"] * pk["n"] + pv(p, "XOMDOT")
+        pbdot = pk["pbdot"] + pv(p, "XPBDOT")
+        delta = dict(p["delta"])
+        for name, val in (("GAMMA", pk["gamma"]), ("OMDOT", omdot),
+                          ("PBDOT", pbdot), ("DR", pk["dr"]),
+                          ("DTH", pk["dth"])):
+            delta[name] = val - p["const"][name]
+        p2 = dict(p)
+        p2["delta"] = delta
+        return p2, pk
+
+    def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
+        p2, _pk = self._with_gr(p)
+        return super().delay(p2, batch, delay)
+
+    def _tm2_sini(self, p, batch, dt):
+        pk = self._gr_pk(p)
+        return pv(p, "M2") * Tsun, clip_unit(pk["sini"])
+
+
+class BinaryBTPiecewise(BinaryBT):
+    """BT with piecewise-constant T0 and/or A1 over MJD ranges (reference
+    `binary_bt.py:84` + `stand_alone_psr_binaries/BT_piecewise.py`).
+
+    Each piece ``i`` is an MJD window [XR1_iiii, XR2_iiii] carrying an
+    alternative epoch T0X_iiii [MJD] and/or projected semi-major axis
+    A1X_iiii [ls]; TOAs outside every window use the global T0/A1.  The
+    window membership masks are computed host-side into the pytree (like
+    MaskParams), so the delay stays one branch-free jitted chain.
+    """
+
+    register = True
+    _stems = ("T0X_", "A1X_", "XR1_", "XR2_")
+
+    def piece_indices(self) -> List[int]:
+        return sorted({q.index for q in self.prefix_params("XR1_")})
+
+    def add_piece(self, xr1: float, xr2: float, t0x=None, a1x=None,
+                  index=None, frozen=True):
+        if index is None:
+            index = 1 + max(self.piece_indices(), default=-1)
+        self.add_param(prefixParameter("float", f"XR1_{index:04d}",
+                                       units="d", value=xr1))
+        self.add_param(prefixParameter("float", f"XR2_{index:04d}",
+                                       units="d", value=xr2))
+        if t0x is not None:
+            self.add_param(prefixParameter("float", f"T0X_{index:04d}",
+                                           units="d", value=t0x,
+                                           frozen=frozen))
+        if a1x is not None:
+            self.add_param(prefixParameter("float", f"A1X_{index:04d}",
+                                           units="ls", value=a1x,
+                                           frozen=frozen))
+        return index
+
+    def prefix_families(self):
+        return list(self._stems) + super().prefix_families()
+
+    def make_param(self, name: str):
+        try:
+            stem, _ = split_prefix(name)
+        except ValueError:
+            return None
+        if stem in ("XR1_", "XR2_", "T0X_"):
+            return prefixParameter("float", name, units="d")
+        if stem == "A1X_":
+            return prefixParameter("float", name, units="ls")
+        return super().make_param(name)
+
+    def validate(self):
+        super().validate()
+        for i in self.piece_indices():
+            x1 = self.params.get(f"XR1_{i:04d}")
+            x2 = self.params.get(f"XR2_{i:04d}")
+            if x1 is None or x2 is None or x1.value is None \
+                    or x2.value is None:
+                raise ValueError(f"piece {i}: XR1/XR2 must both be given")
+            if not x1.value < x2.value:
+                raise ValueError(f"piece {i}: XR1 must be < XR2")
+        # overlapping windows would double-apply T0/A1 shifts (reference
+        # BT_piecewise raises 'Group boundary overlap detected')
+        spans = sorted((float(self.params[f"XR1_{i:04d}"].value),
+                        float(self.params[f"XR2_{i:04d}"].value), i)
+                       for i in self.piece_indices())
+        for (a1_, a2_, ia), (b1_, _b2, ib) in zip(spans, spans[1:]):
+            if b1_ < a2_:
+                raise ValueError(
+                    f"piece windows {ia} and {ib} overlap "
+                    f"([{a1_}, {a2_}) vs [{b1_}, ...))")
+
+    def mask_entries(self, toas):
+        out = super().mask_entries(toas)
+        mjd = np.asarray(toas.tdb.mjd_float)
+        for i in self.piece_indices():
+            x1 = float(self.params[f"XR1_{i:04d}"].value)
+            x2 = float(self.params[f"XR2_{i:04d}"].value)
+            out[f"__btpw_mask_{i:04d}__"] = \
+                ((mjd >= x1) & (mjd < x2)).astype(np.float64)
+        return out
+
+    def dt_extra(self, p, batch, dt):
+        from pint_tpu.models.timing_model import epoch_days
+
+        t0_day = epoch_days(p, "T0")
+        for i in self.piece_indices():
+            if f"T0X_{i:04d}" not in self.params or \
+                    self.params[f"T0X_{i:04d}"].value is None:
+                continue
+            mask = p["mask"][f"__btpw_mask_{i:04d}__"]
+            shift = (t0_day - pv(p, f"T0X_{i:04d}")) * SECS_PER_DAY
+            dt = dt + mask * shift
+        return dt
+
+    def a1_val(self, p, batch, dt):
+        a1 = super().a1_val(p, batch, dt)
+        for i in self.piece_indices():
+            if f"A1X_{i:04d}" not in self.params or \
+                    self.params[f"A1X_{i:04d}"].value is None:
+                continue
+            mask = p["mask"][f"__btpw_mask_{i:04d}__"]
+            a1 = a1 + mask * (pv(p, f"A1X_{i:04d}")
+                              + dt * pv(p, "A1DOT") - a1)
+        return a1

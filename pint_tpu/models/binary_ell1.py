@@ -31,7 +31,7 @@ from typing import List
 import jax.numpy as jnp
 
 from pint_tpu import Tsun
-from pint_tpu.models.binary_orbits import clip_unit
+from pint_tpu.models.binary_orbits import OrbwaveMixin, clip_unit
 from pint_tpu.models.parameter import (
     FloatParam,
     MJDParam,
@@ -84,7 +84,7 @@ def roemer_series(Phi, e1, e2, dphi_order: int = 0):
     return out
 
 
-class BinaryELL1Base(DelayComponent):
+class BinaryELL1Base(OrbwaveMixin, DelayComponent):
     """Shared ELL1 machinery; subclasses provide the Shapiro delay."""
 
     category = "pulsar_system"
@@ -114,6 +114,7 @@ class BinaryELL1Base(DelayComponent):
             f"Orbital frequency derivative {i}" if i else
             "Orbital frequency (alternative to PB)"))
         self.FB0.value = None
+        self._init_orbwave_params()
         self.add_param(funcParameter(
             "ECC", params=("EPS1", "EPS2"),
             func=lambda e1, e2: math.hypot(e1, e2),
@@ -133,7 +134,14 @@ class BinaryELL1Base(DelayComponent):
             return prefixParameter("float", name, units=f"1/s^{index + 1}",
                                    description_template=lambda i:
                                    f"Orbital frequency derivative {i}")
+        made = self._make_orbwave_param(stem, name)
+        if made is not None:
+            return made
         return None
+
+    def prefix_families(self):
+        # ORBWAVEC/S exist only on demand; FB is discoverable via FB0
+        return ["ORBWAVEC", "ORBWAVES"]
 
     def fb_names(self) -> List[str]:
         return [q.name for q in self.prefix_params("FB")
@@ -155,17 +163,21 @@ class BinaryELL1Base(DelayComponent):
                 raise ValueError(
                     f"non-contiguous FB series at {n}: FB indices must "
                     "run 0..k without gaps")
+        self._validate_orbwaves()
 
     # -- orbital kinematics ------------------------------------------------
     def _ttasc(self, p: dict, batch: TOABatch, delay):
         """(t_bary - TASC) [s], f64 (exact two-part difference)."""
         return dt_seconds_qs(p, batch, delay, "TASC")[1]
 
-    def _orbits_and_freq(self, p: dict, dt):
-        """(orbit count, orbital frequency [1/s]) at dt = t - TASC."""
+    def _orbits_and_freq(self, p: dict, dt, batch, delay):
+        """(orbit count, orbital frequency [1/s]) at dt = t - TASC, plus
+        the ORBWAVE Fourier phase variations when present (reference
+        `OrbitWaves`, an alternative to the FBn Taylor series)."""
         from pint_tpu.models.binary_orbits import orbits_and_freq
 
-        return orbits_and_freq(p, dt, self.fb_names())
+        return self._apply_orbwaves(
+            p, batch, delay, *orbits_and_freq(p, dt, self.fb_names()))
 
     def _eps(self, p: dict, dt):
         """(eps1(t), eps2(t))."""
@@ -185,7 +197,7 @@ class BinaryELL1Base(DelayComponent):
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         dt = self._ttasc(p, batch, delay)
-        orbits, forb = self._orbits_and_freq(p, dt)
+        orbits, forb = self._orbits_and_freq(p, dt, batch, delay)
         # reduce to [0,1) before the 2*pi multiply so sin/cos see small args
         Phi = 2.0 * math.pi * (orbits - jnp.floor(orbits))
         e1, e2 = self._eps(p, dt)

@@ -18,6 +18,9 @@ BINARY_COMPONENTS = {
     "DD": "BinaryDD",
     "DDS": "BinaryDDS",
     "DDH": "BinaryDDH",
+    "DDK": "BinaryDDK",
+    "DDGR": "BinaryDDGR",
+    "BT_PIECEWISE": "BinaryBTPiecewise",
 }
 
 

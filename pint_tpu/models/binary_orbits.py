@@ -97,3 +97,97 @@ def orbits_and_freq(p: dict, dt, fb_names):
     phase = dt / pb - 0.5 * pbdot * (dt / pb) ** 2
     freq = (1.0 - pbdot * (dt / pb)) / pb
     return phase, freq
+
+
+def orbwave_delta(p, batch, delay_sec, c_names, s_names):
+    """(delta_orbits, delta_freq [1/s]) of the ORBWAVE Fourier series for
+    orbital-phase variations (reference `OrbitWaves._deltaPhi`,
+    `stand_alone_psr_binaries/binary_orbits.py:243`; an alternative to
+    the FBn Taylor expansion):
+
+        dphi = sum_n [ C_n cos((n+1) OM tw) + S_n sin((n+1) OM tw) ]
+
+    with tw = t_bary - ORBWAVE_EPOCH [s] (barycentric arrival time, i.e.
+    TDB minus the accumulated delay, matching the reference's
+    `OrbitWaves._tw`) and OM = ORBWAVE_OM [rad/s]."""
+    om = pv(p, "ORBWAVE_OM")
+    tw = (batch.tdb_day + batch.tdb_frac
+          - pv(p, "ORBWAVE_EPOCH")) * 86400.0 - delay_sec
+    dphi = jnp.zeros(tw.shape)
+    dfreq = jnp.zeros(tw.shape)
+    for k, (cn, sn) in enumerate(zip(c_names, s_names)):
+        w = (k + 1.0) * om
+        arg = w * tw
+        cc, ss = jnp.cos(arg), jnp.sin(arg)
+        C, S = pv(p, cn), pv(p, sn)
+        dphi = dphi + C * cc + S * ss
+        # d(orbits)/dt — dphi is already in orbit counts, plain chain rule
+        dfreq = dfreq + w * (S * cc - C * ss)
+    return dphi, dfreq
+
+
+class OrbwaveMixin:
+    """Shared ORBWAVE plumbing for the DD and ELL1 binary families:
+    parameter creation, on-demand prefixed members, contiguity
+    validation, and application to (orbits, frequency).
+
+    Host classes call :meth:`_init_orbwave_params` from ``__init__``,
+    include :meth:`_make_orbwave_param`'s result in ``make_param``,
+    ``"ORBWAVEC"/"ORBWAVES"`` in ``prefix_families``,
+    :meth:`_validate_orbwaves` in ``validate``, and
+    :meth:`_apply_orbwaves` after the Taylor orbit computation."""
+
+    def _init_orbwave_params(self):
+        from pint_tpu.models.parameter import FloatParam
+
+        self.add_param(FloatParam(
+            "ORBWAVE_OM", units="rad/s",
+            description="ORBWAVE base angular frequency"))
+        self.add_param(FloatParam(
+            "ORBWAVE_EPOCH", units="d",
+            description="ORBWAVE reference epoch"))
+
+    @staticmethod
+    def _make_orbwave_param(stem, name):
+        from pint_tpu.models.parameter import prefixParameter
+
+        if stem in ("ORBWAVEC", "ORBWAVES"):
+            return prefixParameter("float", name, units="",
+                                   description_template=lambda i:
+                                   f"ORBWAVE harmonic {i}")
+        return None
+
+    def orbwave_names(self):
+        cs = sorted((q.index, q.name)
+                    for q in self.prefix_params("ORBWAVEC")
+                    if q.value is not None)
+        ss = sorted((q.index, q.name)
+                    for q in self.prefix_params("ORBWAVES")
+                    if q.value is not None)
+        return [n for _, n in cs], [n for _, n in ss]
+
+    def _validate_orbwaves(self):
+        cs, ss = self.orbwave_names()
+        if len(cs) != len(ss):
+            raise ValueError(
+                f"ORBWAVE needs matching C/S pairs (got {len(cs)} C, "
+                f"{len(ss)} S)")
+        # harmonic number comes from the index: a gap would silently
+        # shift every higher harmonic (reference OrbitWaves raises the
+        # same way, binary_orbits.py:281)
+        for i, (cn, sn) in enumerate(zip(cs, ss)):
+            if cn != f"ORBWAVEC{i}" or sn != f"ORBWAVES{i}":
+                raise ValueError(
+                    "ORBWAVE indices must run 0..k without gaps "
+                    f"(found {cn}/{sn} at position {i})")
+        if cs and self.params["ORBWAVE_OM"].value is None:
+            raise ValueError("ORBWAVEs require ORBWAVE_OM")
+        if cs and self.params["ORBWAVE_EPOCH"].value is None:
+            raise ValueError("ORBWAVEs require ORBWAVE_EPOCH")
+
+    def _apply_orbwaves(self, p, batch, delay_sec, orbits, forb):
+        cs, ss = self.orbwave_names()
+        if not cs:
+            return orbits, forb
+        dphi, dfreq = orbwave_delta(p, batch, delay_sec, cs, ss)
+        return orbits + dphi, forb + dfreq

@@ -1,0 +1,287 @@
+"""BinaryDDK: Kopeikin annual-orbital-parallax + proper-motion terms
+(reference `binary_ddk.py` + `stand_alone_psr_binaries/DDK_model.py`;
+Kopeikin 1995 eqs. 15-19, 1996 eqs. 8-10).
+
+Oracle strategy: the corrections are re-derived independently in numpy
+here from the published equations, applied as per-TOA perturbations of a
+plain BinaryDD model (A1/OM/SINI overridden one TOA at a time), and the
+resulting delays must match BinaryDDK's to float64 accuracy."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import DownhillWLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.models.astrometry import KPC_LS, MAS_TO_RAD
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+SECS_PER_YEAR = 365.25 * 86400.0
+
+PAR_DDK = """
+PSR FAKEDDK
+RAJ 10:22:58.0
+DECJ +10:01:52.8
+PMRA -15.0
+PMDEC 8.0
+PX 1.5
+F0 60.7794479 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 10.25
+BINARY DDK
+PB 7.75 1
+A1 9.23 1
+T0 55000.2 1
+ECC 0.05 1
+OM 75.0 1
+M2 0.3
+KIN 70.0
+KOM 40.0
+K96 1
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def _model(par=PAR_DDK):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(par.strip().splitlines())
+
+
+def _dd_par_from_ddk(sini):
+    out = []
+    for line in PAR_DDK.strip().splitlines():
+        key = line.split()[0] if line.split() else ""
+        if key in ("KIN", "KOM", "K96"):
+            continue
+        if key == "BINARY":
+            out.append("BINARY DD")
+        else:
+            out.append(line)
+    out.append(f"SINI {sini:.15f}")
+    return out
+
+
+@pytest.fixture(scope="module")
+def ddk_setup():
+    m = _model()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        toas = make_fake_toas_uniform(54800, 55200, 24, m, obs="gbt",
+                                      error_us=1.0)
+    r = Residuals(toas, m)
+    return m, toas, r
+
+
+class TestAgainstIndependentFormulas:
+    def test_delay_matches_perturbed_dd(self, ddk_setup):
+        m, toas, r = ddk_setup
+        p = r.pdict
+        batch = r.batch
+        comp = m.components["BinaryDDK"]
+        delay_other = m.delay_upto(p, batch, "BinaryDDK") \
+            if hasattr(m, "delay_upto") else None
+        # independent numpy Kopeikin corrections -----------------------
+        ra = float(m.RAJ.value)
+        dec = float(m.DECJ.value)
+        sl, cl = np.sin(ra), np.cos(ra)
+        sb, cb = np.sin(dec), np.cos(dec)
+        mu_lon = float(m.PMRA.value) * MAS_TO_RAD
+        mu_lat = float(m.PMDEC.value) * MAS_TO_RAD
+        kom = np.deg2rad(float(m.KOM.value))
+        kin0 = np.deg2rad(float(m.KIN.value))
+        obs = np.asarray(batch.ssb_obs_pos_ls)
+        # dt from T0 in seconds (f64 adequacy for these small terms)
+        t0 = float(m.T0.value.mjd_float)
+        dt = (np.asarray(batch.tdbld) - t0) * 86400.0
+        tt0_yr = dt / SECS_PER_YEAR
+        d_kin = (-mu_lon * np.sin(kom) + mu_lat * np.cos(kom)) * tt0_yr
+        kin = kin0 + d_kin
+        a1_0 = float(m.A1.value)
+        d_a1_pm = a1_0 * d_kin / np.tan(kin)
+        d_om_pm = (mu_lon * np.cos(kom) + mu_lat * np.sin(kom)) \
+            * tt0_yr / np.sin(kin)
+        dI0 = -obs[:, 0] * sl + obs[:, 1] * cl
+        dJ0 = -obs[:, 0] * sb * cl - obs[:, 1] * sb * sl + obs[:, 2] * cb
+        inv_d = float(m.PX.value) / KPC_LS
+        d_a1_px = a1_0 / np.tan(kin) * (dI0 * np.sin(kom)
+                                        - dJ0 * np.cos(kom)) * inv_d
+        d_om_px = -(dI0 * np.cos(kom) + dJ0 * np.sin(kom)) \
+            * inv_d / np.sin(kin)
+        d_a1 = d_a1_pm + d_a1_px
+        d_om = d_om_pm + d_om_px
+        # component's own corrections must match the independent ones
+        ka1, kom_c, kkin = comp._kopeikin(p, batch, jnp.asarray(dt))
+        np.testing.assert_allclose(np.asarray(ka1), d_a1, rtol=1e-9,
+                                   atol=1e-15)
+        np.testing.assert_allclose(np.asarray(kom_c), d_om, rtol=1e-9,
+                                   atol=1e-18)
+        np.testing.assert_allclose(np.asarray(kkin), kin, rtol=1e-12)
+        # and the full delay must equal a plain DD with the perturbed
+        # A1/OM/SINI, TOA by TOA
+        ddk_delay = np.asarray(comp.delay(p, batch, jnp.zeros(batch.ntoas)))
+        for i in range(0, batch.ntoas, 5):
+            dd = _model(PAR_DDK)  # template; replaced next line
+            par_lines = _dd_par_from_ddk(np.sin(kin[i]))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                dd = get_model(par_lines)
+                dd.A1.value = a1_0 + d_a1[i]
+                dd.OM.value = float(m.OM.value) + np.rad2deg(d_om[i])
+                toas_i = toas
+                r_i = Residuals(toas_i, dd)
+            dd_delay = np.asarray(dd.components["BinaryDD"].delay(
+                r_i.pdict, r_i.batch, jnp.zeros(r_i.batch.ntoas)))
+            assert abs(dd_delay[i] - ddk_delay[i]) < 2e-10, i
+
+    def test_reduces_to_dd_without_px_pm(self):
+        par = PAR_DDK.replace("PMRA -15.0", "PMRA 0.0") \
+                     .replace("PMDEC 8.0", "PMDEC 0.0") \
+                     .replace("PX 1.5", "PX 0.0")
+        m = _model(par)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(54800, 55200, 30, m, obs="gbt",
+                                          error_us=1.0)
+        r = Residuals(toas, m)
+        ddk_delay = np.asarray(m.components["BinaryDDK"].delay(
+            r.pdict, r.batch, jnp.zeros(r.batch.ntoas)))
+        dd_lines = [ln for ln in par.strip().splitlines()
+                    if ln.split()[0] not in ("KIN", "KOM", "K96")]
+        dd_lines = ["BINARY DD" if ln.startswith("BINARY") else ln
+                    for ln in dd_lines]
+        dd_lines.append(f"SINI {np.sin(np.deg2rad(70.0)):.15f}")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            dd = get_model(dd_lines)
+            rd = Residuals(toas, dd)
+        dd_delay = np.asarray(dd.components["BinaryDD"].delay(
+            rd.pdict, rd.batch, jnp.zeros(rd.batch.ntoas)))
+        np.testing.assert_allclose(ddk_delay, dd_delay, atol=1e-12)
+
+
+class TestFitRecovery:
+    def test_recover_kin_kom(self):
+        """Simulate with strong PM/PX and recover KIN/KOM by fitting
+        (the reference's test_ddk strategy)."""
+        truth = _model()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            toas = make_fake_toas_uniform(53000, 57000, 500, truth,
+                                          obs="gbt", error_us=0.5,
+                                          add_noise=True, seed=11)
+        m = _model()
+        for n in ("KIN", "KOM"):
+            m[n].frozen = False
+            m[n].value = m[n].value + (3.0 if n == "KIN" else -5.0)
+        for n in ("F0", "PB", "A1", "T0", "ECC", "OM"):
+            m[n].frozen = False
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f = DownhillWLSFitter(toas, m)
+            f.fit_toas(maxiter=30)
+        for n, true_val in (("KIN", 70.0), ("KOM", 40.0)):
+            pull = (m[n].value - true_val) / m[n].uncertainty
+            assert abs(pull) < 5, (n, m[n].value, m[n].uncertainty)
+
+
+class TestConvert:
+    def test_ddk_dd_roundtrip(self):
+        import math
+
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = _model()
+        dd = convert_binary(m, "DD")
+        assert dd.BINARY.value == "DD"
+        assert dd.SINI.value == pytest.approx(math.sin(math.radians(70.0)),
+                                              abs=1e-12)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            back = convert_binary(dd, "DDK", KOM=40.0)
+        assert back.KIN.value == pytest.approx(70.0, abs=1e-9)
+        assert back.KOM.value == pytest.approx(40.0)
+
+    def test_ddk_to_ell1(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        e = convert_binary(_model(), "ELL1")
+        assert e.BINARY.value == "ELL1"
+        assert e.EPS1.value == pytest.approx(
+            0.05 * np.sin(np.deg2rad(75.0)), rel=1e-9)
+
+
+class TestRealJ1713:
+    """The flagship real-world DDK dataset: NANOGrav 11yr J1713+0747
+    (the reference's own DDK test target)."""
+
+    def test_load_and_residuals(self):
+        import os
+
+        from pint_tpu.toa import get_TOAs
+
+        DATA = "/root/reference/tests/datafile"
+        par = os.path.join(DATA, "J1713+0747_NANOGrav_11yv0_short.gls.par")
+        tim = os.path.join(DATA, "J1713+0747_NANOGrav_11yv0_short.tim")
+        if not (os.path.isfile(par) and os.path.isfile(tim)):
+            pytest.skip("reference datafiles not present")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(par)
+            toas = get_TOAs(tim, model=m)
+        assert "BinaryDDK" in m.components
+        assert m.K96.value is True
+        assert m.KOM.value == pytest.approx(83.1, abs=3)
+        r = Residuals(toas, m)
+        assert np.all(np.isfinite(r.time_resids))
+        # ephemeris-limited but structurally sound
+        assert r.rms_weighted() * 1e6 < 2000.0
+
+
+class TestValidation:
+    def test_k96_boolean_spellings(self):
+        for spelling in ("Y", "1", "N"):
+            par = PAR_DDK.replace("K96 1", f"K96 {spelling}")
+            m = _model(par)
+            assert m.K96.value is (spelling != "N")
+
+    def test_orbwave_gap_rejected(self):
+        par = PAR_DDK + ("ORBWAVE_OM 3.5e-8\nORBWAVE_EPOCH 55000\n"
+                         "ORBWAVEC0 0.01\nORBWAVES0 0.01\n"
+                         "ORBWAVEC2 0.01\nORBWAVES2 0.01\n")
+        with pytest.raises(ValueError, match="without gaps"):
+            _model(par)
+
+    def test_btpiecewise_overlap_rejected(self):
+        par = """
+PSR FAKE
+RAJ 10:22:58.0
+DECJ +10:01:52.8
+F0 60.0
+PEPOCH 55000
+BINARY BT_piecewise
+PB 7.75
+A1 9.23
+T0 55000.2
+ECC 0.05
+OM 75.0
+XR1_0001 54990
+XR2_0001 55050
+T0X_0001 55000.2003
+XR1_0002 55040
+XR2_0002 55100
+T0X_0002 55000.2001
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+"""
+        with pytest.raises(ValueError, match="overlap"):
+            _model(par)
