@@ -49,7 +49,7 @@ def _machine_eps() -> float:
 __all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
            "DownhillGLSFitter", "PowellFitter", "LMFitter",
            "WidebandTOAFitter", "WidebandDownhillFitter", "fit_wls_svd",
-           "build_wls_step", "build_gls_step"]
+           "build_wls_step", "build_gls_step", "build_gls_fullcov_step"]
 
 
 def fit_wls_svd(M, r_sec, sigma_sec, threshold: Optional[float] = None):
@@ -318,6 +318,84 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
                 "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
                 "noise_ampls": sol[ntm:], "resid_sec": r,
                 "n_bad": jnp.sum(bad)}
+
+    def step(x, p):
+        r, M, sigma, offc = assemble(x, p)
+        return solve(r, M, sigma, offc, p)
+
+    return step
+
+
+def build_gls_fullcov_step(model: TimingModel, batch: TOABatch,
+                           fit_params: Sequence[str], track_mode: str,
+                           threshold: Optional[float] = None,
+                           include_offset: bool = True, assemble=None):
+    """The dense-covariance GLS step (reference `GLSFitter.fit_toas`
+    ``full_cov=True`` path + `get_gls_mtcm_mtcy_fullcov`,
+    `/root/reference/src/pint/fitter.py:2601`): C = N + U Phi U^T is
+    formed explicitly and Cholesky-factored, the normal equations are
+    M^T C^-1 M dx = M^T C^-1 r.  O(N^2)-memory — the in-suite
+    cross-check of the Woodbury basis path, exactly how the reference
+    validates itself (its `tests/test_gls_fitter.py` runs both).
+    """
+    names = list(fit_params)
+    npar = len(names)
+    if assemble is None:
+        assemble = build_whitened_assembly(model, batch, names, track_mode,
+                                           include_offset)
+
+    @jax.jit
+    def solve(r, M, sigma, offc, p):
+        from jax.scipy.linalg import solve_triangular
+
+        U = model.noise_basis(p)
+        phi = model.noise_weights(p)
+        C = jnp.diag(sigma**2)
+        if phi is not None:
+            phi = jnp.where(phi > 0.0, phi, 0.0)
+            if U.shape[0] != r.shape[0]:  # wideband zero-padding
+                U2 = jnp.concatenate(
+                    [U, jnp.zeros((r.shape[0] - U.shape[0], U.shape[1]))],
+                    axis=0)
+            else:
+                U2 = U
+            C = C + (U2 * phi) @ U2.T
+        L = jnp.linalg.cholesky(C)
+
+        def csolve(b):
+            y = solve_triangular(L, b, lower=True)
+            return solve_triangular(L.T, y, lower=False)
+
+        # two-stage range-safe column normalization (see fit_wls_svd)
+        Mw = M / sigma[:, None]
+        cmax = jnp.max(jnp.abs(Mw), axis=0)
+        cmax = jnp.where(cmax == 0.0, 1.0, cmax)
+        _, nc = normalize_designmatrix(Mw / cmax)
+        norms = cmax * nc
+        Mn = M / norms
+        CiM = csolve(Mn)
+        A = Mn.T @ CiM
+        y = CiM.T @ r
+        e, V = jnp.linalg.eigh(A)
+        thr = _machine_eps() * A.shape[0] if threshold is None \
+            else threshold
+        # ABSOLUTE cutoff in the normalized coordinates, matching
+        # build_gls_step exactly so a user-supplied threshold means the
+        # same thing on both paths (the cross-check must not diverge
+        # because of threshold semantics)
+        bad = e <= thr
+        einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
+        sol = (V @ (einv * (V.T @ y))) / norms
+        Sigma_n = (V * einv) @ V.T
+        off = jnp.float64(0.0)
+        if offc is not None:
+            Cio = csolve(offc)
+            off = (Cio @ r) / (Cio @ offc)
+        r_off = r - off * offc if offc is not None else r
+        chi2 = r_off @ csolve(r_off)
+        return {"dx": sol[:npar], "offset": off, "chi2": chi2,
+                "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
+                "resid_sec": r, "n_bad": jnp.sum(bad)}
 
     def step(x, p):
         r, M, sigma, offc = assemble(x, p)
@@ -662,12 +740,28 @@ class GLSFitter(WLSFitter):
     design matrix (reference `GLSFitter`,
     `/root/reference/src/pint/fitter.py:1821`); chi2 is the Woodbury
     r^T C^-1 r.  Also valid (and equal to WLS) with no correlated
-    components."""
+    components.
+
+    ``fit_toas(full_cov=True)`` switches to the dense-covariance solve
+    (C = N + U Phi U^T formed and Cholesky-factored, reference
+    ``full_cov=True`` path) — the O(N^2) cross-check of the basis path.
+    """
+
+    #: selected by fit_toas(full_cov=...); part of the step-cache key
+    full_cov = False
+
+    def fit_toas(self, maxiter: int = 2, full_cov: bool = False,
+                 **kw) -> float:
+        if full_cov != self.full_cov:
+            self.full_cov = full_cov
+            self._step_cache_key = None  # invalidate the cached step
+        return super().fit_toas(maxiter=maxiter, **kw)
 
     def _make_step(self, names, threshold, include_offset):
-        return build_gls_step(self.model, self.resids.batch, names,
-                              self.track_mode, threshold=threshold,
-                              include_offset=include_offset)
+        build = build_gls_fullcov_step if self.full_cov else build_gls_step
+        return build(self.model, self.resids.batch, names,
+                     self.track_mode, threshold=threshold,
+                     include_offset=include_offset)
 
 
 class DownhillWLSFitter(Fitter):
@@ -989,10 +1083,11 @@ class WidebandTOAFitter(GLSFitter):
         assemble = build_wideband_assembly(
             self.model, wb.batch, wb.dm_index, wb.dm_data, wb.dm_error,
             names, self.track_mode, include_offset)
-        return build_gls_step(self.model, wb.batch, names,
-                              self.track_mode, threshold=threshold,
-                              include_offset=include_offset,
-                              assemble=assemble)
+        build = build_gls_fullcov_step if self.full_cov else build_gls_step
+        return build(self.model, wb.batch, names,
+                     self.track_mode, threshold=threshold,
+                     include_offset=include_offset,
+                     assemble=assemble)
 
     def get_designmatrix(self):
         """(M, names): the *combined* TOA+DM design matrix — TOA rows in

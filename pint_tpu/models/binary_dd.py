@@ -349,7 +349,7 @@ class BinaryDDK(BinaryDD):
         self.require("KIN", "KOM")
         if self._parent is not None:
             if "PX" not in self._parent or \
-                    self._parent.PX.value is None:
+                    not self._parent.PX.value:
                 import warnings as _w
 
                 _w.warn("DDK's annual-orbital-parallax terms need PX; "

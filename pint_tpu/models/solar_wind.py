@@ -8,9 +8,11 @@ the spherically-symmetric 1/r^2 model of Edwards et al. 2006 (eqs. 29-30):
 
 with rho = pi - (Sun-pulsar elongation seen from the observatory) and r
 the observatory-Sun distance.  NE_SW may carry Taylor derivatives
-(NE_SW1, ... about SWEPOCH), as in the reference.  The SWM=1/SWP general
-power-law model (Hazboun et al. 2022) needs hypergeometric functions and
-is not supported — matching the reference's own SWM=0 default.
+(NE_SW1, ... about SWEPOCH), as in the reference.  SWM=1 implements the
+general power-law model (You et al. 2012; Hazboun et al. 2022) with a
+differentiable quadrature + gamma-function formulation
+(:func:`solar_wind_geometry_p_pc`), so the index SWP is fittable by
+autodiff.
 
 The geometry is a pure function of the TOA batch (obs-Sun vector) and the
 astrometry component's pulsar direction, so the whole term is jit-pure and
@@ -35,25 +37,91 @@ AU_LS = AU / C                      # 1 au in light-seconds
 PC_LS = 3.0856775814913673e16 / C   # 1 pc in light-seconds
 
 
-def solar_wind_geometry_pc(obs_sun_pos_ls: jnp.ndarray,
-                           psr_dir: jnp.ndarray) -> jnp.ndarray:
+def _geometry_pc_impl(xp, obs_sun_pos_ls, psr_dir):
     """AU^2 * rho / (r sin rho) in parsecs (Edwards et al. 2006 eq. 30;
-    reference `solar_wind_geometry`, `solar_wind_dispersion.py:370-398`)."""
-    r = jnp.linalg.norm(obs_sun_pos_ls, axis=1)
-    safe_r = jnp.where(r > 0.0, r, 1.0)
+    reference `solar_wind_geometry`, `solar_wind_dispersion.py:370-398`).
+    Generic over the array namespace so the device path (jnp) and
+    host-side consumers (numpy, e.g. the PLSWNoise basis scaling) share
+    one formula."""
+    r = xp.linalg.norm(obs_sun_pos_ls, axis=1)
+    safe_r = xp.where(r > 0.0, r, 1.0)
     # elongation: angle at the observatory between Sun and pulsar
-    cos_elong = jnp.sum(obs_sun_pos_ls * psr_dir, axis=1) / safe_r
-    cos_elong = jnp.clip(cos_elong, -1.0, 1.0)
-    rho = jnp.pi - jnp.arccos(cos_elong)
-    sin_rho = jnp.sin(rho)
-    safe_sin = jnp.where(sin_rho > 1e-12, sin_rho, 1.0)
+    cos_elong = xp.sum(obs_sun_pos_ls * psr_dir, axis=1) / safe_r
+    cos_elong = xp.clip(cos_elong, -1.0, 1.0)
+    rho = xp.pi - xp.arccos(cos_elong)
+    sin_rho = xp.sin(rho)
+    safe_sin = xp.where(sin_rho > 1e-12, sin_rho, 1.0)
     geom = AU_LS**2 * rho / (safe_r * safe_sin) / PC_LS
     # barycentric rows (r == 0) carry no solar-wind delay
-    return jnp.where((r > 0.0) & (sin_rho > 1e-12), geom, 0.0)
+    return xp.where((r > 0.0) & (sin_rho > 1e-12), geom, 0.0)
+
+
+def solar_wind_geometry_pc(obs_sun_pos_ls: jnp.ndarray,
+                           psr_dir: jnp.ndarray) -> jnp.ndarray:
+    return _geometry_pc_impl(jnp, obs_sun_pos_ls, psr_dir)
+
+
+def solar_wind_geometry_pc_np(obs_sun_pos_ls: np.ndarray,
+                              psr_dir: np.ndarray) -> np.ndarray:
+    """Pure-numpy twin (host precompute must stay numpy on TPU: its
+    emulated f64 is not correctly rounded)."""
+    return _geometry_pc_impl(np, obs_sun_pos_ls, psr_dir)
+
+
+#: Gauss-Legendre nodes/weights for the finite leg of the power-law
+#: path integral (computed once, host-side)
+_GL_X, _GL_W = np.polynomial.legendre.leggauss(64)
+_GL_X = jnp.asarray(_GL_X)
+_GL_W = jnp.asarray(_GL_W)
+
+
+def solar_wind_geometry_p_pc(obs_sun_pos_ls: jnp.ndarray,
+                             psr_dir: jnp.ndarray, p) -> jnp.ndarray:
+    """General power-law solar-wind geometry [pc] for n_e ~ (r/1AU)^-p
+    (SWM=1; reference `_solar_wind_geometry`,
+    `/root/reference/src/pint/models/solar_wind_dispersion.py:171`, after
+    You et al. 2012 / Hazboun et al. 2022 eq. 12).
+
+    The path integral int (b^2+z^2)^{-p/2} dz from the observatory to
+    infinity becomes, with z = b tan(phi),
+
+        b^{1-p} [ int_0^{pi/2} cos^{p-2} - int_0^{phi0} cos^{p-2} ],
+        phi0 = arctan(-z_sun / b),
+
+    where the half-range integral has the closed form
+    sqrt(pi)/2 * Gamma((p-1)/2)/Gamma(p/2) (differentiable via gammaln)
+    and the finite leg — whose integrand is smooth, the endpoint
+    singularity sits at pi/2 only — is fixed-order Gauss-Legendre.  The
+    whole expression is differentiable in p, so SWP fits by autodiff
+    where the reference hand-codes a Pade-approximated derivative
+    (`_d_hypergeom_function_dp`).  Requires p > 1 (as the reference).
+    """
+    from jax.scipy.special import gammaln
+
+    r = jnp.linalg.norm(obs_sun_pos_ls, axis=1)
+    safe_r = jnp.where(r > 0.0, r, 1.0)
+    cos_t = jnp.clip(jnp.sum(obs_sun_pos_ls * psr_dir, axis=1) / safe_r,
+                     -1.0, 1.0)
+    theta = jnp.arccos(cos_t)          # solar elongation
+    b = safe_r * jnp.sin(theta)        # impact parameter [ls]
+    b = jnp.maximum(b, 1e-6)           # conjunction guard
+    z_sun = safe_r * cos_t             # obs -> impact-point distance [ls]
+    phi0 = jnp.arctan2(-z_sun, b)
+    half = 0.5 * jnp.sqrt(jnp.pi) * jnp.exp(
+        gammaln((p - 1.0) / 2.0) - gammaln(p / 2.0))
+    # Gauss-Legendre on [0, phi0] (phi0 may be negative: signed leg)
+    mid = 0.5 * phi0
+    nodes = mid[:, None] * (1.0 + _GL_X[None, :])
+    leg = mid * jnp.sum(_GL_W[None, :]
+                        * jnp.cos(nodes) ** (p - 2.0), axis=1)
+    geom = b ** (1.0 - p) * AU_LS**p * (half - leg) / PC_LS
+    return jnp.where(r > 0.0, geom, 0.0)
 
 
 class SolarWindDispersion(DelayComponent):
-    """NE_SW solar-wind dispersion (SWM=0)."""
+    """NE_SW solar-wind dispersion: SWM=0 (1/r^2, Edwards et al. 2006) or
+    SWM=1 (arbitrary power-law index SWP, You et al. 2012 / Hazboun et
+    al. 2022) — SWP is fittable by autodiff."""
 
     register = True
     category = "solar_wind"
@@ -65,7 +133,10 @@ class SolarWindDispersion(DelayComponent):
             description="Solar wind electron density at 1 AU"))
         self.add_param(FloatParam(
             "SWM", value=0.0, units="",
-            description="Solar wind model (0 is the only supported mode)"))
+            description="Solar wind model (0: 1/r^2; 1: power-law SWP)"))
+        self.add_param(FloatParam(
+            "SWP", value=2.0, units="",
+            description="Solar wind power-law index (SWM=1)"))
         self.add_param(MJDParam("SWEPOCH",
                                 description="NE_SW reference epoch"))
 
@@ -90,9 +161,13 @@ class SolarWindDispersion(DelayComponent):
         return None
 
     def validate(self):
-        if self.SWM.value not in (None, 0.0):
+        if self.SWM.value not in (None, 0.0, 1.0):
             raise ValueError(
-                f"SWM={self.SWM.value} is not supported (only SWM=0)")
+                f"SWM={self.SWM.value} is not supported (only 0 or 1)")
+        if self.SWM.value == 1.0 and self.SWP.value is not None \
+                and self.SWP.value <= 1.0:
+            raise ValueError("SWM=1 requires SWP > 1 (the path integral "
+                             "diverges otherwise; reference raises too)")
         if len(self.ne_sw_names()) > 1 and self.SWEPOCH.value is None:
             if self._parent is None or self._parent.PEPOCH.value is None:
                 raise ValueError("SWEPOCH required for NE_SW derivatives")
@@ -116,7 +191,11 @@ class SolarWindDispersion(DelayComponent):
 
     def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
         psr_dir = self._astrometry().psr_dir(p, batch)
-        geom = solar_wind_geometry_pc(batch.obs_sun_pos_ls, psr_dir)
+        if self.SWM.value == 1.0:
+            geom = solar_wind_geometry_p_pc(batch.obs_sun_pos_ls, psr_dir,
+                                            pv(p, "SWP"))
+        else:
+            geom = solar_wind_geometry_pc(batch.obs_sun_pos_ls, psr_dir)
         return self.ne_sw_value(p, batch) * geom
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:

@@ -146,9 +146,12 @@ class TestSolarWind:
         # difference above the ~1e-11-cycle QS phase quantization
         deriv_check(model, toas, "NE_SW", rel=0.05)
 
-    def test_swm_nonzero_rejected(self):
+    def test_swm_invalid_rejected(self):
+        # SWM=1 is now supported; only other modes are rejected
         with pytest.raises(ValueError, match="SWM"):
-            build("NE_SW 8.0\nSWM 1\n")
+            build("NE_SW 8.0\nSWM 3\n")
+        with pytest.raises(ValueError, match="SWP"):
+            build("NE_SW 8.0\nSWM 1\nSWP 0.8\n")
 
     def test_ne_sw_derivatives_parse_and_apply(self):
         # regression: interior-underscore prefixes (NE_SW1) must resolve

@@ -190,3 +190,27 @@ class TestGLSFitter:
         assert f.fitresult.converged
         assert abs((m.F0.value - truth) / m.F0.uncertainty) < 5
         assert "EcorrNoise" in f.noise_resids
+
+
+class TestFullCovariancePath:
+    """Dense C = N + U Phi U^T cross-check of the Woodbury basis path —
+    the reference validates its GLS the same way
+    (`tests/test_gls_fitter.py` runs full_cov True and False)."""
+
+    def test_fullcov_matches_basis(self):
+        m1 = _model("ECORR tel gbt 0.4\nTNREDAMP -13.2\n"
+                    "TNREDGAM 3.0\nTNREDC 10\n")
+        m2 = _model("ECORR tel gbt 0.4\nTNREDAMP -13.2\n"
+                    "TNREDGAM 3.0\nTNREDC 10\n")
+        toas = _toas(m1, n=60, span=700.0, clustered=True, seed=4)
+        f1 = GLSFitter(toas, m1)
+        chi2_basis = f1.fit_toas(maxiter=3)
+        f2 = GLSFitter(toas, m2)
+        chi2_full = f2.fit_toas(maxiter=3, full_cov=True)
+        assert chi2_full == pytest.approx(chi2_basis, rel=1e-6)
+        for n in f1.fit_params:
+            u1, u2 = m1[n].uncertainty, m2[n].uncertainty
+            v1, v2 = m1[n].value, m2[n].value
+            assert float(v2) - float(v1) == pytest.approx(
+                0.0, abs=1e-4 * u1), n
+            assert u2 == pytest.approx(u1, rel=2e-3), n

@@ -1,0 +1,206 @@
+"""SWM=1 general power-law solar wind + PLSWNoise (reference
+`solar_wind_dispersion.py:272` SWM=1 branch, `noise_model.py:659`)."""
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.special import hyp2f1
+
+from pint_tpu.models import get_model
+from pint_tpu.models.solar_wind import (AU_LS, PC_LS,
+                                        solar_wind_geometry_p_pc,
+                                        solar_wind_geometry_pc)
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+DATA = "/root/reference/tests/datafile"
+
+
+class TestGeometryP:
+    def _geoms(self, n=40, seed=0):
+        rng = np.random.default_rng(seed)
+        r = AU_LS * (1 + 0.02 * rng.standard_normal(n))
+        theta = rng.uniform(0.05, np.pi - 0.05, n)
+        obs_sun = np.zeros((n, 3))
+        obs_sun[:, 0] = r
+        psr = np.stack([np.cos(theta), np.sin(theta), np.zeros(n)], axis=1)
+        return r, theta, jnp.asarray(obs_sun), jnp.asarray(psr)
+
+    @pytest.mark.parametrize("p", [1.5, 2.0, 2.5, 3.7])
+    def test_against_hypergeometric_oracle(self, p):
+        """The quadrature+gamma formulation must match the reference's
+        hyp2f1 expression (Hazboun et al. 2022 eq. 12)."""
+        r, theta, obs_sun, psr = self._geoms()
+        b = r * np.sin(theta)
+        z_sun = r * np.cos(theta)
+
+        def dmint(z):
+            return (z / b) * hyp2f1(0.5, p / 2, 1.5, -((z / b) ** 2))
+
+        oracle = (AU_LS / b) ** p * b * (dmint(1e30) - dmint(-z_sun)) / PC_LS
+        ours = np.asarray(solar_wind_geometry_p_pc(obs_sun, psr, p))
+        np.testing.assert_allclose(ours, oracle, rtol=5e-5)
+
+    def test_p2_reduces_to_swm0(self):
+        _, _, obs_sun, psr = self._geoms()
+        g_p = np.asarray(solar_wind_geometry_p_pc(obs_sun, psr, 2.0))
+        g_0 = np.asarray(solar_wind_geometry_pc(obs_sun, psr))
+        np.testing.assert_allclose(g_p, g_0, rtol=1e-5)
+
+    def test_differentiable_in_p(self):
+        _, _, obs_sun, psr = self._geoms(n=10)
+        g = jax.grad(lambda p: jnp.sum(
+            solar_wind_geometry_p_pc(obs_sun, psr, p)))(2.3)
+        assert np.isfinite(float(g)) and float(g) != 0.0
+
+
+@pytest.mark.skipif(not os.path.isfile(os.path.join(DATA, "2145_swfit.par")),
+                    reason="reference datafiles not present")
+class TestRealSwfit:
+    """The reference's own SWM=1 test dataset (its `test_solar_wind.py`
+    fits NE_SW and SWP on these files)."""
+
+    def test_load_and_fit_ne_sw_swp(self):
+        from pint_tpu.fitter import DownhillWLSFitter
+        from pint_tpu.toa import get_TOAs
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(os.path.join(DATA, "2145_swfit.par"))
+            toas = get_TOAs(os.path.join(DATA, "2145_swfit.tim"), model=m)
+        assert m.SWM.value == 1.0
+        assert m.SWP.value == 1.5
+        r = Residuals(toas, m)
+        assert np.all(np.isfinite(r.time_resids))
+        # the SWM=1 DM differs measurably from what SWM=0 would give
+        comp = m.components["SolarWindDispersion"]
+        dm1 = np.asarray(comp.dm_value(r.pdict, r.batch))
+        m.SWM.value = 0.0
+        r0 = Residuals(toas, m)
+        dm0 = np.asarray(comp.dm_value(r0.pdict, r0.batch))
+        assert np.max(np.abs(dm1 - dm0)) > 1e-6
+        m.SWM.value = 1.0
+
+    def test_recover_swp(self):
+        """Simulate with a known SWP and recover it by autodiff fitting
+        (the reference needs a hand-coded Pade derivative for this)."""
+        from pint_tpu.fitter import DownhillWLSFitter
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            truth = get_model(os.path.join(DATA, "2145_swfit.par"))
+            truth.NE_SW.value = 8.0
+            truth.SWP.value = 2.2
+            toas = make_fake_toas_uniform(54000, 54730, 300, truth,
+                                          obs="gbt", error_us=0.3,
+                                          freq_mhz=np.tile([700.0, 1400.0],
+                                                           150),
+                                          add_noise=True, seed=8)
+            m = get_model(os.path.join(DATA, "2145_swfit.par"))
+            m.NE_SW.value = 8.0
+            m.SWP.value = 2.0
+            m.NE_SW.frozen = False
+            m.SWP.frozen = False
+            f = DownhillWLSFitter(toas, m)
+            f.fit_toas(maxiter=20)
+        pull_p = (m.SWP.value - 2.2) / m.SWP.uncertainty
+        pull_n = (m.NE_SW.value - 8.0) / m.NE_SW.uncertainty
+        assert abs(pull_p) < 5, (m.SWP.value, m.SWP.uncertainty)
+        assert abs(pull_n) < 5, (m.NE_SW.value, m.NE_SW.uncertainty)
+
+
+class TestPLSWNoise:
+    PAR = """
+PSR FAKE
+RAJ 10:22:58.0
+DECJ +10:01:52.8
+F0 61.485476554 1
+PEPOCH 55000
+DM 12.4 1
+NE_SW 6.0
+TNSWAMP -3.0
+TNSWGAM 2.0
+TNSWC 12
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+    def test_basis_scaling(self):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(self.PAR.strip().splitlines())
+            toas = make_fake_toas_uniform(
+                54800, 55200, 50, m, obs="gbt", error_us=1.0,
+                freq_mhz=np.tile([700.0, 1400.0], 25))
+        assert "PLSWNoise" in m.components
+        comp = m.components["PLSWNoise"]
+        r = Residuals(toas, m)
+        U = np.asarray(r.pdict["const"][comp.basis_pytree_name])
+        assert U.shape == (50, 24)
+        # column scaling ~ geometry/f^2: low-frequency rows carry larger
+        # entries by (1400/700)^2 = 4 at equal geometry
+        scale = comp.chromatic_scale(toas)
+        assert np.all(scale > 0)
+        # matches geometry * DMconst / f^2 computed independently on the
+        # device path
+        from pint_tpu import DMconst, c as C
+        from pint_tpu.models.solar_wind import solar_wind_geometry_pc
+
+        astro = m.components["AstrometryEquatorial"]
+        psr = np.asarray(astro.psr_dir(r.pdict, r.batch))
+        geom = np.asarray(solar_wind_geometry_pc(
+            r.batch.obs_sun_pos_ls, jnp.asarray(psr)))
+        expected = geom * float(DMconst) / np.asarray(toas.freq_mhz) ** 2
+        np.testing.assert_allclose(scale, expected, rtol=1e-6)
+        # GLS machinery accepts the component
+        assert np.isfinite(r.lnlikelihood())
+
+    def test_requires_solar_wind(self):
+        bad = self.PAR.replace("NE_SW 6.0\n", "")
+        with pytest.raises(ValueError, match="SolarWindDispersion"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                get_model(bad.strip().splitlines())
+
+
+def test_plchromnoise_alpha_uses_tnchromidx():
+    """Regression: PLChromNoise's basis scaling must follow TNCHROMIDX
+    (a class-body editing accident once silently reverted it to the DM
+    default of 2)."""
+    from pint_tpu.models.noise_model import PLChromNoise, PLSWNoise
+
+    assert "chromatic_alpha" in PLChromNoise.__dict__
+    assert "chromatic_alpha" not in PLSWNoise.__dict__
+    PAR = """
+PSR FAKE
+RAJ 10:22:58.0
+DECJ +10:01:52.8
+F0 61.485476554
+PEPOCH 55000
+DM 12.4
+CM 0.1
+TNCHROMIDX 4.0
+TNCHROMAMP -13.0
+TNCHROMGAM 2.0
+TNCHROMC 8
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(PAR.strip().splitlines())
+        toas = make_fake_toas_uniform(
+            54900, 55100, 20, m, obs="gbt", error_us=1.0,
+            freq_mhz=np.tile([700.0, 1400.0], 10))
+    comp = m.components["PLChromNoise"]
+    assert comp.chromatic_alpha() == 4.0
+    scale = comp.chromatic_scale(toas)
+    ratio = scale[::2] / scale[1::2]     # same-epoch-ish 700 vs 1400
+    assert np.allclose(ratio, 2.0**4, rtol=1e-9)
