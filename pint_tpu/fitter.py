@@ -672,6 +672,11 @@ class Fitter:
         """Recover per-component noise realizations from the basis
         amplitudes (reference `fitter.py:1952-1968`)."""
         if "noise_ampls" not in out:
+            # e.g. the full-covariance path: drop any stale realizations
+            # from a previous basis-path fit rather than present them as
+            # current
+            self.noise_ampls = {}
+            self.noise_resids = {}
             return
         ampls = np.asarray(out["noise_ampls"])
         self.noise_ampls = {}
@@ -750,7 +755,7 @@ class GLSFitter(WLSFitter):
     #: selected by fit_toas(full_cov=...); part of the step-cache key
     full_cov = False
 
-    def fit_toas(self, maxiter: int = 2, full_cov: bool = False,
+    def fit_toas(self, maxiter: int = 2, *, full_cov: bool = False,
                  **kw) -> float:
         if full_cov != self.full_cov:
             self.full_cov = full_cov

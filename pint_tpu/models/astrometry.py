@@ -405,17 +405,12 @@ def convert_astrometry(model, target: str, ecl: str = "IERS2010"):
 def host_psr_dir(model) -> np.ndarray:
     """ICRS unit vector to the pulsar from the model's host parameter
     values (no proper-motion propagation) — for host-side consumers like
-    noise-basis scalings that must stay numpy."""
+    noise-basis scalings that must stay numpy.  Reuses the module's
+    spherical/rotation helpers so the convention cannot drift from the
+    device path."""
     astro = next(c for c in model.components.values()
                  if isinstance(c, Astrometry))
     if isinstance(astro, AstrometryEcliptic):
-        lon, lat = float(model.ELONG.value), float(model.ELAT.value)
-        n_ecl = np.array([math.cos(lat) * math.cos(lon),
-                          math.cos(lat) * math.sin(lon), math.sin(lat)])
-        eps = astro.obliquity()
-        ce, se = math.cos(eps), math.sin(eps)
-        return np.array([n_ecl[0], ce * n_ecl[1] - se * n_ecl[2],
-                         se * n_ecl[1] + ce * n_ecl[2]])
-    ra, dec = float(model.RAJ.value), float(model.DECJ.value)
-    return np.array([math.cos(dec) * math.cos(ra),
-                     math.cos(dec) * math.sin(ra), math.sin(dec)])
+        n_ecl = _sph_dir(float(model.ELONG.value), float(model.ELAT.value))
+        return _rot_eq_to_ecl(astro.obliquity()).T @ n_ecl
+    return _sph_dir(float(model.RAJ.value), float(model.DECJ.value))

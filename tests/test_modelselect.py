@@ -1,0 +1,171 @@
+"""F-test / AIC / BIC / dmx_ranges / Wave<->WaveX / WaveX->PLRedNoise
+(reference `utils.py:782,1810,2143,2935,3241` and `Fitter.ftest`)."""
+
+import warnings
+
+import numpy as np
+import pytest
+from scipy.stats import f as fdist
+
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.modelselect import (FTest, akaike_information_criterion,
+                                  bayesian_information_criterion,
+                                  dmx_ranges, ftest,
+                                  plrednoise_from_wavex,
+                                  translate_wave_to_wavex,
+                                  translate_wavex_to_wave)
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR FAKE
+RAJ 07:40:45.79 1
+DECJ 66:20:33.5 1
+F0 346.53199992 1
+F1 -1.46e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96 1
+FD1 2e-5 1
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def _sim(extra="", n=120, add_noise=True, seed=3):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model((PAR + extra).strip().splitlines())
+        toas = make_fake_toas_uniform(
+            54500, 55500, n, m, obs="gbt", error_us=1.0,
+            freq_mhz=np.tile([1400.0, 800.0, 400.0],
+                             (n + 2) // 3)[:n],
+            add_noise=add_noise, seed=seed)
+    return m, toas
+
+
+class TestFTest:
+    def test_matches_scipy_f_distribution(self):
+        chi2_1, dof_1, chi2_2, dof_2 = 120.0, 100, 100.0, 98
+        F = ((chi2_1 - chi2_2) / (dof_1 - dof_2)) / (chi2_2 / dof_2)
+        expect = fdist.sf(F, dof_1 - dof_2, dof_2)
+        assert FTest(chi2_1, dof_1, chi2_2, dof_2) == \
+            pytest.approx(expect, rel=1e-12)
+
+    def test_degenerate_cases(self):
+        assert np.isnan(FTest(100.0, 50, 90.0, 50))
+        assert FTest(90.0, 50, 100.0, 48) == 1.0
+
+    def test_fitter_ftest_workflow(self):
+        """Adding an unwarranted FD3 must give a large probability;
+        restoring a real FD1 that was removed must give a tiny one."""
+        m, toas = _sim()
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=3)
+        out_add = ftest(f, add_lines="FD2 0 1")
+        assert out_add["dof_new"] == out_add["dof_base"] - 1
+        assert out_add["ft"] > 1e-3   # not significant
+        # remove the genuinely-present FD1: the simpler model is bad
+        out_rm = ftest(f, remove=["FD1"])
+        assert out_rm["ft"] < 1e-6
+
+
+class TestICs:
+    def test_aic_bic_prefer_true_model(self):
+        m, toas = _sim()
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=3)
+        aic_true = akaike_information_criterion(m, toas)
+        bic_true = bayesian_information_criterion(m, toas)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m_bad = get_model([ln for ln in m.as_parfile().splitlines()
+                               if not ln.startswith("FD1")])
+            f2 = WLSFitter(toas, m_bad)
+            f2.fit_toas(maxiter=3)
+        assert akaike_information_criterion(m_bad, toas) > aic_true
+        assert bayesian_information_criterion(m_bad, toas) > bic_true
+        # BIC penalizes parameters harder
+        k = len(m.free_params)
+        assert bic_true - aic_true == pytest.approx(
+            k * (np.log(toas.ntoas) - 2.0), rel=1e-9)
+
+
+class TestDmxRanges:
+    def test_bins_require_both_bands(self):
+        m, toas = _sim(n=100)
+        mask, comp = dmx_ranges(toas, divide_freq_mhz=1000.0,
+                                binwidth_days=30.0)
+        names = comp.dmx_names()
+        assert len(names) >= 10
+        assert mask.sum() > 80
+        # every bin covers TOAs in both bands
+        mjds = np.asarray(toas.utc.mjd_float)
+        freqs = np.asarray(toas.freq_mhz)
+        for n_ in names:
+            i = n_.split("_")[1]
+            r1 = comp.params[f"DMXR1_{i}"].mjd_float
+            r2 = comp.params[f"DMXR2_{i}"].mjd_float
+            sel = (mjds >= r1) & (mjds <= r2)
+            assert np.any(freqs[sel] < 1000.0)
+            assert np.any(freqs[sel] >= 1000.0)
+
+
+class TestWaveTranslation:
+    WAVES = "WAVE_OM 0.02\nWAVEEPOCH 55000\nWAVE1 1e-5 -2e-5\nWAVE2 3e-6 4e-6\n"
+
+    def test_roundtrip_and_equivalence(self):
+        m, toas = _sim(self.WAVES, add_noise=False)
+        r0 = Residuals(toas, m)
+        m2 = translate_wave_to_wavex(m)
+        assert "WaveX" in m2.components
+        r2 = Residuals(toas, m2)
+        # identical physical signal through either parameterization
+        np.testing.assert_allclose(np.asarray(r2.time_resids),
+                                   np.asarray(r0.time_resids), atol=2e-9)
+        m3 = translate_wavex_to_wave(m2)
+        assert "Wave" in m3.components
+        r3 = Residuals(toas, m3)
+        np.testing.assert_allclose(np.asarray(r3.time_resids),
+                                   np.asarray(r0.time_resids), atol=2e-9)
+
+
+class TestPLRedNoiseFromWaveX:
+    def test_recovers_injected_spectrum(self):
+        """Simulate red noise from a known power law, fit WaveX
+        amplitudes, convert back to PLRedNoise, recover (gamma, A)
+        (reference tests the same round trip)."""
+        from pint_tpu.models.wave import wavex_setup
+
+        amp_true, gam_true = -11.4, 3.5
+        m, toas = _sim(f"TNREDAMP {amp_true}\nTNREDGAM {gam_true}\n"
+                       "TNREDC 12\n", n=150, add_noise=True, seed=12)
+        # draw a realization from the prior and inject
+        r0 = Residuals(toas, m)
+        comp = m.components["PLRedNoise"]
+        U = np.asarray(r0.pdict["const"][comp.basis_pytree_name])
+        phi = np.asarray(comp.noise_weights(r0.pdict))
+        rng = np.random.default_rng(5)
+        from pint_tpu import mjd as mjdmod
+        toas.utc = mjdmod.add_sec(
+            toas.utc, U @ (rng.standard_normal(U.shape[1]) * np.sqrt(phi)))
+        toas.compute_TDBs(ephem="DE421")
+        toas.compute_posvels(ephem="DE421", planets=False)
+        # model with free WaveX instead of the PLRedNoise
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mw = get_model([ln for ln in m.as_parfile().splitlines()
+                            if not ln.startswith("TNRED")])
+            span = float(np.ptp(np.asarray(toas.utc.mjd_float)))
+            wavex_setup(mw, span, n_freqs=12)
+            fw = WLSFitter(toas, mw)
+            fw.fit_toas(maxiter=3)
+        m_pl = plrednoise_from_wavex(mw)
+        assert "PLRedNoise" in m_pl.components
+        da = m_pl.TNREDAMP.uncertainty
+        dg = m_pl.TNREDGAM.uncertainty
+        assert abs(m_pl.TNREDAMP.value - amp_true) < 5 * da + 0.5
+        assert abs(m_pl.TNREDGAM.value - gam_true) < 5 * dg + 1.0
