@@ -584,7 +584,7 @@ class Fitter:
         names = self.fit_params
         rf = build_resid_sec_fn(self.model, self.resids.batch, names,
                                 self.track_mode)
-        p = self.resids.pdict
+        p = self._device_pdict()
         x = self.model.x0(p, names)
         M = -np.asarray(jax.jit(jax.jacfwd(rf))(x, p))
         return M, names
@@ -657,6 +657,13 @@ class Fitter:
                               self.track_mode, threshold=threshold,
                               include_offset=include_offset)
 
+    def _device_pdict(self):
+        """The current params pytree, transferred to device ONCE per fit:
+        it holds host numpy arrays (the noise basis alone is ~16 MB on
+        real data) and would otherwise re-upload on every jitted step
+        call — ruinous over a networked TPU tunnel."""
+        return jax.device_put(self.resids.pdict)
+
     def _cached_step(self, names, threshold, include_offset):
         """Reuse one jitted step across repeated timing fits (the
         noise-alternating loop calls _fit_timing several times; a fresh
@@ -713,7 +720,7 @@ class WLSFitter(Fitter):
                  tol_chi2: float = 1e-8) -> float:
         m = self.model
         names = self.fit_params
-        p = self.resids.pdict
+        p = self._device_pdict()
         include_offset = "PhaseOffset" not in m.components
         step = self._cached_step(names, threshold, include_offset)
         x = np.zeros(len(names))
@@ -815,7 +822,7 @@ class DownhillWLSFitter(Fitter):
         from scipy.optimize import minimize
 
         self.resids.update()
-        p = self.resids.pdict
+        p = self._device_pdict()
         m = self.model
         # cache the jitted likelihood/gradient pair across the alternating
         # iterations (same reason as _cached_step: a fresh closure would
@@ -889,7 +896,7 @@ class DownhillWLSFitter(Fitter):
                     max_chi2_increase: float = 1e-2) -> float:
         m = self.model
         names = self.fit_params
-        p = self.resids.pdict
+        p = self._device_pdict()
         include_offset = "PhaseOffset" not in m.components
         step = self._cached_step(names, threshold, include_offset)
         x = np.zeros(len(names))
@@ -948,7 +955,7 @@ class PowellFitter(Fitter):
 
         m = self.model
         names = self.fit_params
-        p = self.resids.pdict
+        p = self._device_pdict()
         include_offset = "PhaseOffset" not in m.components
         step = self._make_step(names, None, include_offset)
         # optimize in units of the parameter UNCERTAINTIES so Powell's
@@ -990,7 +997,7 @@ class LMFitter(Fitter):
                  tol_chi2: float = 1e-8, threshold=None) -> float:
         m = self.model
         names = self.fit_params
-        p = self.resids.pdict
+        p = self._device_pdict()
         include_offset = "PhaseOffset" not in m.components
         assemble = build_whitened_assembly(m, self.resids.batch, names,
                                           self.track_mode, include_offset)

@@ -490,12 +490,24 @@ class TimingModel:
         if self.tzr_batch is not None and "AbsPhase" in self.components:
             # host-side (eager, exact) evaluation of the TZR reference
             # phase at the pytree's reference parameter values; see
-            # PhaseCalc.phase for why this stays out of the jitted graph
+            # PhaseCalc.phase for why this stays out of the jitted graph.
+            # Pinned to the CPU backend: the ~1000 eager ops of the
+            # quad-single chain each cost a device round trip on an
+            # accelerator (~13 s over a networked TPU vs 0.2 s on host).
+            import contextlib
+
+            import jax as _jax
+
+            try:
+                ctx = _jax.default_device(_jax.devices("cpu")[0])
+            except RuntimeError:  # JAX_PLATFORMS excludes cpu
+                ctx = contextlib.nullcontext()
             p_tzr = {"const": const, "delta": delta, "mask": tzr_mask}
-            ph = self.calc.phase(p_tzr, self.tzr_batch, subtract_tzr=False,
-                                 is_tzr=True)
-            const["__tzrphase__"] = np.stack(
-                [np.asarray(w, np.float32)[0] for w in ph.words])
+            with ctx:
+                ph = self.calc.phase(p_tzr, self.tzr_batch,
+                                     subtract_tzr=False, is_tzr=True)
+                const["__tzrphase__"] = np.stack(
+                    [np.asarray(w, np.float32)[0] for w in ph.words])
         return p
 
     def apply_deltas(self, p: dict):

@@ -65,6 +65,27 @@ def bayesian_information_criterion(model, toas) -> float:
         2.0 * Residuals(toas, model).lnlikelihood()
 
 
+def _model_without(model, key_pred, add_lines=()):
+    """New model from `model`'s par file with every line whose leading
+    key satisfies `key_pred` removed and `add_lines` appended, in ONE
+    parse (shared by ftest and the Wave/WaveX translators so the
+    filtering variants cannot drift; the single parse keeps remove+add
+    component swaps valid — an intermediate removal-only par may not
+    stand alone)."""
+    from pint_tpu.models import get_model
+
+    lines = []
+    for line in model.as_parfile().splitlines():
+        key = line.split()[0] if line.split() else ""
+        if key and key_pred(key):
+            continue
+        lines.append(line)
+    lines += list(add_lines)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(lines)
+
+
 def ftest(fitter, add_lines: Union[str, Sequence[str]] = (),
           unfreeze: Sequence[str] = (), remove: Sequence[str] = (),
           maxiter: int = 10) -> Dict[str, float]:
@@ -87,19 +108,10 @@ def ftest(fitter, add_lines: Union[str, Sequence[str]] = (),
     remove = set(remove)
     base_chi2 = fitter.resids.calc_chi2()
     base_dof = fitter.resids.dof
-    par = fitter.model.as_parfile().splitlines()
-    if remove:
-        keep = []
-        for line in par:
-            key = line.split()[0] if line.split() else ""
-            if key in remove:
-                continue
-            keep.append(line)
-        par = keep
-    par += list(add_lines)
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        m2 = get_model(par)
+        m2 = _model_without(fitter.model, lambda k: k in remove,
+                            add_lines=add_lines)
         for n in unfreeze:
             m2[n].frozen = False
         f2 = type(fitter)(fitter.toas, m2)
@@ -160,15 +172,7 @@ def translate_wave_to_wavex(model):
         if model.WAVEEPOCH.value is not None \
         else model.PEPOCH.value.mjd_float
     pairs = [tuple(model[n].value) for n in wave.wave_names()]
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        lines = []
-        for line in model.as_parfile().splitlines():
-            key = line.split()[0] if line.split() else ""
-            if key.startswith("WAVE"):
-                continue
-            lines.append(line)
-        m2 = get_model(lines)
+    m2 = _model_without(model, lambda k: k.startswith("WAVE"))
     wx = WaveX()
     m2.add_component(wx)
     m2.WXEPOCH.set_value(epoch)
@@ -200,11 +204,7 @@ def translate_wavex_to_wave(model):
         else model.PEPOCH.value.mjd_float
     pairs = [(-float(model[f"WXSIN_{i:04d}"].value),
               -float(model[f"WXCOS_{i:04d}"].value)) for i in idx]
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        lines = [ln for ln in model.as_parfile().splitlines()
-                 if not (ln.split() and ln.split()[0].startswith("WX"))]
-        m2 = get_model(lines)
+    m2 = _model_without(model, lambda k: k.startswith("WX"))
     wv = Wave()
     m2.add_component(wv)
     m2.WAVE_OM.value = 2.0 * math.pi * base
@@ -288,13 +288,9 @@ def _plnoise_from_wavex(model, component_name: str, noise_cls_name: str,
             H[i, j] = (mlnlike(xpp) - mlnlike(xpm) - mlnlike(xmp)
                        + mlnlike(xmm)) / (4 * h[i] * h[j])
     errs = np.sqrt(np.maximum(np.diag(np.linalg.pinv(H)), 0.0))
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        stem = {"WaveX": "WX", "DMWaveX": "DMWX", "CMWaveX": "CMWX"}[
-            component_name]
-        lines = [ln for ln in model.as_parfile().splitlines()
-                 if not (ln.split() and ln.split()[0].startswith(stem))]
-        m2 = get_model(lines)
+    stem = {"WaveX": "WX", "DMWaveX": "DMWX", "CMWaveX": "CMWX"}[
+        component_name]
+    m2 = _model_without(model, lambda k: k.startswith(stem))
     noise = getattr(nm, noise_cls_name)()
     m2.add_component(noise)
     m2[amp_name].value = float(log10_A)

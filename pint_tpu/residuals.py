@@ -140,6 +140,7 @@ class Residuals:
         self.pdict = self.model.build_pdict(
             self.toas, tzr_toas=self.model.make_tzr_toas_or_none())
         self._phase_resids = None
+        self._chi2_cache = None
 
     def rms_weighted(self) -> float:
         w = 1.0 / (self.get_data_error() * 1e-6) ** 2
@@ -170,9 +171,14 @@ class Residuals:
                 2.0 * np.sum(np.log(sigma_s)))
 
     def calc_chi2(self) -> float:
-        """Weighted chi2 (Woodbury form when correlated noise present)."""
-        dot, _ = self._gaussian_quadratic(self.time_resids)
-        return float(dot)
+        """Weighted chi2 (Woodbury form when correlated noise present).
+        Cached until the next update(): the Woodbury quadratic on real
+        correlated-noise data costs seconds of host linear algebra and
+        post-fit bookkeeping asks for it repeatedly."""
+        if getattr(self, "_chi2_cache", None) is None:
+            dot, _ = self._gaussian_quadratic(self.time_resids)
+            self._chi2_cache = float(dot)
+        return self._chi2_cache
 
     def get_data_error(self) -> np.ndarray:
         """Scaled uncertainties [us] (EFAC/EQUAD once noise models exist)."""

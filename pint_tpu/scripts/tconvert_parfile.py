@@ -1,0 +1,63 @@
+"""Par-file conversions: binary model, format, output location.
+
+Reference: `convert_parfile`
+(`/root/reference/src/pint/scripts/convert_parfile.py`).
+"""
+
+import argparse
+import os
+import sys
+import warnings
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    from pint_tpu.binaryconvert import _SUPPORTED
+
+    parser = argparse.ArgumentParser(
+        description="pint_tpu par-file conversions (cf. convert_parfile)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("input", help="input par file")
+    parser.add_argument("-b", "--binary", default=None,
+                        choices=sorted(_SUPPORTED),
+                        help="convert the binary model")
+    parser.add_argument("-o", "--out", default=None,
+                        help="output file (default: stdout)")
+    parser.add_argument("--kom", type=float, default=0.0,
+                        help="KOM [deg] when converting to DDK")
+    parser.add_argument("--allow_tcb", action="store_true",
+                        help="convert TCB par files to TDB automatically")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    if not os.path.exists(args.input):
+        print(f"cannot open {args.input!r}", file=sys.stderr)
+        return 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.binaryconvert import convert_binary
+        from pint_tpu.models import get_model
+
+        model = get_model(args.input, allow_tcb=args.allow_tcb)
+        if args.binary is not None:
+            if "BINARY" not in model or not model.BINARY.value:
+                print(f"{args.input!r} has no binary model; cannot "
+                      f"convert to {args.binary}", file=sys.stderr)
+                return 1
+            kw = {"KOM": args.kom} if args.binary.upper() == "DDK" else {}
+            model = convert_binary(model, args.binary, **kw)
+    out = model.as_parfile()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+        print(f"Wrote {args.out}")
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

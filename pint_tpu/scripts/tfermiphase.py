@@ -1,0 +1,89 @@
+"""Compute pulse phases for Fermi-LAT photons.
+
+Reference: `fermiphase` (`/root/reference/src/pint/scripts/fermiphase.py`):
+load a Fermi FT1 event file + par file, compute each photon's phase,
+report the (weighted) H-test, optionally write the phases out.  Writing
+a PULSE_PHASE column back into the FITS file is not supported (no FITS
+writer in this zero-dependency stack); phases go to a text file instead.
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu Fermi photon phases (cf. fermiphase)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("eventfile", help="Fermi FT1 event FITS file "
+                                          "(barycentered or geocentric)")
+    parser.add_argument("parfile", help="par file to construct the model")
+    parser.add_argument("weightcol", nargs="?", default=None,
+                        help="photon-weight column name (e.g. from "
+                             "gtsrcprob); the reference's CALC mode is "
+                             "not supported")
+    parser.add_argument("--ephem", default="DE421")
+    parser.add_argument("--planets", action="store_true")
+    parser.add_argument("--minMJD", type=float, default=None)
+    parser.add_argument("--maxMJD", type=float, default=None)
+    parser.add_argument("--outfile", default=None,
+                        help="write 'MJD phase [weight]' rows here")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+    if args.quiet:
+        warnings.filterwarnings("ignore")
+
+    import numpy as np
+
+    from pint_tpu import qs
+    from pint_tpu.event_toas import load_event_TOAs
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.templates import hm, sf_hm
+
+    model = get_model(args.parfile)
+    kw = {"mission": "fermi"}
+    if args.weightcol:
+        if args.weightcol.upper() == "CALC":
+            print("CALC weights are not supported (the reference computes "
+                  "them from a spectral model); give a weight column",
+                  file=sys.stderr)
+            return 1
+        kw["weightcolumn"] = args.weightcol
+    if args.minMJD is not None:
+        kw["minmjd"] = args.minMJD
+    if args.maxMJD is not None:
+        kw["maxmjd"] = args.maxMJD
+    toas = load_event_TOAs(args.eventfile, **kw)
+    toas.apply_clock_corrections()
+    toas.compute_TDBs(ephem=args.ephem)
+    toas.compute_posvels(ephem=args.ephem, planets=args.planets)
+    print(f"Read {toas.ntoas} Fermi photons from {args.eventfile}")
+    r = Residuals(toas, model, subtract_mean=False)
+    ph = model.calc.phase(r.pdict, r.batch)
+    _, frac = qs.round_nearest(ph)
+    phases = np.asarray(qs.to_f64(frac)) % 1.0
+    weights = getattr(toas, "weights", None)
+    h = hm(phases, weights=weights)
+    wtag = "weighted " if weights is not None else ""
+    print(f"{wtag}Htest: {h:.2f} (sig ~ {sf_hm(h):.3g})")
+    if args.outfile:
+        mjds = np.asarray(toas.utc.mjd_float)
+        with open(args.outfile, "w") as f:
+            if weights is None:
+                f.write("# MJD phase\n")
+                for m, p in zip(mjds, phases):
+                    f.write(f"{m:.12f} {p:.9f}\n")
+            else:
+                f.write("# MJD phase weight\n")
+                for m, p, w in zip(mjds, phases, weights):
+                    f.write(f"{m:.12f} {p:.9f} {w:.6f}\n")
+        print(f"Wrote phases to {args.outfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

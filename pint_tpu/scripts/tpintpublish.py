@@ -1,0 +1,117 @@
+"""Publication-quality timing-solution table (LaTeX).
+
+Reference: `pintpublish` (`/root/reference/src/pint/scripts/pintpublish.py`
++ `output/publish.py:31`): generate a LaTeX table of the fitted model —
+measured parameters with uncertainties, set parameters, and fit summary
+statistics when a tim file is given.
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main", "publish_table"]
+
+
+def _fmt_unc(value, unc):
+    """value(err) notation with the uncertainty on the last two digits."""
+    import math
+
+    if unc is None or not (unc > 0):
+        return f"{value:.12g}", ""
+    digits = max(0, -int(math.floor(math.log10(unc))) + 1)
+    scaled = round(unc * 10**digits)
+    return f"{value:.{digits}f}", f"({scaled})"
+
+
+def publish_table(model, toas=None, include_dmx: bool = False) -> str:
+    rows_fit = []
+    rows_set = []
+    for name in model.params:
+        par = model[name]
+        if par.value is None or name in ("PSR", "EPHEM", "CLK", "UNITS"):
+            continue
+        if not include_dmx and name.startswith(("DMX", "SWX")):
+            continue
+        kind = getattr(par, "kind", "float")
+        if kind in ("str", "bool", "pair"):
+            continue
+        try:
+            v = float(par.value) if kind != "mjd" \
+                else float(par.value.mjd_float)
+        except (TypeError, ValueError):
+            continue
+        if not par.frozen:
+            val, err = _fmt_unc(v, par.uncertainty)
+            rows_fit.append((name, par.units or "", f"{val}{err}"))
+        else:
+            rows_set.append((name, par.units or "", f"{v:.12g}"))
+    lines = [
+        r"\begin{table}",
+        rf"\caption{{Timing solution for {model.PSR.value}}}",
+        r"\begin{tabular}{lll}",
+        r"\hline",
+        r"Parameter & Units & Value \\",
+        r"\hline",
+        r"\multicolumn{3}{c}{Measured parameters} \\",
+        r"\hline",
+    ]
+    esc = lambda s: s.replace("_", r"\_").replace("^", r"\^{}")
+    for n, u, v in rows_fit:
+        lines.append(rf"{esc(n)} & {esc(u)} & {v} \\")
+    lines += [r"\hline", r"\multicolumn{3}{c}{Set parameters} \\",
+              r"\hline"]
+    for n, u, v in rows_set:
+        lines.append(rf"{esc(n)} & {esc(u)} & {v} \\")
+    if toas is not None:
+        from pint_tpu.residuals import Residuals
+
+        r = Residuals(toas, model)
+        lines += [
+            r"\hline",
+            r"\multicolumn{3}{c}{Fit summary} \\",
+            r"\hline",
+            rf"Number of TOAs & & {toas.ntoas} \\",
+            rf"$\chi^2$ & & {r.calc_chi2():.2f} \\",
+            rf"Reduced $\chi^2$ & & {r.reduced_chi2:.3f} \\",
+            rf"Weighted RMS & $\mu$s & {r.rms_weighted() * 1e6:.3f} \\",
+        ]
+    lines += [r"\hline", r"\end{tabular}", r"\end{table}"]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu LaTeX timing-solution table (cf. "
+                    "pintpublish)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("parfile")
+    parser.add_argument("timfile", nargs="?", default=None,
+                        help="optional tim file for fit statistics")
+    parser.add_argument("-o", "--out", default=None)
+    parser.add_argument("--include-dmx", action="store_true",
+                        help="include the DMX/SWX forest in the table")
+    args = parser.parse_args(argv)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.models import get_model
+
+        model = get_model(args.parfile)
+        toas = None
+        if args.timfile:
+            from pint_tpu.toa import get_TOAs
+
+            toas = get_TOAs(args.timfile, model=model)
+        table = publish_table(model, toas, include_dmx=args.include_dmx)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(table + "\n")
+        print(f"Wrote {args.out}")
+    else:
+        print(table)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

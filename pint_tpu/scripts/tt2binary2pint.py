@@ -1,0 +1,75 @@
+"""Convert a tempo2 'T2' binary par file to a model this framework (and
+the reference) implements.
+
+Reference: `t2binary2pint`
+(`/root/reference/src/pint/scripts/t2binary2pint.py`): the tempo2 T2
+model is a universal superset; the concrete model is guessed from which
+parameters are present (KOM/KIN -> DDK, EPS1/EPS2 or TASC -> ELL1,
+otherwise DD/BT).
+"""
+
+import argparse
+import sys
+import warnings
+
+__all__ = ["main", "guess_binary_model"]
+
+
+def guess_binary_model(params) -> str:
+    """Map a T2 parameter set to a concrete binary model (reference
+    `pint.models.binary_dd` guessing in `t2binary2pint`/model_builder)."""
+    has = lambda *names: any(n in params for n in names)
+    if has("KOM", "KIN"):
+        return "DDK"
+    if has("EPS1", "EPS2", "TASC"):
+        return "ELL1H" if has("H3") else "ELL1"
+    if has("H3", "STIGMA"):
+        return "DDH"
+    if has("SHAPMAX"):
+        return "DDS"
+    if has("M2", "SINI", "OMDOT", "GAMMA"):
+        return "DD"
+    return "BT"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="pint_tpu T2-binary par conversion (cf. "
+                    "t2binary2pint)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("input_par", help="input par file (BINARY T2)")
+    parser.add_argument("output_par", help="output par file")
+    parser.add_argument("--allow_tcb", action="store_true")
+    args = parser.parse_args(argv)
+
+    lines = open(args.input_par).read().splitlines()
+    params = {ln.split()[0].upper() for ln in lines if ln.split()}
+    out_lines = []
+    binary = None
+    for ln in lines:
+        fields = ln.split()
+        if fields and fields[0].upper() == "BINARY":
+            binary = fields[1].upper()
+            if binary == "T2":
+                binary = guess_binary_model(params)
+                print(f"BINARY T2 -> {binary}")
+            out_lines.append(f"BINARY {binary}")
+        else:
+            out_lines.append(ln)
+    if binary is None:
+        print("no BINARY line in input", file=sys.stderr)
+        return 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.models import get_model
+
+        model = get_model(out_lines, allow_tcb=args.allow_tcb)
+    model.write_parfile(args.output_par,
+                        comment=f"converted from T2 to {binary} by "
+                                "tt2binary2pint")
+    print(f"Wrote {args.output_par}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

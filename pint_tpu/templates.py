@@ -26,40 +26,103 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["LCGaussian", "LCLorentzian", "LCTemplate", "fit_template",
-           "hm", "z2m", "sf_hm"]
+__all__ = ["LCGaussian", "LCGaussian2", "LCSkewGaussian", "LCLorentzian",
+           "LCLorentzian2", "LCVonMises", "LCKing", "LCTopHat",
+           "LCEGaussian", "LCTemplate", "NormAngles", "fit_template",
+           "fit_template_binned", "hm", "z2m", "sf_hm"]
 
 TWOPI = 2.0 * math.pi
 _NWRAP = 3  # peaks wrapped over [-3, 3] cover sigma <~ 0.5 exactly in f64
 
 
 class _Primitive:
-    """A localized peak on the phase circle with (loc, width) params."""
+    """A localized peak on the phase circle: a location plus one or more
+    shape parameters.
 
-    def __init__(self, loc: float, width: float):
+    ``shape_names`` / ``log_shape`` declare the shape parameters and
+    whether each is packed in log (widths: yes; skews/slopes: no) for
+    unconstrained optimization (the reference keeps a separate bounds
+    machinery instead, `lcprimitives.py:208`)."""
+
+    shape_names = ("width",)
+    log_shape = (True,)
+
+    def __init__(self, loc: float, *shape, **kw):
         self.loc = float(loc)
-        self.width = float(width)
-
-    nparams = 2
-
-    @staticmethod
-    def density(dphi, width):
-        raise NotImplementedError
-
-    def __call__(self, phases):
-        return type(self).eval(jnp.asarray(phases), self.loc, self.width)
+        defaults = list(self.shape_defaults())
+        if len(shape) > len(self.shape_names):
+            raise TypeError(
+                f"{type(self).__name__} takes at most "
+                f"{len(self.shape_names)} shape parameters "
+                f"{self.shape_names}, got {len(shape)}")
+        shape = list(shape)
+        for i, nm in enumerate(self.shape_names):
+            if nm in kw:
+                val = kw.pop(nm)
+            elif i < len(shape):
+                val = shape[i]
+            else:
+                val = defaults[i]
+            defaults[i] = float(val)
+        if kw:
+            raise TypeError(f"unknown shape parameters {sorted(kw)}")
+        self.shape = defaults
 
     @classmethod
-    def eval(cls, phases, loc, width):
+    def shape_defaults(cls):
+        return [0.03] * len(cls.shape_names)
+
+    @classmethod
+    def shape_fit_bounds(cls):
+        """L-BFGS-B bounds per (packed) shape parameter: log-widths get
+        the standard range, others unbounded; primitives with hard
+        domain edges (King's gamma > 1) override."""
+        import math as _m
+
+        return [(_m.log(5e-4), _m.log(0.5)) if is_log else (None, None)
+                for is_log in cls.log_shape]
+
+    # back-compat convenience for single-width primitives
+    @property
+    def width(self):
+        return self.shape[0]
+
+    @width.setter
+    def width(self, v):
+        self.shape[0] = float(v)
+
+    @staticmethod
+    def density(dphi, *shape):
+        raise NotImplementedError
+
+    def __call__(self, phases, log10_ens=None):
+        f = type(self).eval_e if log10_ens is not None else None
+        if f is not None:
+            return f(jnp.asarray(phases), jnp.asarray(log10_ens),
+                     self.loc, *self.shape)
+        return type(self).eval(jnp.asarray(phases), self.loc, *self.shape)
+
+    #: wrap count; heavy-tailed primitives override (Cauchy-class tails
+    #: decay only as 1/x^2)
+    _nwrap = _NWRAP
+
+    @classmethod
+    def eval(cls, phases, loc, *shape):
         out = 0.0
-        for k in range(-_NWRAP, _NWRAP + 1):
-            out = out + cls.density(phases - loc + k, width)
+        for k in range(-cls._nwrap, cls._nwrap + 1):
+            out = out + cls.density(phases - loc + k, *shape)
         return out
+
+    @classmethod
+    def eval_e(cls, phases, log10_ens, loc, *shape):
+        """Energy-dependent evaluation; energy-independent primitives
+        ignore the energies."""
+        return cls.eval(phases, loc, *shape)
 
 
 class LCGaussian(_Primitive):
     """Wrapped Gaussian peak (reference `LCGaussian`,
-    `templates/lcprimitives.py:431`)."""
+    `templates/lcprimitives.py:724`)."""
 
     @staticmethod
     def density(dphi, width):
@@ -67,9 +130,43 @@ class LCGaussian(_Primitive):
             (width * jnp.sqrt(TWOPI))
 
 
+class LCGaussian2(_Primitive):
+    """Two-sided (asymmetric) wrapped Gaussian (reference `LCGaussian2`,
+    `lcprimitives.py:797`): width1 on the leading (dphi < 0) side, width2
+    trailing, continuous at the peak, exactly normalized."""
+
+    shape_names = ("width1", "width2")
+    log_shape = (True, True)
+
+    @staticmethod
+    def density(dphi, width1, width2):
+        w = jnp.where(dphi < 0.0, width1, width2)
+        return jnp.exp(-0.5 * (dphi / w) ** 2) * \
+            (2.0 / ((width1 + width2) * jnp.sqrt(TWOPI)))
+
+
+class LCSkewGaussian(_Primitive):
+    """Wrapped skew-normal peak (reference `LCSkewGaussian`,
+    `lcprimitives.py:861`): 2/w phi(z) Phi(skew z), z = dphi/w."""
+
+    shape_names = ("width", "skew")
+    log_shape = (True, False)
+
+    @classmethod
+    def shape_defaults(cls):
+        return [0.03, 0.0]
+
+    @staticmethod
+    def density(dphi, width, skew):
+        from jax.scipy.stats import norm
+
+        z = dphi / width
+        return 2.0 / width * norm.pdf(z) * norm.cdf(skew * z)
+
+
 class LCLorentzian(_Primitive):
     """Wrapped Lorentzian peak (reference `LCLorentzian`,
-    `templates/lcprimitives.py:540`): the wrapped-Cauchy closed form —
+    `templates/lcprimitives.py:1004`): the wrapped-Cauchy closed form —
     exactly normalized, no truncated 1/x^2 tails."""
 
     @classmethod
@@ -79,14 +176,110 @@ class LCLorentzian(_Primitive):
             (1.0 + rho**2 - 2.0 * rho * jnp.cos(TWOPI * (phases - loc)))
 
 
+class LCLorentzian2(_Primitive):
+    """Two-sided (asymmetric) wrapped Lorentzian (reference
+    `LCLorentzian2`, `lcprimitives.py:1089`)."""
+
+    shape_names = ("width1", "width2")
+    log_shape = (True, True)
+    _nwrap = 50  # 1/x^2 tails: 50 wraps leave ~3e-4 of the mass
+
+    @staticmethod
+    def density(dphi, width1, width2):
+        w = jnp.where(dphi < 0.0, width1, width2)
+        return (2.0 / (math.pi * (width1 + width2))) * \
+            w**2 / (dphi**2 + w**2)
+
+
+class LCVonMises(_Primitive):
+    """Von Mises peak (reference `LCVonMises`, `lcprimitives.py:1178`):
+    exp(kappa cos(2 pi dphi)) / I0(kappa), kappa = 1/(2 pi width)^2 —
+    periodic by construction, no wrapping needed."""
+
+    @classmethod
+    def eval(cls, phases, loc, width):
+        from jax.scipy.special import i0e
+
+        kappa = (TWOPI * width) ** -2
+        dphi = TWOPI * (phases - loc)
+        # i0e = exp(-|k|) I0(k): form the ratio without overflow
+        return jnp.exp(kappa * (jnp.cos(dphi) - 1.0)) / i0e(kappa)
+
+
+class LCKing(_Primitive):
+    """Wrapped King-profile peak (reference `LCKing`,
+    `lcprimitives.py:1253`): the radial King PSF treated as a 1D pulse
+    shape, density d/dz [1 - (1 + z^2/(2 sigma^2 gamma))^(1-gamma)]/2
+    matching the reference's closed-form integral."""
+
+    shape_names = ("sigma", "gamma")
+    log_shape = (True, False)
+    _nwrap = 50  # x^(1-2 gamma) tails: power-law, like Lorentzian2
+
+    @classmethod
+    def shape_defaults(cls):
+        return [0.03, 1.5]
+
+    @classmethod
+    def shape_fit_bounds(cls):
+        b = super().shape_fit_bounds()
+        b[1] = (1.05, 50.0)   # density is negative/singular at gamma <= 1
+        return b
+
+    @staticmethod
+    def density(dphi, sigma, gamma):
+        u = 0.5 * (dphi / sigma) ** 2
+        return 0.5 * (gamma - 1.0) / (gamma * sigma**2) * \
+            jnp.abs(dphi) * (1.0 + u / gamma) ** -gamma
+
+
+class LCTopHat(_Primitive):
+    """Top-hat (boxcar) peak (reference `LCTopHat`,
+    `lcprimitives.py:1311`); piecewise-constant, so fit it with the
+    derivative-free path only."""
+
+    @classmethod
+    def eval(cls, phases, loc, width):
+        dphi = (phases - loc + 0.5) % 1.0 - 0.5
+        return jnp.where(jnp.abs(dphi) <= width / 2.0, 1.0 / width, 0.0)
+
+
+class LCEGaussian(LCGaussian):
+    """Energy-dependent wrapped Gaussian (reference `LCEGaussian`,
+    `lceprimitives.py:180`): location and width vary linearly in
+    log10(E), referenced to 1 GeV (log10_ens = 3)."""
+
+    shape_names = ("width", "loc_slope", "width_slope")
+    log_shape = (True, False, False)
+
+    @classmethod
+    def shape_defaults(cls):
+        return [0.03, 0.0, 0.0]
+
+    @classmethod
+    def eval(cls, phases, loc, width, loc_slope=0.0, width_slope=0.0):
+        return LCGaussian.eval(phases, loc, width)
+
+    @classmethod
+    def eval_e(cls, phases, log10_ens, loc, width, loc_slope=0.0,
+               width_slope=0.0):
+        de = log10_ens - 3.0
+        loc_e = loc + loc_slope * de
+        width_e = jnp.maximum(width + width_slope * de, 1e-4)
+        out = 0.0
+        for k in range(-_NWRAP, _NWRAP + 1):
+            out = out + LCGaussian.density(phases - loc_e + k, width_e)
+        return out
+
+
 class LCTemplate:
-    """f(phi) = sum_k n_k P_k(phi; loc_k, w_k) + (1 - sum n_k).
+    """f(phi) = sum_k n_k P_k(phi; loc_k, shape_k) + (1 - sum n_k).
 
     Parameter vector layout (for the jit path): per peak
-    ``[norm_k, loc_k, log_width_k]`` — widths enter through log so
-    unconstrained optimization keeps them positive (reference keeps a
-    separate constraint machinery, `lcnorm.py`).
-    """
+    ``[norm_k, loc_k, shape_k...]`` with log-declared shape parameters
+    (widths) packed through log, so unconstrained optimization keeps
+    them positive (reference keeps a separate constraint machinery,
+    `lcnorm.py`; :class:`NormAngles` is provided for parity)."""
 
     def __init__(self, primitives: Sequence[_Primitive],
                  norms: Sequence[float]):
@@ -98,36 +291,79 @@ class LCTemplate:
         self.norms = [float(n) for n in norms]
 
     # -- parameter vector <-> structure ------------------------------------
+    def _offsets(self):
+        """Start index of each peak's [norm, loc, shapes...] block."""
+        out = [0]
+        for p in self.primitives:
+            out.append(out[-1] + 2 + len(p.shape_names))
+        return out
+
+    def norm_indices(self):
+        return [o for o in self._offsets()[:-1]]
+
     def get_parameters(self) -> np.ndarray:
         out = []
         for n, p in zip(self.norms, self.primitives):
-            out += [n, p.loc, math.log(p.width)]
+            out += [n, p.loc]
+            for v, is_log in zip(p.shape, type(p).log_shape):
+                out.append(math.log(v) if is_log else v)
         return np.array(out)
 
     def set_parameters(self, x):
         x = np.asarray(x, np.float64)
-        nsum = float(sum(x[3 * k] for k in range(len(self.primitives))))
+        offs = self._offsets()
+        nsum = float(sum(x[o] for o in offs[:-1]))
         scale = 1.0 / nsum if nsum > 1.0 else 1.0
         for k, p in enumerate(self.primitives):
-            self.norms[k] = float(x[3 * k]) * scale
-            p.loc = float(x[3 * k + 1]) % 1.0
-            p.width = math.exp(float(x[3 * k + 2]))
+            o = offs[k]
+            self.norms[k] = float(x[o]) * scale
+            p.loc = float(x[o + 1]) % 1.0
+            for i, is_log in enumerate(type(p).log_shape):
+                v = float(x[o + 2 + i])
+                p.shape[i] = math.exp(v) if is_log else v
 
-    def _eval_fn(self):
+    def _eval_fn(self, energy_dependent: bool = False):
         classes = [type(p) for p in self.primitives]
+        offs = self._offsets()
 
-        def f(phases, x):
-            total = jnp.zeros_like(phases)
-            nsum = 0.0
-            for k, cls in enumerate(classes):
-                n, loc, logw = x[3 * k], x[3 * k + 1], x[3 * k + 2]
-                total = total + n * cls.eval(phases, loc, jnp.exp(logw))
-                nsum = nsum + n
-            return total + (1.0 - nsum)
+        def shapes_from(x, k):
+            cls = classes[k]
+            o = offs[k]
+            vals = []
+            for i, is_log in enumerate(cls.log_shape):
+                v = x[o + 2 + i]
+                vals.append(jnp.exp(v) if is_log else v)
+            return vals
+
+        if energy_dependent:
+            def f(phases, log10_ens, x):
+                total = jnp.zeros_like(phases)
+                nsum = 0.0
+                for k, cls in enumerate(classes):
+                    o = offs[k]
+                    total = total + x[o] * cls.eval_e(
+                        phases, log10_ens, x[o + 1], *shapes_from(x, k))
+                    nsum = nsum + x[o]
+                return total + (1.0 - nsum)
+        else:
+            def f(phases, x):
+                total = jnp.zeros_like(phases)
+                nsum = 0.0
+                for k, cls in enumerate(classes):
+                    o = offs[k]
+                    total = total + x[o] * cls.eval(
+                        phases, x[o + 1], *shapes_from(x, k))
+                    nsum = nsum + x[o]
+                return total + (1.0 - nsum)
 
         return f
 
-    def __call__(self, phases) -> np.ndarray:
+    def __call__(self, phases, log10_ens=None) -> np.ndarray:
+        if log10_ens is not None:
+            f = self._eval_fn(energy_dependent=True)
+            return np.asarray(f(jnp.asarray(phases, jnp.float64),
+                                jnp.asarray(log10_ens, jnp.float64),
+                                jnp.asarray(self.get_parameters())))
         f = self._eval_fn()
         return np.asarray(f(jnp.asarray(phases, jnp.float64),
                             jnp.asarray(self.get_parameters())))
@@ -165,30 +401,103 @@ def fit_template(template: LCTemplate, phases, weights=None,
         jnp.asarray(np.asarray(weights, np.float64))
     lnlike = log_likelihood_fn(template)
 
-    nk = len(template.primitives)
+    norm_idx = template.norm_indices()
 
     @jax.jit
     def negll(x):
         # smooth barrier keeps sum(norms) <= 1 (the per-norm bounds alone
         # cannot: two peaks at 0.8 + 0.7 would drive the background
         # negative and the likelihood to NaN)
-        nsum = sum(x[3 * k] for k in range(nk))
+        nsum = sum(x[i] for i in norm_idx)
         barrier = 1e4 * jnp.maximum(nsum - 0.999, 0.0) ** 2
         return -lnlike(phases, weights, x) + barrier
 
     grad = jax.jit(jax.grad(negll))
     x0 = template.get_parameters()
-    # keep norms in (0,1) via bounds; loc free (wrapped); log-width free
-    bounds = []
-    for _ in range(nk):
-        bounds += [(1e-4, 1.0), (None, None), (math.log(5e-4),
-                                               math.log(0.5))]
     res = minimize(lambda x: float(negll(jnp.asarray(x))),
                    x0, jac=lambda x: np.asarray(grad(jnp.asarray(x))),
-                   method="L-BFGS-B", bounds=bounds,
+                   method="L-BFGS-B", bounds=_fit_bounds(template),
                    options={"maxiter": maxiter})
     template.set_parameters(res.x)
     return template, -float(res.fun)
+
+
+def _fit_bounds(template: LCTemplate):
+    """Per-parameter L-BFGS-B bounds: norms in (0,1), locations free
+    (wrapped), shape bounds from each primitive class."""
+    bounds = []
+    for p in template.primitives:
+        bounds += [(1e-4, 1.0), (None, None)]
+        bounds += type(p).shape_fit_bounds()
+    return bounds
+
+
+def fit_template_binned(template: LCTemplate, phases, weights=None,
+                        nbins: int = 64,
+                        maxiter: int = 200) -> Tuple[LCTemplate, float]:
+    """Binned Poisson maximum-likelihood template fit (reference
+    `lcfitters.py` binned path): histogram the (weighted) phases and
+    maximize sum_b [c_b ln mu_b - mu_b] with mu_b the template integral
+    per bin x total counts.  Much cheaper than the unbinned likelihood
+    for very large photon sets; agrees with it as nbins -> inf."""
+    from scipy.optimize import minimize
+
+    phases = np.asarray(phases, np.float64) % 1.0
+    w = np.ones_like(phases) if weights is None else         np.asarray(weights, np.float64)
+    counts, edges = np.histogram(phases, bins=nbins, range=(0.0, 1.0),
+                                 weights=w)
+    centers = jnp.asarray(0.5 * (edges[:-1] + edges[1:]))
+    counts_j = jnp.asarray(counts)
+    total = float(np.sum(w))
+    f = template._eval_fn()
+    norm_idx = template.norm_indices()
+
+    @jax.jit
+    def negll(x):
+        mu = jnp.maximum(f(centers, x) / nbins * total, 1e-300)
+        nsum = sum(x[i] for i in norm_idx)
+        barrier = 1e4 * jnp.maximum(nsum - 0.999, 0.0) ** 2
+        return -jnp.sum(counts_j * jnp.log(mu) - mu) + barrier
+
+    grad = jax.jit(jax.grad(negll))
+    res = minimize(lambda x: float(negll(jnp.asarray(x))),
+                   template.get_parameters(),
+                   jac=lambda x: np.asarray(grad(jnp.asarray(x))),
+                   method="L-BFGS-B", bounds=_fit_bounds(template),
+                   options={"maxiter": maxiter})
+    template.set_parameters(res.x)
+    return template, -float(res.fun)
+
+
+class NormAngles:
+    """Simplex parameterization of the peak norms (reference
+    `lcnorm.NormAngles`, `templates/lcnorm.py:20`): n norms with
+    sum <= 1 mapped to n unconstrained angles through nested
+    spherical sines, so constrained optimizers are unnecessary."""
+
+    def __init__(self, norms: Sequence[float]):
+        self.n = len(norms)
+        self.set_norms(norms)
+
+    def set_norms(self, norms):
+        norms = np.asarray(norms, np.float64)
+        if np.any(norms < 0) or norms.sum() > 1.0 + 1e-9:
+            raise ValueError("norms must be >= 0 with sum <= 1")
+        self.angles = np.zeros(self.n)
+        remainder = 1.0
+        for i, v in enumerate(norms):
+            frac = np.clip(v / remainder if remainder > 0 else 0.0,
+                           0.0, 1.0)
+            self.angles[i] = math.asin(math.sqrt(frac))
+            remainder -= v
+
+    def get_norms(self) -> np.ndarray:
+        out = np.zeros(self.n)
+        remainder = 1.0
+        for i, a in enumerate(self.angles):
+            out[i] = remainder * math.sin(a) ** 2
+            remainder -= out[i]
+        return out
 
 
 # -- periodicity statistics ------------------------------------------------

@@ -1,0 +1,130 @@
+"""Smoke tests for the four round-3 CLIs: tfermiphase, tconvert_parfile,
+tpintpublish, tt2binary2pint (reference `scripts/fermiphase.py`,
+`convert_parfile.py`, `pintpublish.py`, `t2binary2pint.py`)."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+DATA = "/root/reference/tests/datafile"
+
+PAR_DD = """
+PSR FAKET2
+RAJ 10:22:58.0
+DECJ +10:01:52.8
+F0 60.7794479 1
+F1 -1.6e-16 1
+PEPOCH 55000
+DM 10.25 1
+BINARY T2
+PB 7.75 1
+A1 9.23 1
+T0 55000.2 1
+ECC 0.35 1
+OM 75.0 1
+M2 0.3
+SINI 0.9
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+class TestConvertParfile:
+    def test_binary_conversion_roundtrip(self, tmp_path, capsys):
+        from pint_tpu.models import get_model
+        from pint_tpu.scripts import tconvert_parfile
+
+        src = tmp_path / "dd.par"
+        src.write_text(PAR_DD.replace("BINARY T2", "BINARY DD").strip())
+        out = tmp_path / "ell1.par"
+        rc = tconvert_parfile.main([str(src), "-b", "ELL1",
+                                    "-o", str(out), "--quiet"])
+        assert rc == 0 and out.exists()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(str(out))
+        assert m.BINARY.value == "ELL1"
+        assert m.EPS1.value == pytest.approx(
+            0.35 * np.sin(np.deg2rad(75.0)), rel=1e-9)
+
+    def test_stdout_mode(self, tmp_path, capsys):
+        from pint_tpu.scripts import tconvert_parfile
+
+        src = tmp_path / "dd.par"
+        src.write_text(PAR_DD.replace("BINARY T2", "BINARY DD").strip())
+        rc = tconvert_parfile.main([str(src), "--quiet"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "BINARY" in out and "FAKET2" in out
+
+
+class TestT2Binary2Pint:
+    def test_t2_guessed_to_dd(self, tmp_path, capsys):
+        from pint_tpu.models import get_model
+        from pint_tpu.scripts import tt2binary2pint
+
+        src = tmp_path / "t2.par"
+        src.write_text(PAR_DD.strip())
+        out = tmp_path / "out.par"
+        rc = tt2binary2pint.main([str(src), str(out)])
+        assert rc == 0 and out.exists()
+        assert "BINARY T2 -> DD" in capsys.readouterr().out
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(str(out))
+        assert m.BINARY.value == "DD"
+
+    def test_guessing_table(self):
+        from pint_tpu.scripts.tt2binary2pint import guess_binary_model
+
+        assert guess_binary_model({"KOM", "KIN", "PB"}) == "DDK"
+        assert guess_binary_model({"EPS1", "EPS2", "TASC"}) == "ELL1"
+        assert guess_binary_model({"TASC", "H3"}) == "ELL1H"
+        assert guess_binary_model({"SHAPMAX", "T0"}) == "DDS"
+        assert guess_binary_model({"M2", "SINI", "T0"}) == "DD"
+        assert guess_binary_model({"T0", "PB", "A1"}) == "BT"
+
+
+class TestPintPublish:
+    def test_latex_table_real_data(self, tmp_path, capsys):
+        from pint_tpu.scripts import tpintpublish
+
+        par = os.path.join(DATA, "NGC6440E.par")
+        tim = os.path.join(DATA, "NGC6440E.tim")
+        if not os.path.isfile(par):
+            pytest.skip("reference datafiles not present")
+        out = tmp_path / "table.tex"
+        rc = tpintpublish.main([par, tim, "-o", str(out)])
+        assert rc == 0
+        tex = out.read_text()
+        assert r"\begin{table}" in tex and r"\end{table}" in tex
+        assert "Measured parameters" in tex
+        assert "F0" in tex
+        assert "Number of TOAs" in tex
+        assert r"\chi^2" in tex
+
+
+class TestFermiphase:
+    def test_fermi_events(self, tmp_path, capsys):
+        from pint_tpu.scripts import tfermiphase
+
+        ev = os.path.join(
+            DATA, "J0030+0451_P8_15.0deg_239557517_458611204_"
+                  "ft1weights_GEO_wt.gt.0.4.fits")
+        par = os.path.join(DATA, "J0030+0451_post.par")
+        if not os.path.isfile(ev):
+            pytest.skip("reference datafiles not present")
+        out = tmp_path / "phases.txt"
+        rc = tfermiphase.main([ev, par, "--outfile", str(out),
+                               "--quiet"])
+        assert rc == 0 and out.exists()
+        txt = capsys.readouterr().out
+        assert "Htest" in txt
+        rows = out.read_text().splitlines()
+        assert len(rows) > 100
+        phases = np.array([float(r.split()[1]) for r in rows[1:]])
+        assert np.all((phases >= 0) & (phases < 1))

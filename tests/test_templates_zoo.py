@@ -1,0 +1,128 @@
+"""Extended template zoo: asymmetric/skew/von-Mises/King primitives,
+energy dependence, norm-simplex parameterization, binned fitting
+(reference `templates/lcprimitives.py`, `lceprimitives.py`, `lcnorm.py`,
+`lcfitters.py`)."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.templates import (LCEGaussian, LCGaussian, LCGaussian2,
+                                LCKing, LCLorentzian, LCLorentzian2,
+                                LCSkewGaussian, LCTemplate, LCTopHat,
+                                LCVonMises, NormAngles, fit_template,
+                                fit_template_binned)
+
+GRID = (np.arange(8192) + 0.5) / 8192
+
+
+class TestPrimitiveNormalization:
+    @pytest.mark.parametrize("prim", [
+        LCGaussian(0.3, 0.04),
+        LCGaussian2(0.3, 0.02, 0.06),
+        LCSkewGaussian(0.3, 0.04, 4.0),
+        LCLorentzian(0.3, 0.02),
+        LCLorentzian2(0.3, 0.01, 0.03),
+        LCVonMises(0.3, 0.04),
+        LCKing(0.3, 0.02, 1.8),
+        LCTopHat(0.3, 0.2),
+    ])
+    def test_unit_integral(self, prim):
+        vals = np.asarray(prim(GRID))
+        assert np.all(np.isfinite(vals))
+        assert np.mean(vals) == pytest.approx(1.0, abs=2e-3)
+
+    def test_gaussian2_asymmetry(self):
+        p = LCGaussian2(0.5, 0.01, 0.05)
+        v = np.asarray(p(GRID))
+        lead = v[(GRID > 0.45) & (GRID < 0.5)].sum()
+        trail = v[(GRID > 0.5) & (GRID < 0.55)].sum()
+        assert trail > 2 * lead
+
+    def test_skew_shifts_mass(self):
+        sym = np.asarray(LCSkewGaussian(0.5, 0.03, 0.0)(GRID))
+        ref = np.asarray(LCGaussian(0.5, 0.03)(GRID))
+        np.testing.assert_allclose(sym, ref, rtol=1e-9, atol=1e-9)
+        skew = np.asarray(LCSkewGaussian(0.5, 0.03, 5.0)(GRID))
+        mean_skew = np.sum(GRID * skew) / np.sum(skew)
+        assert mean_skew > 0.5 + 0.005
+
+    def test_vonmises_matches_gaussian_when_narrow(self):
+        g = np.asarray(LCGaussian(0.5, 0.02)(GRID))
+        v = np.asarray(LCVonMises(0.5, 0.02)(GRID))
+        assert np.max(np.abs(v - g)) / np.max(g) < 0.01
+
+
+class TestEnergyDependence:
+    def test_location_drifts_with_energy(self):
+        p = LCEGaussian(0.5, 0.03, loc_slope=0.05, width_slope=0.0)
+        lo = np.asarray(p(GRID, log10_ens=np.full_like(GRID, 2.0)))
+        hi = np.asarray(p(GRID, log10_ens=np.full_like(GRID, 4.0)))
+        assert GRID[np.argmax(lo)] == pytest.approx(0.45, abs=0.002)
+        assert GRID[np.argmax(hi)] == pytest.approx(0.55, abs=0.002)
+
+    def test_energy_independent_at_1gev(self):
+        p = LCEGaussian(0.5, 0.03, loc_slope=0.05, width_slope=0.01)
+        at3 = np.asarray(p(GRID, log10_ens=np.full_like(GRID, 3.0)))
+        ref = np.asarray(LCGaussian(0.5, 0.03)(GRID))
+        np.testing.assert_allclose(at3, ref, rtol=1e-9)
+
+
+class TestNormAngles:
+    def test_roundtrip(self):
+        for norms in ([0.3, 0.5], [0.0, 0.2, 0.7], [1.0], [0.25] * 4):
+            na = NormAngles(norms)
+            np.testing.assert_allclose(na.get_norms(), norms, atol=1e-12)
+
+    def test_any_angles_valid(self):
+        na = NormAngles([0.3, 0.3])
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            na.angles = rng.uniform(-5, 5, 2)
+            n = na.get_norms()
+            assert np.all(n >= -1e-12) and n.sum() <= 1 + 1e-12
+
+    def test_invalid_rejected(self):
+        with pytest.raises(ValueError):
+            NormAngles([0.8, 0.5])
+
+
+class TestFitters:
+    def _draw(self, n=40000, seed=4):
+        rng = np.random.default_rng(seed)
+        n1 = rng.binomial(n, 0.35)
+        n2 = rng.binomial(n - n1, 0.25 / 0.65)
+        ph1 = rng.normal(0.3, 0.015, n1)
+        ph2 = 0.62 + rng.standard_cauchy(n2) * 0.02
+        ph2 = ph2[np.abs(ph2 - 0.62) < 0.4][: n2 // 2]
+        bg = rng.uniform(0, 1, n - n1 - len(ph2))
+        return np.concatenate([ph1, ph2, bg]) % 1.0
+
+    def test_binned_matches_unbinned(self):
+        phases = self._draw()
+        t1 = LCTemplate([LCGaussian(0.32, 0.02), LCLorentzian(0.6, 0.03)],
+                        [0.3, 0.15])
+        t2 = LCTemplate([LCGaussian(0.32, 0.02), LCLorentzian(0.6, 0.03)],
+                        [0.3, 0.15])
+        fit_template(t1, phases)
+        fit_template_binned(t2, phases, nbins=256)
+        for p1, p2 in zip(t1.primitives, t2.primitives):
+            assert p1.loc == pytest.approx(p2.loc, abs=2e-3)
+        assert t1.norms[0] == pytest.approx(t2.norms[0], abs=0.02)
+        assert t1.primitives[0].loc == pytest.approx(0.3, abs=3e-3)
+
+    def test_fit_asymmetric_peak(self):
+        rng = np.random.default_rng(9)
+        n = 30000
+        npk = rng.binomial(n, 0.5)
+        # true two-sided gaussian: side chosen with mass ratio w1:w2
+        side = rng.uniform(size=npk) < 0.01 / 0.05
+        half = np.abs(rng.normal(0.0, 1.0, npk))
+        ph = np.where(side, -half * 0.01, half * 0.04) + 0.5
+        phases = np.concatenate([ph, rng.uniform(0, 1, n - npk)]) % 1.0
+        t = LCTemplate([LCGaussian2(0.52, 0.02, 0.02)], [0.4])
+        fit_template(t, phases)
+        w1, w2 = t.primitives[0].shape
+        assert t.primitives[0].loc == pytest.approx(0.5, abs=5e-3)
+        assert w1 == pytest.approx(0.01, rel=0.25)
+        assert w2 == pytest.approx(0.04, rel=0.25)
+        assert w2 > 2.0 * w1
