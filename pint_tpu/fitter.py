@@ -48,7 +48,8 @@ def _machine_eps() -> float:
 
 __all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
            "DownhillGLSFitter", "PowellFitter", "LMFitter",
-           "WidebandTOAFitter", "WidebandDownhillFitter", "fit_wls_svd",
+           "WidebandTOAFitter", "WidebandDownhillFitter", "WidebandLMFitter",
+           "fit_wls_svd",
            "build_wls_step", "build_gls_step", "build_gls_fullcov_step"]
 
 
@@ -157,6 +158,36 @@ def build_chi2_fn(model: TimingModel, batch: TOABatch,
             w = 1.0 / sigma**2
             r = r - jnp.sum(r * w) / jnp.sum(w)
         return jnp.sum((r / sigma) ** 2)
+
+    return chi2
+
+
+def build_wideband_chi2_fn(model: TimingModel, batch: TOABatch,
+                           dm_index, dm_data, dm_error,
+                           fit_params: Sequence[str], track_mode: str,
+                           include_offset: bool):
+    """Jitted combined TOA+DM chi2 ``(x, p) -> float`` — the wideband
+    trial-point metric for Powell/LM (no jacobian)."""
+    from pint_tpu.residuals import scaled_dm_sigma_rows
+
+    names = list(fit_params)
+    resid_sec = build_resid_sec_fn(model, batch, names, track_mode)
+    idx = jnp.asarray(np.asarray(dm_index), dtype=jnp.int64)
+    dmv = jnp.asarray(np.asarray(dm_data, np.float64))
+    dme = jnp.asarray(np.asarray(dm_error, np.float64))
+
+    @jax.jit
+    def chi2(x, p):
+        p2 = model.with_x(p, x, names)
+        r_t = resid_sec(x, p)
+        sigma_t = model.scaled_toa_uncertainty(p, batch) * 1e-6
+        if include_offset:
+            w = 1.0 / sigma_t**2
+            r_t = r_t - jnp.sum(r_t * w) / jnp.sum(w)
+        r_dm = dmv - model.total_dm(p2, batch)[idx]
+        sigma_dm = scaled_dm_sigma_rows(model, p, batch, idx, dme)
+        return jnp.sum((r_t / sigma_t) ** 2) + \
+            jnp.sum((r_dm / sigma_dm) ** 2)
 
     return chi2
 
@@ -992,6 +1023,15 @@ class LMFitter(Fitter):
     `/root/reference/src/pint/fitter.py:2313`).  The damped solve runs on
     device from the same whitened assembly as WLS."""
 
+    def _make_assembly(self, names, include_offset):
+        return build_whitened_assembly(self.model, self.resids.batch,
+                                       names, self.track_mode,
+                                       include_offset)
+
+    def _make_chi2_fn(self, names, include_offset):
+        return build_chi2_fn(self.model, self.resids.batch, names,
+                             self.track_mode, include_offset)
+
     def fit_toas(self, maxiter: int = 50, lam0: float = 1e-3,
                  lam_decrease: float = 3.0, lam_increase: float = 5.0,
                  tol_chi2: float = 1e-8, threshold=None) -> float:
@@ -999,8 +1039,8 @@ class LMFitter(Fitter):
         names = self.fit_params
         p = self._device_pdict()
         include_offset = "PhaseOffset" not in m.components
-        assemble = build_whitened_assembly(m, self.resids.batch, names,
-                                          self.track_mode, include_offset)
+        assemble = self._make_assembly(names, include_offset)
+
         @jax.jit
         def damped_solve(r, M, sigma, offc, lam):
             Mw = M / sigma[:, None]
@@ -1029,8 +1069,7 @@ class LMFitter(Fitter):
             r, M, sigma, offc = assemble(x, p)
             return damped_solve(r, M, sigma, offc, lam)
 
-        chi2_fn = build_chi2_fn(m, self.resids.batch, names,
-                                self.track_mode, include_offset)
+        chi2_fn = self._make_chi2_fn(names, include_offset)
         x = np.zeros(len(names))
         lam = lam0
         chi2 = float(chi2_fn(jnp.asarray(x), p))
@@ -1115,6 +1154,29 @@ class WidebandTOAFitter(GLSFitter):
         x = self.model.x0(p, names)
         _, M, _, _ = jax.jit(assemble)(x, p)
         return np.asarray(M), names
+
+
+class WidebandLMFitter(LMFitter, WidebandTOAFitter):
+    """Levenberg-Marquardt over the combined TOA+DM wideband assembly
+    (reference `WidebandLMFitter`,
+    `/root/reference/src/pint/fitter.py:2436`)."""
+
+    def __init__(self, toas, model: TimingModel,
+                 track_mode: Optional[str] = None):
+        WidebandTOAFitter.__init__(self, toas, model,
+                                   track_mode=track_mode)
+
+    def _make_assembly(self, names, include_offset):
+        wb = self.resids
+        return build_wideband_assembly(
+            self.model, wb.batch, wb.dm_index, wb.dm_data, wb.dm_error,
+            names, self.track_mode, include_offset)
+
+    def _make_chi2_fn(self, names, include_offset):
+        wb = self.resids
+        return build_wideband_chi2_fn(
+            self.model, wb.batch, wb.dm_index, wb.dm_data, wb.dm_error,
+            names, self.track_mode, include_offset)
 
 
 class WidebandDownhillFitter(DownhillWLSFitter, WidebandTOAFitter):

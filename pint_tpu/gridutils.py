@@ -145,3 +145,17 @@ def grid_chisq_derived(fitter: Fitter, parnames: Sequence[str],
     chi2 = grid_chisq_flat(fitter, out, maxiter=maxiter)
     parvalues = [out[n].reshape(grids[0].shape) for n in parnames]
     return chi2.reshape(grids[0].shape), parvalues
+
+
+def tuple_chisq(fitter: Fitter, parnames: Sequence[str], parvalues,
+                maxiter: int = 2):
+    """chi2 at an arbitrary LIST of parameter tuples (reference
+    `tuple_chisq`, `/root/reference/src/pint/gridutils.py:593`, there a
+    process pool over points; here the whole list is one vmapped XLA
+    program).  ``parvalues``: sequence of tuples, one value per name in
+    ``parnames``.  Returns ``(chi2[G], dof)``."""
+    vals = np.asarray([[float(v) for v in tup] for tup in parvalues],
+                      np.float64)
+    flat = {n: vals[:, i] for i, n in enumerate(parnames)}
+    chi2 = grid_chisq_flat(fitter, flat, maxiter=maxiter)
+    return chi2, fitter.resids.dof

@@ -342,3 +342,29 @@ class TestSimulationNoise:
         # ~1 us within the fitted span
         spread_us = np.std(dt, axis=0).mean() * 1e6
         assert 0.05 < spread_us < 10.0
+
+
+class TestTupleChisq:
+    def test_matches_grid(self):
+        """tuple_chisq over an arbitrary point list equals grid_chisq_flat
+        at the same points (reference `tuple_chisq`, gridutils.py:593)."""
+        import warnings
+
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.gridutils import grid_chisq_flat, tuple_chisq
+        from pint_tpu.examples import simulate_j0740_class
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m, toas = simulate_j0740_class(ntoas=60, span_days=400.0,
+                                           seed=2)
+        m.M2.frozen = True
+        m.SINI.frozen = True
+        f = WLSFitter(toas, m)
+        pts = [(0.23, 0.98), (0.25, 0.99), (0.27, 0.985)]
+        chi2_t, dof = tuple_chisq(f, ("M2", "SINI"), pts, maxiter=2)
+        grid = {"M2": np.array([p[0] for p in pts]),
+                "SINI": np.array([p[1] for p in pts])}
+        chi2_g = grid_chisq_flat(f, grid, maxiter=2)
+        np.testing.assert_allclose(chi2_t, chi2_g, rtol=1e-12)
+        assert chi2_t.shape == (3,) and dof > 0

@@ -226,3 +226,25 @@ class TestWidebandWithCorrelatedNoise:
             f.fit_toas(maxiter=3)
         assert np.isfinite(f.fitresult.chi2)
         assert f.fitresult.chi2 / f.resids.dof < 2.0
+
+
+class TestWidebandLM:
+    def test_lm_matches_downhill(self):
+        """WidebandLMFitter recovers the same solution as the downhill
+        wideband fitter (reference `WidebandLMFitter`, fitter.py:2436)."""
+        from pint_tpu.fitter import WidebandDownhillFitter, WidebandLMFitter
+
+        m1, toas = make_wb_dataset(ntoas=50, seed=7)
+        m2 = get_model(m1.as_parfile().splitlines())
+        truth_f0 = m1.F0.value
+        m1.F0.value = truth_f0 + 2e-11
+        m2.F0.value = truth_f0 + 2e-11
+        f1 = WidebandDownhillFitter(toas, m1)
+        f1.fit_toas(maxiter=10)
+        f2 = WidebandLMFitter(toas, m2)
+        f2.fit_toas(maxiter=30)
+        for n in ("F0", "DM"):
+            assert abs(m2[n].value - m1[n].value) < \
+                2e-2 * m1[n].uncertainty + 1e-15, n
+            assert m2[n].uncertainty == pytest.approx(m1[n].uncertainty,
+                                                      rel=0.05), n
