@@ -220,6 +220,118 @@ class TestWLSKernel:
         np.testing.assert_allclose(M @ np.asarray(dx), r, atol=1e-8)
 
 
+class TestEighKernel:
+    """fit_wls_eigh (the MXU normal-equations kernel used on TPU) against
+    fit_wls_svd — same contract, same thresholding semantics."""
+
+    def test_matches_svd_well_conditioned(self):
+        from pint_tpu.fitter import fit_wls_eigh
+
+        rng = np.random.default_rng(11)
+        N, P = 300, 8
+        M = rng.standard_normal((N, P)) * 10.0 ** rng.integers(-3, 4, P)
+        sigma = rng.uniform(0.5, 2.0, N)
+        r = rng.standard_normal(N)
+        dx_s, Sig_s, n_s, nb_s = fit_wls_svd(M, r, sigma)
+        dx_e, Sig_e, n_e, nb_e = fit_wls_eigh(M, r, sigma)
+        assert int(nb_s) == int(nb_e) == 0
+        np.testing.assert_allclose(np.asarray(dx_e), np.asarray(dx_s),
+                                   rtol=1e-9, atol=0)
+        np.testing.assert_allclose(np.asarray(n_e), np.asarray(n_s),
+                                   rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(Sig_e), np.asarray(Sig_s),
+                                   rtol=1e-8, atol=1e-12)
+
+    def test_degenerate_column_flagged(self):
+        from pint_tpu.fitter import fit_wls_eigh
+
+        rng = np.random.default_rng(3)
+        N = 50
+        a = rng.standard_normal(N)
+        M = np.stack([a, 2 * a], axis=1)  # rank 1
+        r = a.copy()
+        sigma = np.ones(N)
+        dx, Sigma_n, norms, nbad = fit_wls_eigh(M, r, sigma)
+        assert int(nbad) == 1
+        np.testing.assert_allclose(M @ np.asarray(dx), r, atol=1e-8)
+
+    def test_near_collinear_below_noise_floor_dropped(self):
+        """A direction deeper than the normal-equations noise floor
+        (relative singular value ~1e-9 << sqrt(eps*P)) must be FLAGGED by
+        the eigh kernel — its eigenvalue is rounding garbage and keeping
+        it would inject a 1/e ~ 1e16 step.  The SVD kernel legitimately
+        resolves it; that asymmetry is the kernel's documented divergence."""
+        from pint_tpu.fitter import fit_wls_eigh
+
+        rng = np.random.default_rng(5)
+        N = 400
+        a = rng.standard_normal(N)
+        b = rng.standard_normal(N)
+        b -= a * (a @ b) / (a @ a)          # b orthogonal to a
+        b /= np.linalg.norm(b)
+        a /= np.linalg.norm(a)
+        M = np.stack([a, a + 2e-9 * b], axis=1)
+        r = a + 0.3 * b
+        sigma = np.ones(N)
+        dx_s, _, _, nb_s = fit_wls_svd(M, r, sigma)
+        dx_e, _, _, nb_e = fit_wls_eigh(M, r, sigma)
+        assert int(nb_s) == 0               # SVD resolves 1e-9 in f64
+        assert int(nb_e) == 1               # eigh must drop, not keep noise
+        # the eigh solution is the sane minimum-norm one, not garbage
+        assert np.all(np.abs(np.asarray(dx_e)) < 1e3)
+        np.testing.assert_allclose(M @ np.asarray(dx_e), a, atol=1e-6)
+
+    def test_deep_but_resolvable_degeneracy_kept(self):
+        """At ~1e-4 relative singular value (the OM-T0-class regime, two
+        orders above the noise floor) BOTH kernels must keep the direction
+        and agree on the solution."""
+        from pint_tpu.fitter import fit_wls_eigh
+
+        rng = np.random.default_rng(8)
+        N = 400
+        a = rng.standard_normal(N)
+        b = rng.standard_normal(N)
+        b -= a * (a @ b) / (a @ a)
+        b /= np.linalg.norm(b)
+        a /= np.linalg.norm(a)
+        M = np.stack([a, a + 2e-4 * b], axis=1)
+        xtrue = np.array([0.7, -0.4])
+        r = M @ xtrue
+        sigma = np.ones(N)
+        dx_s, _, _, nb_s = fit_wls_svd(M, r, sigma)
+        dx_e, _, _, nb_e = fit_wls_eigh(M, r, sigma)
+        assert int(nb_s) == 0 and int(nb_e) == 0
+        np.testing.assert_allclose(np.asarray(dx_e), xtrue, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(dx_e), np.asarray(dx_s),
+                                   rtol=1e-4)
+
+    def test_full_fit_same_answer(self, sim):
+        """A complete WLS fit forced through each kernel recovers the same
+        parameters to well inside 1e-3 of the quoted uncertainties."""
+        from pint_tpu.fitter import build_wls_step, fit_wls_eigh
+        import jax.numpy as jnp
+
+        m, toas, truth = sim
+        f = WLSFitter(toas, m)
+        r = f.resids
+        outs = {}
+        for kern in (fit_wls_svd, fit_wls_eigh):
+            step = build_wls_step(m, r.batch, f.fit_params, f.track_mode,
+                                  kernel=kern)
+            x = jnp.zeros(len(f.fit_params))
+            for _ in range(3):
+                x = x + step(x, r.pdict)["dx"]
+            out = step(x, r.pdict)
+            outs[kern.__name__] = (np.asarray(x), out)
+        x_s, out_s = outs["fit_wls_svd"]
+        x_e, out_e = outs["fit_wls_eigh"]
+        sig = np.sqrt(np.abs(np.diag(np.asarray(out_s["Sigma_n"])))) / \
+            np.asarray(out_s["norms"])
+        assert np.all(np.abs(x_e - x_s) < 1e-3 * sig + 1e-30)
+        assert float(out_e["chi2"]) == pytest.approx(
+            float(out_s["chi2"]), rel=1e-9)
+
+
 class TestPowellAndLM:
     """PowellFitter / LMFitter / grid_chisq_derived (reference
     `fitter.py:1659,2313`, `gridutils.py:395`)."""
