@@ -802,9 +802,9 @@ class IntegratedEphemeris(BuiltinEphemeris):
                 "ephemeris (N-body fit to the analytic theory; Earth "
                 "~100 km).  Supply a DE kernel via $PINT_TPU_EPHEM_DIR "
                 "for full accuracy.", stacklevel=2)
-        self._lo = None
-        self._hi = None
-        self._splines = None
+        #: (wlo, whi) -> {body: CubicSpline}; every quantized window ever
+        #: built in this process
+        self._windows = {}
 
     # -- window management -------------------------------------------------
     @staticmethod
@@ -814,18 +814,48 @@ class IntegratedEphemeris(BuiltinEphemeris):
             d = os.path.join(os.path.expanduser("~"), ".cache", "pint_tpu")
         return d
 
-    def _ensure_window(self, mjd):
+    def _window_key(self, mjd):
+        """The quantized window covering this query, a pure function of
+        the query epochs ALONE.  Earlier designs extended one global
+        window as new epochs arrived; because the EMB initial-condition
+        fit runs over the whole window, extension changed the served
+        Earth positions for epochs already answered — results then
+        depended on query *history* (test-order-dependent parity
+        failures).  Deterministic quantization means the same dataset
+        always gets the same integration no matter what else the process
+        touched; distinct datasets may use overlapping windows (disk
+        cache makes rebuilds cheap)."""
         mjd = np.atleast_1d(np.asarray(mjd, np.float64))
         lo, hi = float(np.min(mjd)), float(np.max(mjd))
-        if self._lo is not None and self._lo <= lo and hi <= self._hi:
-            return
         q = self._QUANTUM
-        wlo = np.floor((lo - self._PAD) / q) * q
-        whi = np.ceil((hi + self._PAD) / q) * q
-        if self._lo is not None:  # extend, don't shrink
-            wlo = min(wlo, self._lo)
-            whi = max(whi, self._hi)
-        self._build(wlo, whi)
+        wlo = float(np.floor((lo - self._PAD) / q) * q)
+        whi = float(np.ceil((hi + self._PAD) / q) * q)
+        return wlo, whi
+
+    def _splines_for(self, mjd, key=None):
+        if key is not None:
+            # pinned path: never serve silent CubicSpline extrapolation —
+            # a query outside the pinned window falls back to its own
+            # quantized window (still deterministic, still correct)
+            m = np.atleast_1d(np.asarray(mjd, np.float64))
+            if not (key[0] <= float(np.min(m))
+                    and float(np.max(m)) <= key[1]):
+                key = None
+        if key is None:
+            key = self._window_key(mjd)
+        sp = self._windows.get(key)
+        if sp is None:
+            sp = self._windows[key] = self._build(*key)
+        return sp
+
+    def pinned_to(self, mjd_span):
+        """A view of this ephemeris whose every query is served from the
+        single window quantized from ``mjd_span`` — so a multi-observatory
+        dataset (whose posvels are computed in per-site groups with
+        different time ranges) sees ONE consistent integration throughout.
+        The span must cover the later queries (the window pad leaves
+        ~700 days of slack)."""
+        return _PinnedEphemeris(self, self._window_key(mjd_span))
 
     def _build(self, wlo, whi):
         from scipy.interpolate import CubicSpline
@@ -849,8 +879,7 @@ class IntegratedEphemeris(BuiltinEphemeris):
                 os.replace(tmp, path)
             except OSError:
                 pass
-        self._lo, self._hi = float(grid[0]), float(grid[-1])
-        self._splines = {
+        return {
             nm: CubicSpline(grid, states[:, 3 * i:3 * i + 3])
             for i, nm in enumerate(_NBODY_NAMES)
         }
@@ -928,17 +957,17 @@ class IntegratedEphemeris(BuiltinEphemeris):
         return grid, Y[:, :nstate]
 
     # -- posvel ------------------------------------------------------------
-    def posvel(self, body: str, mjd_tdb) -> PosVel:
+    def posvel(self, body: str, mjd_tdb, _window_key=None) -> PosVel:
         body = body.lower()
         mjd = np.asarray(mjd_tdb, np.float64)
         if body == "ssb":
             z = np.zeros(np.shape(mjd) + (3,))
             return PosVel(z, z.copy())
-        self._ensure_window(mjd)
+        splines = self._splines_for(mjd, key=_window_key)
         t_cy = (mjd - _J2000_MJD) / 36525.0
         if body in ("earth", "moon", "emb"):
-            emb_p = self._splines["emb"](mjd)
-            emb_v = self._splines["emb"](mjd, 1) / DAY_S
+            emb_p = splines["emb"](mjd)
+            emb_v = splines["emb"](mjd, 1) / DAY_S
             if body == "emb":
                 return PosVel(emb_p, emb_v)
             mp_km, mv_kmd = _moon_geocentric_km(t_cy)
@@ -951,9 +980,9 @@ class IntegratedEphemeris(BuiltinEphemeris):
             return PosVel(emb_p + (1.0 - _MOON_FRAC) * mp,
                           emb_v + (1.0 - _MOON_FRAC) * mv)
         key = body[:-5] if body.endswith("_bary") else body
-        if key in self._splines:
-            return PosVel(self._splines[key](mjd),
-                          self._splines[key](mjd, 1) / DAY_S)
+        if key in splines:
+            return PosVel(splines[key](mjd),
+                          splines[key](mjd, 1) / DAY_S)
         return super().posvel(body, mjd_tdb)
 
 
@@ -1022,12 +1051,27 @@ def load_ephemeris(name: Optional[str] = "DE421"):
     return eph
 
 
+class _PinnedEphemeris:
+    """Window-pinned view of an :class:`IntegratedEphemeris` (see
+    `IntegratedEphemeris.pinned_to`)."""
+
+    def __init__(self, eph: "IntegratedEphemeris", key):
+        self._eph = eph
+        self._key = key
+        self.name = eph.name
+
+    def posvel(self, body: str, mjd_tdb) -> PosVel:
+        return self._eph.posvel(body, mjd_tdb, _window_key=self._key)
+
+
 _INTEGRATED_SINGLETON: Optional["IntegratedEphemeris"] = None
 
 
 def _shared_integrated() -> "IntegratedEphemeris":
     """One IntegratedEphemeris instance for every kernel-name fallback, so
-    the integration window is built (and extended) once per process."""
+    each quantized window is integrated once per process and shared (the
+    instance keeps a dict of windows; results are a pure function of each
+    query's own span — see `IntegratedEphemeris._window_key`)."""
     global _INTEGRATED_SINGLETON
     if _INTEGRATED_SINGLETON is None:
         _INTEGRATED_SINGLETON = IntegratedEphemeris(warn=False)

@@ -36,7 +36,7 @@ try:  # jax >= 0.8 public API; fall back for older jax
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from pint_tpu.fitter import build_resid_sec_fn
+from pint_tpu.fitter import build_resid_sec_fn, masked_eigh_inverse
 from pint_tpu.gridutils import grid_in_axes, stack_grid_pdict
 from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.residuals import raw_phase_resids
@@ -114,7 +114,13 @@ def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
         d = jnp.sqrt(jnp.diagonal(A))
         d = jnp.where(d == 0.0, 1.0, d)
         An = A / jnp.outer(d, d)
-        z = jnp.linalg.solve(An, bb / d)
+        # thresholded eigendecomposition with the exact semantics of the
+        # single-device kernel — an unthresholded solve diverges
+        # percent-level from the vmap path on NANOGrav design matrices,
+        # whose DMX/JUMP columns are near-degenerate
+        n_total = M.shape[0] * mesh.devices.shape[1]
+        V, einv, _ = masked_eigh_inverse(An, None, n_total)
+        z = V @ (einv * (V.T @ (bb / d)))
         dx = z / (d * cmax)
         # chi2 at x with the offset profiled out, reduced over shards
         w = 1.0 / sigma**2

@@ -536,6 +536,11 @@ class TOAs:
         self.ephem = ephem
         self.planets = planets
         tdb_f = self.tdb.mjd_float
+        if hasattr(eph, "pinned_to") and len(tdb_f):
+            # serve every per-observatory group below from the ONE window
+            # quantized from the full dataset span (integrated-ephemeris
+            # consistency; see IntegratedEphemeris.pinned_to)
+            eph = eph.pinned_to(tdb_f)
         tt = mjdmod.utc_to_tt(self.utc)
 
         n = self.ntoas
