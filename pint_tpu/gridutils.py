@@ -70,11 +70,12 @@ def grid_in_axes(p: dict, grid_names: Sequence[str]) -> dict:
 
 def build_grid_fit_fn(model: TimingModel, batch, fit_params: Sequence[str],
                       track_mode: str, maxiter: int = 2,
-                      threshold: Optional[float] = None):
+                      threshold: Optional[float] = None, kernel=None):
     """``fit_one(p) -> (chi2, x)``: a full (fixed-iteration) WLS fit of one
-    pytree — vmap/shard_map this over stacked grid pytrees."""
+    pytree — vmap/shard_map this over stacked grid pytrees.  ``kernel``
+    forces a specific WLS solve kernel (default: backend-matched)."""
     step = build_wls_step(model, batch, fit_params, track_mode,
-                          threshold=threshold)
+                          threshold=threshold, kernel=kernel)
 
     def fit_one(p):
         x = jnp.zeros(len(fit_params))
@@ -87,9 +88,10 @@ def build_grid_fit_fn(model: TimingModel, batch, fit_params: Sequence[str],
 
 
 def grid_chisq_flat(fitter: Fitter, grid_values: Dict[str, np.ndarray],
-                    maxiter: int = 2) -> np.ndarray:
+                    maxiter: int = 2, kernel=None) -> np.ndarray:
     """chi2 at each of G grid points (all grid arrays shape (G,)); the
-    non-grid free parameters are re-fit at every point."""
+    non-grid free parameters are re-fit at every point.  ``kernel``
+    forces a specific WLS solve kernel (default: backend-matched)."""
     model = fitter.model
     r = fitter.resids
     names = [n for n in fitter.fit_params if n not in grid_values]
@@ -99,14 +101,15 @@ def grid_chisq_flat(fitter: Fitter, grid_values: Dict[str, np.ndarray],
     p = r.pdict
     # cache the compiled vmapped fit on the fitter: a fresh jit wrapper
     # per call would retrace the whole grid program every time
-    key = (tuple(sorted(grid_values)), tuple(names), maxiter)
+    key = (tuple(sorted(grid_values)), tuple(names), maxiter, kernel)
     cache = getattr(fitter, "_grid_fit_cache", None)
     if cache is None:
         cache = fitter._grid_fit_cache = {}
     vfit = cache.get(key)
     if vfit is None:
         fit_one = build_grid_fit_fn(model, r.batch, names,
-                                    fitter.track_mode, maxiter=maxiter)
+                                    fitter.track_mode, maxiter=maxiter,
+                                    kernel=kernel)
         axes = grid_in_axes(p, list(grid_values))
         vfit = cache[key] = jax.jit(jax.vmap(fit_one, in_axes=(axes,)))
     stacked = stack_grid_pdict(model, p, grid_values)

@@ -499,7 +499,12 @@ class TimingModel:
             import jax as _jax
 
             try:
-                ctx = _jax.default_device(_jax.devices("cpu")[0])
+                # local_devices, not devices: under a multi-process
+                # runtime (pint_tpu.multihost) global cpu device 0 is
+                # non-addressable from ranks > 0, and pinning eager ops
+                # to a non-addressable device segfaults the CPU client
+                ctx = _jax.default_device(
+                    _jax.local_devices(backend="cpu")[0])
             except RuntimeError:  # JAX_PLATFORMS excludes cpu
                 ctx = contextlib.nullcontext()
             p_tzr = {"const": const, "delta": delta, "mask": tzr_mask}

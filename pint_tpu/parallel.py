@@ -163,24 +163,25 @@ def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
     return make
 
 
-def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
-                       mesh: Optional[Mesh] = None,
-                       maxiter: int = 2) -> np.ndarray:
-    """chi2 over a flat grid, sharded over the mesh: the distributed
-    replacement for the reference's ProcessPoolExecutor grid."""
+def prep_sharded_grid(fitter, grid_values: Dict[str, np.ndarray],
+                      mesh: Mesh, batch_splits: int, maxiter: int,
+                      cache_tag: str):
+    """Shared preparation for the single-process and multi-process grid
+    entry points: validate the grid, pad the TOA axis to the mesh's toa
+    dimension, stack the grid pytree, and fetch/compile the shard_map
+    program (cached on the fitter).  Returns ``(fit, stacked, batch,
+    g)``."""
     if not grid_values:
         raise ValueError("grid_values is empty")
-    mesh = mesh or make_mesh()
     model = fitter.model
     r = fitter.resids
     sizes = {n: len(v) for n, v in grid_values.items()}
     if len(set(sizes.values())) != 1:
         raise ValueError(f"grid arrays differ in length: {sizes}")
     g = next(iter(sizes.values()))
-    if g % mesh.devices.shape[0]:
-        raise ValueError(
-            f"grid size {g} does not split over "
-            f"{mesh.devices.shape[0]} batch-axis devices")
+    if g % batch_splits:
+        raise ValueError(f"grid size {g} does not split over "
+                         f"{batch_splits} batch-axis shards")
     for n in grid_values:
         if not model[n].frozen:
             raise ValueError(f"grid parameter {n} must be frozen")
@@ -198,7 +199,7 @@ def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
     stacked = stack_grid_pdict(model, p, grid_values)
     # cache the compiled sharded program on the fitter (same rationale as
     # gridutils.grid_chisq_flat: a fresh shard_map+jit per call retraces)
-    key = ("sharded", tuple(sorted(grid_values)), tuple(names), maxiter,
+    key = (cache_tag, tuple(sorted(grid_values)), tuple(names), maxiter,
            mesh.devices.shape, batch.ntoas, g)
     cache = getattr(fitter, "_grid_fit_cache", None)
     if cache is None:
@@ -208,5 +209,17 @@ def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
         make = build_sharded_grid_fit(model, names, fitter.track_mode,
                                       mesh, maxiter=maxiter)
         fit = cache[key] = make(stacked, batch, list(grid_values))
+    return fit, stacked, batch, g
+
+
+def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
+                       mesh: Optional[Mesh] = None,
+                       maxiter: int = 2) -> np.ndarray:
+    """chi2 over a flat grid, sharded over the mesh: the distributed
+    replacement for the reference's ProcessPoolExecutor grid."""
+    mesh = mesh or make_mesh()
+    fit, stacked, batch, _ = prep_sharded_grid(
+        fitter, grid_values, mesh, mesh.devices.shape[0], maxiter,
+        "sharded")
     chi2, _ = fit(stacked, batch)
     return np.asarray(chi2)

@@ -1,0 +1,45 @@
+"""SPMD worker for tests/test_multihost.py — one OS process per 'host'.
+
+Every worker builds the identical tiny problem, joins the distributed
+runtime, runs the multi-process grid fit, and process 0 prints the chi2
+vector as JSON for the parent to compare against the single-process
+path."""
+
+import json
+import sys
+import warnings
+
+warnings.filterwarnings("ignore")
+
+
+def main():
+    coord, pid, nproc, nlocal = (sys.argv[1], int(sys.argv[2]),
+                                 int(sys.argv[3]), int(sys.argv[4]))
+    from pint_tpu import multihost
+
+    multihost.init(coordinator=coord, num_processes=nproc, process_id=pid,
+                   local_devices=nlocal)
+
+    import numpy as np
+
+    from pint_tpu.examples import simulate_j0740_class
+    from pint_tpu.fitter import WLSFitter
+
+    model, toas = simulate_j0740_class(ntoas=40, span_days=600.0)
+    model.M2.frozen = True
+    model.SINI.frozen = True
+    fitter = WLSFitter(toas, model)
+    grid = {
+        "M2": np.repeat(np.array([0.2, 0.3]), 2),
+        "SINI": np.tile(np.array([0.95, 0.99]), 2),
+    }
+    mesh = multihost.global_mesh()
+    chi2 = multihost.multihost_grid_chisq(fitter, grid, mesh=mesh,
+                                          maxiter=2)
+    if pid == 0:
+        print("@@CHI2@@" + json.dumps([float(c) for c in chi2]),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

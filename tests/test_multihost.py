@@ -1,0 +1,75 @@
+"""Multi-process (multi-host analogue) grid fit: real OS processes, Gloo
+collectives, global arrays — checked against the single-process path.
+
+The reference has no multi-host capability at all (SURVEY §2.8: its only
+parallelism is a same-host process pool, `gridutils.py:322`); this
+validates the DCN layer of the TPU-native scale-out
+(`pint_tpu/multihost.py`)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_grid_matches_single_process():
+    nproc, nlocal = 2, 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + ":" + env.get("PYTHONPATH", "")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coord, str(i), str(nproc), str(nlocal)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for i in range(nproc)]
+    try:
+        outs = [p.communicate(timeout=850) for p in procs]
+    finally:
+        for p in procs:  # no leaked workers if one hangs the rendezvous
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{se[-2000:]}"
+    lines = [ln for ln in outs[0][0].splitlines()
+             if ln.startswith("@@CHI2@@")]
+    assert lines, f"no chi2 output: {outs[0][0][-500:]}"
+    chi2_mp = np.array(json.loads(lines[0][len("@@CHI2@@"):]))
+
+    # single-process reference: the same problem on this process's own
+    # (2, 2) virtual mesh
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.examples import simulate_j0740_class
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.parallel import make_mesh, sharded_grid_chisq
+
+        model, toas = simulate_j0740_class(ntoas=40, span_days=600.0)
+        model.M2.frozen = True
+        model.SINI.frozen = True
+        fitter = WLSFitter(toas, model)
+        grid = {
+            "M2": np.repeat(np.array([0.2, 0.3]), 2),
+            "SINI": np.tile(np.array([0.95, 0.99]), 2),
+        }
+        mesh = make_mesh(4, batch=2)  # (2, 2), same shape as 2 hosts x 2
+        chi2_sp = sharded_grid_chisq(fitter, grid, mesh=mesh, maxiter=2)
+
+    assert chi2_mp.shape == chi2_sp.shape == (4,)
+    assert np.all(np.isfinite(chi2_mp))
+    np.testing.assert_allclose(chi2_mp, chi2_sp, rtol=1e-9)
