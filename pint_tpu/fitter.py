@@ -134,20 +134,30 @@ def fit_wls_eigh(M, r_sec, sigma_sec, threshold: Optional[float] = None):
     uncertainties.
     """
     Mn, rw, norms = _whiten_normalize(M, r_sec, sigma_sec)
-    G = Mn.T @ Mn
-    e, V = jnp.linalg.eigh(G)
-    S = jnp.sqrt(jnp.maximum(e, 0.0))
-    if threshold is None:
-        threshold = _machine_eps() * max(M.shape)
-    # noise floor of the eigendecomposition itself: below this, e is
-    # rounding garbage and 1/e would poison the step (see docstring)
-    efloor = _machine_eps() * M.shape[1] * jnp.maximum(e[-1], 0.0)
-    bad = (S <= threshold * S[-1]) | (e <= efloor)
-    einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
+    V, einv, n_bad = masked_eigh_inverse(Mn.T @ Mn, threshold, M.shape[0])
     y = Mn.T @ rw
     dpars = (V @ (einv * (V.T @ y))) / norms
     Sigma_n = (V * einv) @ V.T
-    return dpars, Sigma_n, norms, jnp.sum(bad)
+    return dpars, Sigma_n, norms, n_bad
+
+
+def masked_eigh_inverse(G, threshold, n_rows):
+    """Thresholded eigendecomposition of a unit-normalized normal matrix
+    ``G = Mn^T Mn``: the single source of the eigh kernel's degeneracy
+    semantics (relative singular-value cutoff + the normal-equations
+    noise floor — see :func:`fit_wls_eigh`), shared with the sharded
+    psum path (`pint_tpu.parallel`) so the two can never drift.  Returns
+    ``(V, einv, n_bad)`` with ``pinv(G) = (V * einv) @ V.T``."""
+    e, V = jnp.linalg.eigh(G)
+    S = jnp.sqrt(jnp.maximum(e, 0.0))
+    if threshold is None:
+        threshold = _machine_eps() * max(n_rows, G.shape[0])
+    # noise floor of the eigendecomposition itself: below this, e is
+    # rounding garbage and 1/e would poison the step
+    efloor = _machine_eps() * G.shape[0] * jnp.maximum(e[-1], 0.0)
+    bad = (S <= threshold * S[-1]) | (e <= efloor)
+    einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
+    return V, einv, jnp.sum(bad)
 
 
 def _default_wls_kernel():
