@@ -38,17 +38,24 @@ from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
 from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.residuals import Residuals, raw_phase_resids
 from pint_tpu.toabatch import TOABatch
-from pint_tpu.utils import normalize_designmatrix, woodbury_dot
+from pint_tpu.utils import (get_xp, normalize_designmatrix,
+                            woodbury_dot, woodbury_dot_split)
 
 
-def _machine_eps() -> float:
-    """Effective f64 epsilon of the active backend: TPU's emulated f64
-    carries ~48 mantissa bits, so degeneracy thresholds tuned to true
+def _machine_eps(xp=None) -> float:
+    """Effective f64 epsilon of wherever the SOLVE runs: TPU's emulated
+    f64 carries ~48 mantissa bits, so degeneracy thresholds tuned to true
     IEEE eps (2^-52) under-cut it and let near-singular directions leak
-    huge, chi2-flat parameter steps through the solve."""
+    huge, chi2-flat parameter steps through the solve.  Host-finished
+    solves (xp is numpy) are true-IEEE regardless of the backend — using
+    the device eps there would DROP legitimately deep directions (e.g.
+    B1855's OM-T0 pair) and collapse their uncertainties."""
     import jax as _jax
 
-    return 2.0 ** -48 if _jax.default_backend() != "cpu" else         float(jnp.finfo(jnp.float64).eps)
+    if xp is np:
+        return float(np.finfo(np.float64).eps)
+    return 2.0 ** -48 if _jax.default_backend() != "cpu" else \
+        float(jnp.finfo(jnp.float64).eps)
 
 __all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
            "DownhillGLSFitter", "PowellFitter", "LMFitter",
@@ -63,11 +70,12 @@ def _whiten_normalize(M, r_sec, sigma_sec):
     carries only the f32 exponent range (~1e±38), and a one-shot
     sum-of-squares norm overflows for stiff columns like F1.  Shared by
     the SVD and eigh kernels so the contract cannot drift between them.
-    Returns ``(Mn, rw, norms)``."""
+    Works on numpy and jax arrays.  Returns ``(Mn, rw, norms)``."""
+    xp = get_xp(M)
     Mw = M / sigma_sec[:, None]
     rw = r_sec / sigma_sec
-    cmax = jnp.max(jnp.abs(Mw), axis=0)
-    cmax = jnp.where(cmax == 0.0, 1.0, cmax)
+    cmax = xp.max(xp.abs(Mw), axis=0)
+    cmax = xp.where(cmax == 0.0, 1.0, cmax)
     Mc = Mw / cmax
     Mn, nc = normalize_designmatrix(Mc)
     return Mn, rw, cmax * nc
@@ -91,15 +99,16 @@ def fit_wls_svd(M, r_sec, sigma_sec, threshold: Optional[float] = None):
     (max-abs, then the norm of an O(1) matrix) instead of one
     sum-of-squares.
     """
+    xp = get_xp(M)
     Mn, rw, norms = _whiten_normalize(M, r_sec, sigma_sec)
-    U, S, Vt = jnp.linalg.svd(Mn, full_matrices=False)
+    U, S, Vt = xp.linalg.svd(Mn, full_matrices=False)
     if threshold is None:
-        threshold = _machine_eps() * max(M.shape)
+        threshold = _machine_eps(xp) * max(M.shape)
     bad = S <= threshold * S[0]
-    Sinv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, S))
+    Sinv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, S))
     dpars = (Vt.T @ (Sinv * (U.T @ rw))) / norms
     Sigma_n = (Vt.T * Sinv**2) @ Vt
-    return dpars, Sigma_n, norms, jnp.sum(bad)
+    return dpars, Sigma_n, norms, xp.sum(bad)
 
 
 def fit_wls_eigh(M, r_sec, sigma_sec, threshold: Optional[float] = None):
@@ -148,16 +157,38 @@ def masked_eigh_inverse(G, threshold, n_rows):
     noise floor — see :func:`fit_wls_eigh`), shared with the sharded
     psum path (`pint_tpu.parallel`) so the two can never drift.  Returns
     ``(V, einv, n_bad)`` with ``pinv(G) = (V * einv) @ V.T``."""
-    e, V = jnp.linalg.eigh(G)
-    S = jnp.sqrt(jnp.maximum(e, 0.0))
+    xp = get_xp(G)
+    e, V = xp.linalg.eigh(G)
+    S = xp.sqrt(xp.maximum(e, 0.0))
     if threshold is None:
-        threshold = _machine_eps() * max(n_rows, G.shape[0])
+        threshold = _machine_eps(xp) * max(n_rows, G.shape[0])
     # noise floor of the eigendecomposition itself: below this, e is
     # rounding garbage and 1/e would poison the step
-    efloor = _machine_eps() * G.shape[0] * jnp.maximum(e[-1], 0.0)
+    efloor = _machine_eps(xp) * G.shape[0] * xp.maximum(e[-1], 0.0)
     bad = (S <= threshold * S[-1]) | (e <= efloor)
-    einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
-    return V, einv, jnp.sum(bad)
+    einv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, e))
+    return V, einv, xp.sum(bad)
+
+
+def _eigh_xp(xp, A):
+    """eigh for the GLS solve.  For the host (numpy) path this calls the
+    XLA:CPU eigendecomposition EAGERLY rather than numpy's LAPACK: the
+    B1855-class GLS spectrum is knife-edge at the absolute degeneracy
+    cutoff (several physical eigenvalues within implementation-noise of
+    eps*P), and using a different eigh implementation than the
+    CPU-backend jitted path makes n_bad — and therefore the reported
+    deep-direction uncertainties — process-dependent.  Same kernel on
+    both paths = same knife-edge decisions."""
+    if xp is not np:
+        return xp.linalg.eigh(A)
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        e, V = jnp.linalg.eigh(jax.device_put(np.asarray(A), cpu))
+    return np.asarray(e), np.asarray(V)
+
+
+def _diag_xp(xp, v):
+    return xp.diag(v)
 
 
 def _default_wls_kernel():
@@ -328,7 +359,8 @@ def build_wideband_assembly(model: TimingModel, batch: TOABatch,
 def build_gls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
-                   include_offset: bool = True, assemble=None):
+                   include_offset: bool = True, assemble=None,
+                   assemble_builder=None):
     """The jitted GLS Gauss-Newton step ``(x, p) -> dict`` (reference
     `GLSFitter.fit_toas` basis path + `get_gls_mtcm_mtcy`,
     `/root/reference/src/pint/fitter.py:1841,2618`).
@@ -359,80 +391,172 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
         assemble = build_whitened_assembly(model, batch, names, track_mode,
                                            include_offset)
 
-    @jax.jit
-    def solve(r, M, sigma, offc, p):
-        U = model.noise_basis(p)
-        phi = model.noise_weights(p)
+    def _impl(xp, r, M, sigma, offc, U, phi, esl):
+        """The complete GLS linear solve + Woodbury chi2, xp-generic:
+        runs as one jitted program on the (true-IEEE) CPU backend and as
+        host numpy on accelerators — TPU's emulated-f64 dot products are
+        only ~f32-grade at NANOGrav row counts, which destroys the
+        small-eigenvalue structure parameter uncertainties are made of
+        (measured on B1855+09: DMX uncertainties collapse ~200x if the
+        Gram is formed on device).  With ``esl`` the ECORR block is
+        eliminated through its exactly-diagonal Gram (Schur complement),
+        so the eigendecomposition touches only timing+Fourier columns
+        (~150 instead of ~780 on B1855) and chi2 uses the matching
+        per-epoch Sherman-Morrison (`woodbury_dot_split`)."""
+        npar = len(names)
         if U is not None and U.shape[0] != r.shape[0]:
             # wideband: the noise basis covers only the TOA rows; the DM
-            # block is uncorrelated (reference pint_matrix.py:532 pads the
-            # same way when combining design matrices)
-            U = jnp.concatenate(
-                [U, jnp.zeros((r.shape[0] - U.shape[0], U.shape[1]))],
+            # block is uncorrelated (reference pint_matrix.py:532 pads
+            # the same way when combining design matrices)
+            U = xp.concatenate(
+                [U, xp.zeros((r.shape[0] - U.shape[0], U.shape[1]))],
                 axis=0)
         if phi is not None:
             # zero prior variance (e.g. a disabled red-noise amplitude)
             # would make phiinv infinite; floor it so those columns are
             # pinned to ~zero amplitude instead of poisoning the solve
             # (1e-30 keeps 1/phi inside TPU's emulated-f64 range)
-            phi = jnp.where(phi > 0.0, phi, 1e-30)
+            phi = xp.where(phi > 0.0, phi, 1e-30)
         ntm = M.shape[1]
-        Mfull = M if U is None else jnp.concatenate([M, U], axis=1)
-        Mw = Mfull / sigma[:, None]
-        rw = r / sigma
-        # two-stage range-safe column normalization (see fit_wls_svd)
-        cmax = jnp.max(jnp.abs(Mw), axis=0)
-        cmax = jnp.where(cmax == 0.0, 1.0, cmax)
-        Mc = Mw / cmax
-        Mn, nc = normalize_designmatrix(Mc)
-        norms = cmax * nc
-        phiinv = jnp.zeros(Mfull.shape[1]) if phi is None else \
-            jnp.concatenate([jnp.zeros(ntm), 1.0 / phi])
+        Mfull = M if U is None else xp.concatenate([M, U], axis=1)
+        P = Mfull.shape[1]
+        Mn, rw, norms = _whiten_normalize(Mfull, r, sigma)
+        phiinv = xp.zeros(P) if phi is None else \
+            xp.concatenate([xp.zeros(ntm), 1.0 / phi])
         # (sqrt(phiinv)/norms)^2, NOT phiinv/norms^2: timing-column norms
         # can exceed 1e19 and norms**2 leaves the emulated-f64 exponent
         # range on TPU (the squared form stays bounded for every column)
-        A = Mn.T @ Mn + jnp.diag((jnp.sqrt(phiinv) / norms) ** 2)
-        e, V = jnp.linalg.eigh(A)
-        thr = _machine_eps() * A.shape[0] \
-            if threshold is None else threshold
-        # ABSOLUTE threshold in the normalized coordinates (timing columns
-        # have unit norm, so data-driven eigenvalues are O(ncols) and true
-        # degeneracies sit at rounding level).  A threshold relative to
-        # e[-1] breaks when a strong noise prior dominates: 1/phi for a
-        # tightly-pinned basis mode inflates e[-1] by many orders and the
-        # cutoff then swallows legitimately small timing eigenvalues —
-        # seen on B1855+09, where the deep (1 - rho^2 ~ 1e-10) OM-T0
-        # degeneracy was dropped, collapsing both uncertainties ~1e5x
-        # below tempo2's.
-        bad = e <= thr
-        einv = jnp.where(bad, 0.0, 1.0 / jnp.where(bad, 1.0, e))
-        y = V @ (einv * (V.T @ (Mn.T @ rw)))
-        sol = y / norms
-        Sigma_n = (V * einv) @ V.T
+        prior = (xp.sqrt(phiinv) / norms) ** 2
+        thr = _machine_eps(xp) * P if threshold is None else threshold
+        # ABSOLUTE threshold in the normalized coordinates (timing
+        # columns have unit norm, so data-driven eigenvalues are O(ncols)
+        # and true degeneracies sit at rounding level).  A threshold
+        # relative to e[-1] breaks when a strong noise prior dominates:
+        # 1/phi for a tightly-pinned basis mode inflates e[-1] by many
+        # orders and the cutoff then swallows legitimately small timing
+        # eigenvalues — seen on B1855+09, where the deep
+        # (1 - rho^2 ~ 1e-10) OM-T0 degeneracy was dropped, collapsing
+        # both uncertainties ~1e5x below tempo2's.
+        if esl is None:
+            A = Mn.T @ Mn + _diag_xp(xp, prior)
+            e, V = _eigh_xp(xp, A)
+            bad = e <= thr
+            einv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, e))
+            sol = (V @ (einv * (V.T @ (Mn.T @ rw)))) / norms
+            Sigma_n = (V * einv) @ V.T
+        else:
+            dlo, dhi = ntm + esl[0], ntm + esl[1]
+            kidx = np.concatenate([np.arange(dlo), np.arange(dhi, P)])
+            didx = np.arange(dlo, dhi)
+            K = Mn[:, kidx]
+            D = Mn[:, didx]
+            b_K = K.T @ rw
+            b_D = D.T @ rw
+            # D's Gram block is exactly diagonal (disjoint supports);
+            # unit column normalization makes the diagonal 1
+            d_D = 1.0 + prior[didx]
+            G_KD = K.T @ D
+            S = K.T @ K + _diag_xp(xp, prior[kidx]) \
+                - (G_KD / d_D[None, :]) @ G_KD.T
+            e, V = _eigh_xp(xp, S)
+            bad = e <= thr
+            einv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, e))
+            sol_K = V @ (einv * (V.T @ (b_K - G_KD @ (b_D / d_D))))
+            sol_D = (b_D - G_KD.T @ sol_K) / d_D
+            if xp is np:
+                sol = np.zeros(P)
+                sol[kidx] = sol_K
+                sol[didx] = sol_D
+                sol = sol / norms
+            else:
+                sol = jnp.zeros(P).at[kidx].set(sol_K) \
+                    .at[didx].set(sol_D) / norms
+            # (A^-1)_KK is exactly the Schur-complement inverse, and the
+            # timing columns are the first npar entries of K
+            Sigma_n = (V * einv) @ V.T
         # chi2 at x, offset profiled out in the C^-1 metric (over the
         # offc regressor — ones on TOA rows, zeros on wideband DM rows)
-        off = jnp.float64(0.0)
+        off = xp.float64(0.0)
         if phi is None:
             if offc is not None:
                 w = offc / sigma**2
-                off = jnp.sum(r * w) / jnp.sum(w * offc)
-            chi2 = jnp.sum(((r - off * offc if offc is not None else r)
-                            / sigma) ** 2)
+                off = xp.sum(r * w) / xp.sum(w * offc)
+            chi2 = xp.sum(((r - off * offc if offc is not None else r)
+                           / sigma) ** 2)
         else:
+            if esl is None:
+                def cdot(a, b):
+                    return woodbury_dot(sigma**2, U, phi, a, b)[0]
+            else:
+                Ue = U[:, esl[0]:esl[1]]
+                phie = phi[esl[0]:esl[1]]
+                Uf = xp.concatenate([U[:, :esl[0]], U[:, esl[1]:]],
+                                    axis=1)
+                phif = xp.concatenate([phi[:esl[0]], phi[esl[1]:]])
+
+                def cdot(a, b):
+                    return woodbury_dot_split(sigma**2, Ue, phie,
+                                              Uf, phif, a, b)[0]
             if offc is not None:
-                d11, _ = woodbury_dot(sigma**2, U, phi, offc, offc)
-                d1r, _ = woodbury_dot(sigma**2, U, phi, offc, r)
-                off = d1r / d11
+                off = cdot(offc, r) / cdot(offc, offc)
             r_off = r - off * offc if offc is not None else r
-            chi2, _ = woodbury_dot(sigma**2, U, phi, r_off, r_off)
+            chi2 = cdot(r_off, r_off)
         return {"dx": sol[:npar], "offset": off, "chi2": chi2,
                 "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
                 "noise_ampls": sol[ntm:], "resid_sec": r,
-                "n_bad": jnp.sum(bad)}
+                "n_bad": xp.sum(bad)}
 
-    def step(x, p):
-        r, M, sigma, offc = assemble(x, p)
-        return solve(r, M, sigma, offc, p)
+    def make_solve(esl):
+        if jax.default_backend() == "cpu":
+            @jax.jit
+            def solve(r, M, sigma, offc, p):
+                return _impl(jnp, r, M, sigma, offc,
+                             model.noise_basis(p), model.noise_weights(p),
+                             esl)
+
+            return solve
+
+        cache: dict = {}
+
+        def solve(r, M, sigma, offc, p):
+            r_h, M_h, s_h, offc_h = _fetch_host(r, M, sigma, offc)
+            if "U" not in cache:  # static across steps of one fit
+                U = model.noise_basis(p)
+                cache["U"] = None if U is None else \
+                    np.asarray(U, np.float64)
+            phi = model.noise_weights(p)
+            phi_h = None if phi is None else np.asarray(phi, np.float64)
+            return _impl(np, r_h, M_h, s_h, offc_h, cache["U"], phi_h,
+                         esl)
+
+        return solve
+
+    _assemble_exact = _exact_assemble_factory(
+        batch,
+        assemble_builder if assemble_builder is not None else
+        (lambda b: build_whitened_assembly(model, b, names, track_mode,
+                                           include_offset)))
+
+    def _host_step(x, p, exact, assemble_fn, solve_fn):
+        if exact:
+            r, M, sigma, offc = _assemble_exact(x, p)
+        else:
+            r, M, sigma, offc = assemble_fn(x, p)
+        return solve_fn(r, M, sigma, offc, p)
+
+    solve_cache: dict = {}
+
+    def step(x, p, exact=False):
+        esl = solve_cache.get("esl", ...)
+        if esl is ...:
+            esl = solve_cache["esl"] = model.ecorr_block(p)
+        solve = solve_cache.get(esl)
+        if solve is None:
+            solve = solve_cache[esl] = make_solve(esl)
+        if jax.default_backend() == "cpu":
+            r, M, sigma, offc = assemble(x, p)
+            return solve(r, M, sigma, offc, p)
+        return _host_step(x, p, exact, assemble, solve)
 
     return step
 
@@ -508,18 +632,61 @@ def build_gls_fullcov_step(model: TimingModel, batch: TOABatch,
                 "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
                 "resid_sec": r, "n_bad": jnp.sum(bad)}
 
-    def step(x, p):
+    def step(x, p, exact=False):
+        # exact is accepted for interface parity but moot: the dense
+        # full-cov path is CPU-only by construction (see docstring)
         r, M, sigma, offc = assemble(x, p)
         return solve(r, M, sigma, offc, p)
 
     return step
 
 
+def _fetch_host(r, M, sigma, offc):
+    """ONE batched device->host transfer of a whitened assembly (a
+    per-array fetch pays a full tunnel round trip each)."""
+    parts = [jnp.ravel(r), jnp.ravel(M), jnp.ravel(sigma)]
+    if offc is not None:
+        parts.append(jnp.ravel(offc))
+    flat = np.asarray(jnp.concatenate(parts))
+    n = r.shape[0]
+    r_h = flat[:n]
+    M_h = flat[n:n + M.size].reshape(M.shape)
+    s_h = flat[n + M.size:n + M.size + n]
+    offc_h = None if offc is None else flat[n + M.size + n:]
+    return r_h, M_h, s_h, offc_h
+
+
+def _exact_assemble_factory(batch, default_builder):
+    """Final-covariance assembly on the in-process CPU backend: the
+    accelerator-assembled design matrix carries ~1e-11 relative noise
+    (emulated-f64 pipeline), ABOVE the deepest physical eigenvalues of
+    NANOGrav normal matrices (~1e-13 normalized) — uncertainties of
+    deeply-correlated pairs would be garbage.  Iteration steps stay on
+    the accelerator (dx noise just iterates away); only the one final
+    pass pays the CPU cost.  Everything — the captured batch AND the
+    builder's own constants — must be created inside the CPU context:
+    accelerator-committed captures silently override
+    ``default_device(cpu)``."""
+    cache: dict = {}
+
+    def assemble_exact(x, p):
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            if "a" not in cache:
+                batch_np = jax.tree_util.tree_map(np.asarray, batch)
+                cache["a"] = default_builder(batch_np)
+            x_np = np.asarray(x)
+            p_np = jax.tree_util.tree_map(np.asarray, p)
+            return cache["a"](x_np, p_np)
+
+    return assemble_exact
+
+
 def build_wls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
                    include_offset: bool = True, assemble=None,
-                   kernel=None):
+                   kernel=None, host_finish=None):
     """The jitted Gauss-Newton step ``(x, p) -> dict`` for a frozen model
     structure.
 
@@ -540,29 +707,60 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
     if assemble is None:
         assemble = build_whitened_assembly(model, batch, names, track_mode,
                                            include_offset)
-    if kernel is None:
-        kernel = _default_wls_kernel()
+    if host_finish is None:
+        host_finish = jax.default_backend() != "cpu"
 
-    @jax.jit
-    def solve(r, M, sigma, offc):
-        dpars, Sigma_n, norms, n_bad = kernel(M, r, sigma, threshold)
+    def _solve(xp, r, M, sigma, offc, kern):
+        dpars, Sigma_n, norms, n_bad = kern(M, r, sigma, threshold)
         # chi2 at x with the offset profiled out (the linear best fit of
         # the offc regressor — ones on TOA rows, zeros on wideband DM rows
         # — to the current residuals)
         if offc is not None:
             w = offc / sigma**2
-            off = jnp.sum(r * w) / jnp.sum(w * offc)
+            off = xp.sum(r * w) / xp.sum(w * offc)
             r_off = r - off * offc
         else:
-            off = jnp.float64(0.0)
+            off = xp.float64(0.0)
             r_off = r
-        chi2 = jnp.sum((r_off / sigma) ** 2)
+        chi2 = xp.sum((r_off / sigma) ** 2)
         npar = len(names)
         return {"dx": dpars[:npar], "offset": off, "chi2": chi2,
                 "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
                 "resid_sec": r, "n_bad": n_bad}
 
-    def step(x, p):
+    if host_finish:
+        # accelerator fit path: the device computes the physics
+        # (residuals + jacfwd — the part TPU accelerates ~500x) and the
+        # SOLVE runs on the host in true-IEEE f64 with the reference's
+        # exact SVD recipe.  This is a PRECISION decision, not a
+        # performance one: the TPU's emulated-f64 dot products are only
+        # ~f32-grade (measured 4e-7..4e-4 absolute error on
+        # unit-normalized Grams at NANOGrav row counts), which destroys
+        # the small-eigenvalue structure that parameter uncertainties
+        # are made of.  Grids/ensembles (vmapped, chi2-oriented) keep
+        # the all-device kernels via host_finish=False.
+        assemble_exact = _exact_assemble_factory(
+            batch, lambda b: build_whitened_assembly(
+                model, b, names, track_mode, include_offset))
+        host_kernel = fit_wls_svd if kernel is None else kernel
+
+        def step(x, p, exact=False):
+            if exact:
+                r, M, sigma, offc = assemble_exact(x, p)
+            else:
+                r, M, sigma, offc = assemble(x, p)
+            r_h, M_h, s_h, offc_h = _fetch_host(r, M, sigma, offc)
+            return _solve(np, r_h, M_h, s_h, offc_h, host_kernel)
+
+        return step
+
+    kern = _default_wls_kernel() if kernel is None else kernel
+
+    @jax.jit
+    def solve(r, M, sigma, offc):
+        return _solve(jnp, r, M, sigma, offc, kern)
+
+    def step(x, p, exact=False):
         r, M, sigma, offc = assemble(x, p)
         return solve(r, M, sigma, offc)
 
@@ -856,7 +1054,7 @@ class WLSFitter(Fitter):
                 break
             prev_chi2 = chi2
         # final chi2 at the converged x
-        final = step(jnp.asarray(x), p)
+        final = step(jnp.asarray(x), p, exact=True)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
         self._store_noise(final, p)
         self._finalize(p, x, Sigma, names)
@@ -1046,9 +1244,14 @@ class DownhillWLSFitter(Fitter):
             if lam == 1.0 and improvement < required_chi2_decrease:
                 converged = True
                 break
-        self._store_noise(out, p)
-        self._finalize(p, x, denormalize_covariance(out["Sigma_n"],
-                                                    out["norms"]), names)
+        # final covariance from an exact (CPU-assembled, host-solved)
+        # re-evaluation at the solution: the iteration steps' device
+        # assemblies carry ~1e-11 relative noise, above the deepest
+        # physical eigenvalues (see build_wls_step)
+        final = step(jnp.asarray(x), p, exact=True)
+        self._store_noise(final, p)
+        self._finalize(p, x, denormalize_covariance(final["Sigma_n"],
+                                                    final["norms"]), names)
         self.fitresult = FitSummary(chi2, self.resids.dof, it + 1, converged)
         if exception is not None and not converged:
             warnings.warn(str(exception))
@@ -1095,7 +1298,7 @@ class PowellFitter(Fitter):
                        options={"maxiter": maxiter, "xtol": 1e-10,
                                 "ftol": 1e-12})
         x = res.x * scale
-        final = step(jnp.asarray(x), p)
+        final = step(jnp.asarray(x), p, exact=True)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
         self._store_noise(final, p)
         self._finalize(p, x, Sigma, names)
@@ -1188,7 +1391,7 @@ class LMFitter(Fitter):
                     break
         # covariance from the undamped step at the solution
         step = self._cached_step(names, threshold, include_offset)
-        final = step(jnp.asarray(x), p)
+        final = step(jnp.asarray(x), p, exact=True)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
         self._store_noise(final, p)
         self._finalize(p, x, Sigma, names)
@@ -1218,14 +1421,22 @@ class WidebandTOAFitter(GLSFitter):
 
     def _make_step(self, names, threshold, include_offset):
         wb = self.resids
-        assemble = build_wideband_assembly(
-            self.model, wb.batch, wb.dm_index, wb.dm_data, wb.dm_error,
-            names, self.track_mode, include_offset)
-        build = build_gls_fullcov_step if self.full_cov else build_gls_step
-        return build(self.model, wb.batch, names,
-                     self.track_mode, threshold=threshold,
-                     include_offset=include_offset,
-                     assemble=assemble)
+
+        def builder(batch):
+            return build_wideband_assembly(
+                self.model, batch, wb.dm_index, wb.dm_data, wb.dm_error,
+                names, self.track_mode, include_offset)
+
+        if self.full_cov:
+            return build_gls_fullcov_step(
+                self.model, wb.batch, names, self.track_mode,
+                threshold=threshold, include_offset=include_offset,
+                assemble=builder(wb.batch))
+        return build_gls_step(self.model, wb.batch, names,
+                              self.track_mode, threshold=threshold,
+                              include_offset=include_offset,
+                              assemble=builder(wb.batch),
+                              assemble_builder=builder)
 
     def get_designmatrix(self):
         """(M, names): the *combined* TOA+DM design matrix — TOA rows in

@@ -74,8 +74,11 @@ def build_grid_fit_fn(model: TimingModel, batch, fit_params: Sequence[str],
     """``fit_one(p) -> (chi2, x)``: a full (fixed-iteration) WLS fit of one
     pytree — vmap/shard_map this over stacked grid pytrees.  ``kernel``
     forces a specific WLS solve kernel (default: backend-matched)."""
+    # host_finish=False: the grid is one vmapped XLA program; the
+    # all-device eigh kernel is right for chi2 maps (see build_wls_step)
     step = build_wls_step(model, batch, fit_params, track_mode,
-                          threshold=threshold, kernel=kernel)
+                          threshold=threshold, kernel=kernel,
+                          host_finish=False)
 
     def fit_one(p):
         x = jnp.zeros(len(fit_params))

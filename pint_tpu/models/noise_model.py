@@ -250,6 +250,11 @@ class EcorrNoise(NoiseComponent):
     register = True
     category = "ecorr_noise"
     introduces_correlated_errors = True
+    #: the quantization basis has disjoint 0/1 columns, so its Gram
+    #: matrix is exactly diagonal — the GLS solve eliminates the block in
+    #: closed form (fitter.build_gls_step) and chi2 uses the per-epoch
+    #: Sherman-Morrison (utils.woodbury_dot_split)
+    diag_gram = True
 
     def __init__(self):
         super().__init__()
@@ -324,9 +329,17 @@ class EcorrNoise(NoiseComponent):
 def powerlaw_psd(f, amp, gamma):
     """Power-law PSD in timing-residual units (reference `powerlaw`,
     `/root/reference/src/pint/models/noise_model.py:1370`):
-    P(f) = A^2/(12 pi^2) fyr^(gamma-3) f^(-gamma)."""
-    return amp**2 / (12.0 * math.pi**2) * FYR ** (gamma - 3.0) \
-        * f ** (-gamma)
+    P(f) = A^2/(12 pi^2) fyr^(gamma-3) f^(-gamma).
+
+    Evaluated in LOG space: the direct form's intermediate ``f**-gamma``
+    reaches ~1e37 for PTA-band frequencies (f ~ 3e-9 Hz, gamma ~ 4.4),
+    which overflows TPU's emulated f64 (f32 exponent range, max ~3.4e38)
+    — on device the red-noise prior weights came back NaN, silently
+    pinning every red-noise mode to zero amplitude in GLS solves.  The
+    final value (~1e-12 s^2-class) is comfortably in range."""
+    log_psd = (2.0 * jnp.log(amp) - math.log(12.0 * math.pi**2)
+               + (gamma - 3.0) * math.log(FYR) - gamma * jnp.log(f))
+    return jnp.exp(log_psd)
 
 
 class PLRedNoise(NoiseComponent):

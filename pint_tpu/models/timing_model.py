@@ -518,11 +518,32 @@ class TimingModel:
     def apply_deltas(self, p: dict):
         """Fold the (post-fit) offsets back into the host parameters and
         zero them.  Host f64 arithmetic is exact at offset scales."""
+        import jax
+
+        # ONE batched device->host fetch of every delta leaf: a per-leaf
+        # np.asarray pays a full round trip PER PARAMETER, which over a
+        # networked TPU (~100 ms each) turned a 313-TOA wideband fit's
+        # bookkeeping into 44 s of pure transfer latency
+        delta = p["delta"]
+        jkeys = [k for k, v in delta.items() if isinstance(v, jax.Array)]
+        host_delta = {}
+        if jkeys:
+            parts = [jnp.ravel(jnp.asarray(delta[k], jnp.float64))
+                     for k in jkeys]
+            sizes = [int(v.size) for v in parts]
+            packed = np.asarray(jnp.concatenate(parts))
+            off = 0
+            for k, s in zip(jkeys, sizes):
+                host_delta[k] = packed[off:off + s].reshape(
+                    np.shape(delta[k]))
+                off += s
         for c in self.components.values():
             for par in c.params.values():
                 if not (par.on_device and par.name in p["delta"]):
                     continue
-                d = np.asarray(p["delta"][par.name], np.float64)
+                d = host_delta.get(par.name)
+                if d is None:
+                    d = np.asarray(p["delta"][par.name], np.float64)
                 if not np.any(d):
                     continue
                 if isinstance(par, MJDParam):
@@ -616,6 +637,27 @@ class TimingModel:
         ws = [c.noise_weights(p) for c in self.correlated_noise_components
               if c.basis_pytree_name in p["const"]]
         return jnp.concatenate(ws) if ws else None
+
+    def ecorr_block(self, p: dict):
+        """(lo, hi) column range of a verified-disjoint ECORR block within
+        ``noise_basis(p)``, or None.  Host-side (reads the basis to
+        numpy); disjointness — every TOA in at most one quantization
+        epoch — is what makes the block's Gram matrix exactly diagonal,
+        so GLS solves can eliminate it in closed form and chi2 can use
+        the per-epoch Sherman-Morrison (`utils.woodbury_dot_split`)."""
+        sl = None
+        off = 0
+        for c in self.correlated_noise_components:
+            nm = c.basis_pytree_name
+            if nm not in p["const"]:
+                continue
+            Ub = np.asarray(p["const"][nm])
+            w = Ub.shape[1]
+            if (getattr(c, "diag_gram", False) and w and sl is None
+                    and int(np.max(np.sum(Ub != 0.0, axis=1))) <= 1):
+                sl = (off, off + w)
+            off += w
+        return sl
 
     def scaled_dm_uncertainty(self, p: dict, batch: TOABatch, dm_error):
         """Per-TOA wideband DM uncertainties [pc cm^-3] after DMEFAC/DMEQUAD

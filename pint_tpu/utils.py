@@ -130,6 +130,56 @@ def woodbury_dot(Ndiag, U, Phidiag, x, y):
     return dot, logdet
 
 
+def ecorr_ninv_apply(Ndiag, Ue, phie, X):
+    """``(diag(N) + Ue diag(phie) Ue^T)^-1 X`` for DISJOINT 0/1 indicator
+    columns ``Ue`` (the ECORR quantization basis): the Sherman-Morrison
+    update per column is exact and independent, so the inverse applies as
+    two matmuls — no factorization of any kind.  ``X`` may be a vector or
+    an (N, m) matrix.  This is the structural fact the reference exploits
+    in `_calc_ecorr_chi2` (`/root/reference/src/pint/residuals.py:670`);
+    here it also eliminates the ECORR block from the GLS normal matrix
+    (`pint_tpu.fitter.build_gls_step`), which on TPU turns an
+    O((ntiming+necorr+nfourier)^3) eigendecomposition into an
+    O((ntiming+nfourier)^3) one."""
+    xp = _xp(Ndiag)
+    vec = X.ndim == 1
+    Xm = X[:, None] if vec else X
+    Ninv_X = Xm / Ndiag[:, None]
+    s = xp.sum((Ue * Ue).T / Ndiag, axis=1)          # (k,)
+    coef = phie / (1.0 + phie * s)                   # (k,)
+    NinvUe = Ue / Ndiag[:, None]
+    out = Ninv_X - NinvUe @ (coef[:, None] * (Ue.T @ Ninv_X))
+    return out[:, 0] if vec else out
+
+
+def woodbury_dot_split(Ndiag, Ue, phie, Uf, phif, x, y):
+    """``x^T C^-1 y`` and ``logdet C`` for
+    ``C = diag(N) + Ue diag(phie) Ue^T + Uf diag(phif) Uf^T``
+    where ``Ue`` is the disjoint ECORR quantization block (eliminated in
+    closed form by :func:`ecorr_ninv_apply`) and ``Uf`` the dense
+    correlated bases (Fourier red/DM/chrom/SW) — so the only
+    factorization is a Cholesky of the SMALL (nfourier, nfourier) inner
+    matrix instead of the full basis.  Equal to :func:`woodbury_dot` with
+    ``U = [Ue | Uf]`` (tests `test_gls.py::TestWoodburySplit`)."""
+    xp = _xp(Ndiag)
+    Cinv_y = ecorr_ninv_apply(Ndiag, Ue, phie, y)
+    s = xp.sum((Ue * Ue).T / Ndiag, axis=1)
+    logdet = xp.sum(xp.log(Ndiag)) + xp.sum(xp.log1p(phie * s))
+    dot = xp.sum(x * Cinv_y)
+    if Uf.shape[1] == 0:
+        return dot, logdet
+    Cinv_x = ecorr_ninv_apply(Ndiag, Ue, phie, x)
+    CinvUf = ecorr_ninv_apply(Ndiag, Ue, phie, Uf)
+    Sigma = Uf.T @ CinvUf + _diag(xp, 1.0 / phif)
+    cf = _cho_factor(xp, Sigma)
+    a = Uf.T @ Cinv_x
+    b = Uf.T @ Cinv_y
+    dot = dot - a @ _cho_solve(xp, cf, b)
+    logdet = logdet + xp.sum(xp.log(phif)) \
+        + 2.0 * xp.sum(xp.log(_diag_of(xp, cf)))
+    return dot, logdet
+
+
 _xp = get_xp
 
 
