@@ -181,14 +181,13 @@ def _eigh_xp(xp, A):
     both paths = same knife-edge decisions."""
     if xp is not np:
         return xp.linalg.eigh(A)
-    cpu = jax.local_devices(backend="cpu")[0]
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:  # JAX_PLATFORMS excludes cpu
+        return np.linalg.eigh(np.asarray(A, np.float64))
     with jax.default_device(cpu):
         e, V = jnp.linalg.eigh(jax.device_put(np.asarray(A), cpu))
     return np.asarray(e), np.asarray(V)
-
-
-def _diag_xp(xp, v):
-    return xp.diag(v)
 
 
 def _default_wls_kernel():
@@ -438,7 +437,7 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
         # (1 - rho^2 ~ 1e-10) OM-T0 degeneracy was dropped, collapsing
         # both uncertainties ~1e5x below tempo2's.
         if esl is None:
-            A = Mn.T @ Mn + _diag_xp(xp, prior)
+            A = Mn.T @ Mn + xp.diag(prior)
             e, V = _eigh_xp(xp, A)
             bad = e <= thr
             einv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, e))
@@ -456,7 +455,7 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
             # unit column normalization makes the diagonal 1
             d_D = 1.0 + prior[didx]
             G_KD = K.T @ D
-            S = K.T @ K + _diag_xp(xp, prior[kidx]) \
+            S = K.T @ K + xp.diag(prior[kidx]) \
                 - (G_KD / d_D[None, :]) @ G_KD.T
             e, V = _eigh_xp(xp, S)
             bad = e <= thr
@@ -538,10 +537,10 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
                                            include_offset)))
 
     def _host_step(x, p, exact, assemble_fn, solve_fn):
-        if exact:
-            r, M, sigma, offc = _assemble_exact(x, p)
-        else:
-            r, M, sigma, offc = assemble_fn(x, p)
+        out = _assemble_exact(x, p) if exact else None
+        if out is None:
+            out = assemble_fn(x, p)
+        r, M, sigma, offc = out
         return solve_fn(r, M, sigma, offc, p)
 
     solve_cache: dict = {}
@@ -643,7 +642,13 @@ def build_gls_fullcov_step(model: TimingModel, batch: TOABatch,
 
 def _fetch_host(r, M, sigma, offc):
     """ONE batched device->host transfer of a whitened assembly (a
-    per-array fetch pays a full tunnel round trip each)."""
+    per-array fetch pays a full tunnel round trip each).  Arrays that
+    already live on the host or the CPU backend (the exact-assembly
+    path) convert directly — no accelerator round trip."""
+    plat = getattr(getattr(M, "device", None), "platform", None)
+    if isinstance(M, np.ndarray) or plat == "cpu":
+        return (np.asarray(r), np.asarray(M), np.asarray(sigma),
+                None if offc is None else np.asarray(offc))
     parts = [jnp.ravel(r), jnp.ravel(M), jnp.ravel(sigma)]
     if offc is not None:
         parts.append(jnp.ravel(offc))
@@ -670,7 +675,19 @@ def _exact_assemble_factory(batch, default_builder):
     cache: dict = {}
 
     def assemble_exact(x, p):
-        cpu = jax.local_devices(backend="cpu")[0]
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # JAX_PLATFORMS excludes cpu entirely
+            if "warned" not in cache:
+                cache["warned"] = True
+                warnings.warn(
+                    "no cpu backend available (JAX_PLATFORMS excludes "
+                    "cpu): final covariance uses the accelerator-"
+                    "assembled design matrix, whose ~1e-11 noise can "
+                    "inflate/collapse deeply-correlated uncertainties; "
+                    "run with JAX_PLATFORMS=<accel>,cpu for exact "
+                    "covariances")
+            return None
         with jax.default_device(cpu):
             if "a" not in cache:
                 batch_np = jax.tree_util.tree_map(np.asarray, batch)
@@ -745,10 +762,10 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
         host_kernel = fit_wls_svd if kernel is None else kernel
 
         def step(x, p, exact=False):
-            if exact:
-                r, M, sigma, offc = assemble_exact(x, p)
-            else:
-                r, M, sigma, offc = assemble(x, p)
+            out = assemble_exact(x, p) if exact else None
+            if out is None:
+                out = assemble(x, p)
+            r, M, sigma, offc = out
             r_h, M_h, s_h, offc_h = _fetch_host(r, M, sigma, offc)
             return _solve(np, r_h, M_h, s_h, offc_h, host_kernel)
 
