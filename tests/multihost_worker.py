@@ -1,9 +1,10 @@
 """SPMD worker for tests/test_multihost.py — one OS process per 'host'.
 
 Every worker builds the identical tiny problem, joins the distributed
-runtime, runs the multi-process grid fit, and process 0 prints the chi2
-vector as JSON for the parent to compare against the single-process
-path."""
+runtime, runs the multi-process grid fit, and process 0 writes the chi2
+vector as JSON to the path in argv[5] (a file, because the Gloo/absl
+runtime logs to stdout from other threads) for the parent to compare
+against the single-process path."""
 
 import json
 import sys
@@ -15,6 +16,7 @@ warnings.filterwarnings("ignore")
 def main():
     coord, pid, nproc, nlocal = (sys.argv[1], int(sys.argv[2]),
                                  int(sys.argv[3]), int(sys.argv[4]))
+    out_path = sys.argv[5] if len(sys.argv) > 5 else None
     from pint_tpu import multihost
 
     multihost.init(coordinator=coord, num_processes=nproc, process_id=pid,
@@ -37,8 +39,15 @@ def main():
     chi2 = multihost.multihost_grid_chisq(fitter, grid, mesh=mesh,
                                           maxiter=2)
     if pid == 0:
-        print("@@CHI2@@" + json.dumps([float(c) for c in chi2]),
-              flush=True)
+        payload = json.dumps([float(c) for c in chi2])
+        if out_path:
+            # a file, not stdout: the Gloo/absl runtime logs to stdout
+            # from other threads and can interleave with (and corrupt)
+            # a printed result line
+            with open(out_path, "w") as fh:
+                fh.write(payload)
+        else:
+            print("@@CHI2@@" + payload, flush=True)
 
 
 if __name__ == "__main__":

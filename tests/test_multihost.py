@@ -25,7 +25,7 @@ def _free_port():
     return port
 
 
-def test_two_process_grid_matches_single_process():
+def test_two_process_grid_matches_single_process(tmp_path):
     nproc, nlocal = 2, 2
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -33,8 +33,10 @@ def test_two_process_grid_matches_single_process():
         os.path.abspath(__file__))) + ":" + env.get("PYTHONPATH", "")
     worker = os.path.join(os.path.dirname(__file__),
                           "multihost_worker.py")
+    out_path = str(tmp_path / "chi2.json")
     procs = [subprocess.Popen(
-        [sys.executable, worker, coord, str(i), str(nproc), str(nlocal)],
+        [sys.executable, worker, coord, str(i), str(nproc), str(nlocal),
+         out_path],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True) for i in range(nproc)]
     try:
@@ -46,10 +48,10 @@ def test_two_process_grid_matches_single_process():
                 p.wait()
     for p, (so, se) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{se[-2000:]}"
-    lines = [ln for ln in outs[0][0].splitlines()
-             if ln.startswith("@@CHI2@@")]
-    assert lines, f"no chi2 output: {outs[0][0][-500:]}"
-    chi2_mp = np.array(json.loads(lines[0][len("@@CHI2@@"):]))
+    assert os.path.isfile(out_path), \
+        f"worker 0 wrote no result; stdout tail: {outs[0][0][-500:]}"
+    with open(out_path) as fh:
+        chi2_mp = np.array(json.loads(fh.read()))
 
     # single-process reference: the same problem on this process's own
     # (2, 2) virtual mesh
