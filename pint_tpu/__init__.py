@@ -23,12 +23,33 @@ Physical constants follow the reference's choices
 (`src/pint/__init__.py:56-106`): IAU/tempo conventions.
 """
 
+import os as _os
+
 import jax
 
 # Pulsar timing is meaningless in float32: absolute phase needs ~21 significant
 # digits (handled by double-double on top of f64). Enable x64 before anything
 # else in the package builds jitted functions.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the heavyweight fit programs (a wideband
+# GLS step compiles for ~3 min cold) are identical across processes, so every
+# pytest run / CLI invocation / bench subprocess should pay the compile once
+# per machine, not once per process.  PINT_TPU_XLA_CACHE=0 disables; =1 (or
+# unset) uses the default ~/.cache location; any other value is the cache
+# directory.  An explicit JAX_COMPILATION_CACHE_DIR (or a prior programmatic
+# setting) wins.
+_cache_flag = _os.environ.get("PINT_TPU_XLA_CACHE", "1")
+if _cache_flag != "0":
+    if jax.config.jax_compilation_cache_dir is None:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            _os.path.expanduser(
+                _cache_flag if _cache_flag not in ("", "1") else
+                "~/.cache/pint_tpu/xla"))
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in _os.environ:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
 
 __version__ = "0.1.0"
 

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import profiling
 from pint_tpu.exceptions import ConvergenceFailure, DegeneracyWarning
 from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.residuals import Residuals, raw_phase_resids
@@ -60,8 +61,9 @@ def _machine_eps(xp=None) -> float:
 __all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
            "DownhillGLSFitter", "PowellFitter", "LMFitter",
            "WidebandTOAFitter", "WidebandDownhillFitter", "WidebandLMFitter",
-           "fit_wls_svd", "fit_wls_eigh",
-           "build_wls_step", "build_gls_step", "build_gls_fullcov_step"]
+           "fit_wls_svd", "fit_wls_eigh", "wls_solve", "gls_solve",
+           "build_wls_step", "build_gls_step", "build_gls_fullcov_step",
+           "build_fused_fit"]
 
 
 def _whiten_normalize(M, r_sec, sigma_sec):
@@ -240,7 +242,7 @@ def build_whitened_assembly(model: TimingModel, batch: TOABatch,
     primal_j = jax.jit(primal)
     jac_j = jax.jit(jax.jacfwd(resid_sec))
 
-    def assemble(x, p):
+    def assemble_inline(x, p):
         r, sigma = primal_j(x, p)
         M = -jac_j(x, p)
         offc = None
@@ -249,6 +251,17 @@ def build_whitened_assembly(model: TimingModel, batch: TOABatch,
             M = jnp.concatenate([M, -offc[:, None]], axis=1)
         return r, M, sigma, offc
 
+    def assemble(x, p):
+        with profiling.stage("assemble_device"):
+            profiling.count("jit_call", 2)
+            out = assemble_inline(x, p)
+            if profiling.enabled():
+                jax.block_until_ready([a for a in out if a is not None])
+        return out
+
+    # trace-safe variant for fused whole-fit programs (no profiling
+    # hooks, no block_until_ready on tracers)
+    assemble.inline = assemble_inline
     return assemble
 
 
@@ -342,7 +355,7 @@ def build_wideband_assembly(model: TimingModel, batch: TOABatch,
     primal_j = jax.jit(primal)
     jac_j = jax.jit(jax.jacfwd(combined))
 
-    def assemble(x, p):
+    def assemble_inline(x, p):
         r, sigma = primal_j(x, p)
         M = -jac_j(x, p)
         offc = None
@@ -352,6 +365,15 @@ def build_wideband_assembly(model: TimingModel, batch: TOABatch,
             M = jnp.concatenate([M, -offc[:, None]], axis=1)
         return r, M, sigma, offc
 
+    def assemble(x, p):
+        with profiling.stage("assemble_device"):
+            profiling.count("jit_call", 2)
+            out = assemble_inline(x, p)
+            if profiling.enabled():
+                jax.block_until_ready([a for a in out if a is not None])
+        return out
+
+    assemble.inline = assemble_inline
     return assemble
 
 
@@ -391,119 +413,8 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
                                            include_offset)
 
     def _impl(xp, r, M, sigma, offc, U, phi, esl):
-        """The complete GLS linear solve + Woodbury chi2, xp-generic:
-        runs as one jitted program on the (true-IEEE) CPU backend and as
-        host numpy on accelerators — TPU's emulated-f64 dot products are
-        only ~f32-grade at NANOGrav row counts, which destroys the
-        small-eigenvalue structure parameter uncertainties are made of
-        (measured on B1855+09: DMX uncertainties collapse ~200x if the
-        Gram is formed on device).  With ``esl`` the ECORR block is
-        eliminated through its exactly-diagonal Gram (Schur complement),
-        so the eigendecomposition touches only timing+Fourier columns
-        (~150 instead of ~780 on B1855) and chi2 uses the matching
-        per-epoch Sherman-Morrison (`woodbury_dot_split`)."""
-        npar = len(names)
-        if U is not None and U.shape[0] != r.shape[0]:
-            # wideband: the noise basis covers only the TOA rows; the DM
-            # block is uncorrelated (reference pint_matrix.py:532 pads
-            # the same way when combining design matrices)
-            U = xp.concatenate(
-                [U, xp.zeros((r.shape[0] - U.shape[0], U.shape[1]))],
-                axis=0)
-        if phi is not None:
-            # zero prior variance (e.g. a disabled red-noise amplitude)
-            # would make phiinv infinite; floor it so those columns are
-            # pinned to ~zero amplitude instead of poisoning the solve
-            # (1e-30 keeps 1/phi inside TPU's emulated-f64 range)
-            phi = xp.where(phi > 0.0, phi, 1e-30)
-        ntm = M.shape[1]
-        Mfull = M if U is None else xp.concatenate([M, U], axis=1)
-        P = Mfull.shape[1]
-        Mn, rw, norms = _whiten_normalize(Mfull, r, sigma)
-        phiinv = xp.zeros(P) if phi is None else \
-            xp.concatenate([xp.zeros(ntm), 1.0 / phi])
-        # (sqrt(phiinv)/norms)^2, NOT phiinv/norms^2: timing-column norms
-        # can exceed 1e19 and norms**2 leaves the emulated-f64 exponent
-        # range on TPU (the squared form stays bounded for every column)
-        prior = (xp.sqrt(phiinv) / norms) ** 2
-        thr = _machine_eps(xp) * P if threshold is None else threshold
-        # ABSOLUTE threshold in the normalized coordinates (timing
-        # columns have unit norm, so data-driven eigenvalues are O(ncols)
-        # and true degeneracies sit at rounding level).  A threshold
-        # relative to e[-1] breaks when a strong noise prior dominates:
-        # 1/phi for a tightly-pinned basis mode inflates e[-1] by many
-        # orders and the cutoff then swallows legitimately small timing
-        # eigenvalues — seen on B1855+09, where the deep
-        # (1 - rho^2 ~ 1e-10) OM-T0 degeneracy was dropped, collapsing
-        # both uncertainties ~1e5x below tempo2's.
-        if esl is None:
-            A = Mn.T @ Mn + xp.diag(prior)
-            e, V = _eigh_xp(xp, A)
-            bad = e <= thr
-            einv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, e))
-            sol = (V @ (einv * (V.T @ (Mn.T @ rw)))) / norms
-            Sigma_n = (V * einv) @ V.T
-        else:
-            dlo, dhi = ntm + esl[0], ntm + esl[1]
-            kidx = np.concatenate([np.arange(dlo), np.arange(dhi, P)])
-            didx = np.arange(dlo, dhi)
-            K = Mn[:, kidx]
-            D = Mn[:, didx]
-            b_K = K.T @ rw
-            b_D = D.T @ rw
-            # D's Gram block is exactly diagonal (disjoint supports);
-            # unit column normalization makes the diagonal 1
-            d_D = 1.0 + prior[didx]
-            G_KD = K.T @ D
-            S = K.T @ K + xp.diag(prior[kidx]) \
-                - (G_KD / d_D[None, :]) @ G_KD.T
-            e, V = _eigh_xp(xp, S)
-            bad = e <= thr
-            einv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, e))
-            sol_K = V @ (einv * (V.T @ (b_K - G_KD @ (b_D / d_D))))
-            sol_D = (b_D - G_KD.T @ sol_K) / d_D
-            if xp is np:
-                sol = np.zeros(P)
-                sol[kidx] = sol_K
-                sol[didx] = sol_D
-                sol = sol / norms
-            else:
-                sol = jnp.zeros(P).at[kidx].set(sol_K) \
-                    .at[didx].set(sol_D) / norms
-            # (A^-1)_KK is exactly the Schur-complement inverse, and the
-            # timing columns are the first npar entries of K
-            Sigma_n = (V * einv) @ V.T
-        # chi2 at x, offset profiled out in the C^-1 metric (over the
-        # offc regressor — ones on TOA rows, zeros on wideband DM rows)
-        off = xp.float64(0.0)
-        if phi is None:
-            if offc is not None:
-                w = offc / sigma**2
-                off = xp.sum(r * w) / xp.sum(w * offc)
-            chi2 = xp.sum(((r - off * offc if offc is not None else r)
-                           / sigma) ** 2)
-        else:
-            if esl is None:
-                def cdot(a, b):
-                    return woodbury_dot(sigma**2, U, phi, a, b)[0]
-            else:
-                Ue = U[:, esl[0]:esl[1]]
-                phie = phi[esl[0]:esl[1]]
-                Uf = xp.concatenate([U[:, :esl[0]], U[:, esl[1]:]],
-                                    axis=1)
-                phif = xp.concatenate([phi[:esl[0]], phi[esl[1]:]])
-
-                def cdot(a, b):
-                    return woodbury_dot_split(sigma**2, Ue, phie,
-                                              Uf, phif, a, b)[0]
-            if offc is not None:
-                off = cdot(offc, r) / cdot(offc, offc)
-            r_off = r - off * offc if offc is not None else r
-            chi2 = cdot(r_off, r_off)
-        return {"dx": sol[:npar], "offset": off, "chi2": chi2,
-                "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
-                "noise_ampls": sol[ntm:], "resid_sec": r,
-                "n_bad": xp.sum(bad)}
+        return gls_solve(xp, r, M, sigma, offc, U, phi, esl, npar,
+                         threshold)
 
     def make_solve(esl):
         if jax.default_backend() == "cpu":
@@ -517,16 +428,43 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
 
         cache: dict = {}
 
-        def solve(r, M, sigma, offc, p):
+        def solve(r, M, sigma, offc, p, p_host=None):
+            from pint_tpu.utils import host_eager
+
             r_h, M_h, s_h, offc_h = _fetch_host(r, M, sigma, offc)
-            if "U" not in cache:  # static across steps of one fit
-                U = model.noise_basis(p)
-                cache["U"] = None if U is None else \
-                    np.asarray(U, np.float64)
-            phi = model.noise_weights(p)
-            phi_h = None if phi is None else np.asarray(phi, np.float64)
-            return _impl(np, r_h, M_h, s_h, offc_h, cache["U"], phi_h,
-                         esl)
+            if p_host is not None:
+                # The basis U from the HOST pytree (no device traffic),
+                # re-extracted whenever the basis leaves are replaced by
+                # a build_pdict.  Keyed on the leaf OBJECTS themselves
+                # (strong references, identity-compared) so a recycled
+                # allocation can never produce a false hit.  phi is NOT
+                # cached: the prior variances depend on noise parameter
+                # VALUES (which change across noise-fit alternations
+                # while the basis arrays are reused).
+                leaves = [p_host["const"].get(c.basis_pytree_name)
+                          for c in model.correlated_noise_components]
+                hit = ("leaves" in cache
+                       and len(cache["leaves"]) == len(leaves)
+                       and all(a is b for a, b in
+                               zip(cache["leaves"], leaves)))
+                if not hit:
+                    cache["leaves"] = leaves
+                    cache["U"] = _host_noise_basis(model, p_host)
+                with host_eager():
+                    phi = model.noise_weights(p_host)
+                phi_h = None if phi is None else \
+                    np.asarray(phi, np.float64)
+            else:
+                if "U" not in cache:  # static across steps of one fit
+                    U = model.noise_basis(p)
+                    cache["U"] = None if U is None else \
+                        np.asarray(U, np.float64)
+                phi = model.noise_weights(p)
+                phi_h = None if phi is None else \
+                    np.asarray(phi, np.float64)
+            with profiling.stage("solve_host"):
+                return _impl(np, r_h, M_h, s_h, offc_h, cache["U"],
+                             phi_h, esl)
 
         return solve
 
@@ -536,28 +474,154 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
         (lambda b: build_whitened_assembly(model, b, names, track_mode,
                                            include_offset)))
 
-    def _host_step(x, p, exact, assemble_fn, solve_fn):
-        out = _assemble_exact(x, p) if exact else None
+    def _host_step(x, p, exact, assemble_fn, solve_fn, p_host):
+        out = _assemble_exact(x, p_host if p_host is not None else p) \
+            if exact else None
         if out is None:
             out = assemble_fn(x, p)
         r, M, sigma, offc = out
-        return solve_fn(r, M, sigma, offc, p)
+        return solve_fn(r, M, sigma, offc, p, p_host)
 
     solve_cache: dict = {}
 
-    def step(x, p, exact=False):
+    def step(x, p, exact=False, p_host=None):
         esl = solve_cache.get("esl", ...)
         if esl is ...:
-            esl = solve_cache["esl"] = model.ecorr_block(p)
+            esl = solve_cache["esl"] = model.ecorr_block(
+                p_host if p_host is not None else p)
         solve = solve_cache.get(esl)
         if solve is None:
             solve = solve_cache[esl] = make_solve(esl)
         if jax.default_backend() == "cpu":
             r, M, sigma, offc = assemble(x, p)
             return solve(r, M, sigma, offc, p)
-        return _host_step(x, p, exact, assemble, solve)
+        return _host_step(x, p, exact, assemble, solve, p_host)
 
     return step
+
+
+def gls_solve(xp, r, M, sigma, offc, U, phi, esl, npar,
+              threshold=None):
+    """The complete GLS linear solve + Woodbury chi2, xp-generic: runs
+    as (part of) a jitted program on the (true-IEEE) CPU backend and in
+    fused accelerator fit programs (where only the step ``dx`` is
+    consumed — XLA dead-code-eliminates the rest), and as host numpy
+    for the FINAL solve on accelerators — TPU's emulated-f64 dot
+    products are only ~f32-grade at NANOGrav row counts, which destroys
+    the small-eigenvalue structure parameter uncertainties are made of
+    (measured on B1855+09: DMX uncertainties collapse ~200x if the Gram
+    is formed on device).  With ``esl`` the ECORR block is eliminated
+    through its exactly-diagonal Gram (Schur complement), so the
+    eigendecomposition touches only timing+Fourier columns (~150
+    instead of ~780 on B1855) and chi2 uses the matching per-epoch
+    Sherman-Morrison (`woodbury_dot_split`).  The returned ``e_min``
+    (smallest KEPT eigenvalue of the normalized, prior-augmented normal
+    matrix) is the conditioning figure the fitters use to decide
+    whether the device-assembled design matrix suffices for the final
+    covariance (consulted by ``Fitter._final_step`` and the fused-fit
+    finish against ``EXACT_COV_EMIN_FLOOR``)."""
+    if U is not None and U.shape[0] != r.shape[0]:
+        # wideband: the noise basis covers only the TOA rows; the DM
+        # block is uncorrelated (reference pint_matrix.py:532 pads
+        # the same way when combining design matrices)
+        U = xp.concatenate(
+            [U, xp.zeros((r.shape[0] - U.shape[0], U.shape[1]))],
+            axis=0)
+    if phi is not None:
+        # zero prior variance (e.g. a disabled red-noise amplitude)
+        # would make phiinv infinite; floor it so those columns are
+        # pinned to ~zero amplitude instead of poisoning the solve
+        # (1e-30 keeps 1/phi inside TPU's emulated-f64 range)
+        phi = xp.where(phi > 0.0, phi, 1e-30)
+    ntm = M.shape[1]
+    Mfull = M if U is None else xp.concatenate([M, U], axis=1)
+    P = Mfull.shape[1]
+    Mn, rw, norms = _whiten_normalize(Mfull, r, sigma)
+    phiinv = xp.zeros(P) if phi is None else \
+        xp.concatenate([xp.zeros(ntm), 1.0 / phi])
+    # (sqrt(phiinv)/norms)^2, NOT phiinv/norms^2: timing-column norms
+    # can exceed 1e19 and norms**2 leaves the emulated-f64 exponent
+    # range on TPU (the squared form stays bounded for every column)
+    prior = (xp.sqrt(phiinv) / norms) ** 2
+    thr = _machine_eps(xp) * P if threshold is None else threshold
+    # ABSOLUTE threshold in the normalized coordinates (timing
+    # columns have unit norm, so data-driven eigenvalues are O(ncols)
+    # and true degeneracies sit at rounding level).  A threshold
+    # relative to e[-1] breaks when a strong noise prior dominates:
+    # 1/phi for a tightly-pinned basis mode inflates e[-1] by many
+    # orders and the cutoff then swallows legitimately small timing
+    # eigenvalues — seen on B1855+09, where the deep
+    # (1 - rho^2 ~ 1e-10) OM-T0 degeneracy was dropped, collapsing
+    # both uncertainties ~1e5x below tempo2's.
+    if esl is None:
+        A = Mn.T @ Mn + xp.diag(prior)
+        e, V = _eigh_xp(xp, A)
+        bad = e <= thr
+        einv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, e))
+        sol = (V @ (einv * (V.T @ (Mn.T @ rw)))) / norms
+        Sigma_n = (V * einv) @ V.T
+    else:
+        dlo, dhi = ntm + esl[0], ntm + esl[1]
+        kidx = np.concatenate([np.arange(dlo), np.arange(dhi, P)])
+        didx = np.arange(dlo, dhi)
+        K = Mn[:, kidx]
+        D = Mn[:, didx]
+        b_K = K.T @ rw
+        b_D = D.T @ rw
+        # D's Gram block is exactly diagonal (disjoint supports);
+        # unit column normalization makes the diagonal 1
+        d_D = 1.0 + prior[didx]
+        G_KD = K.T @ D
+        S = K.T @ K + xp.diag(prior[kidx]) \
+            - (G_KD / d_D[None, :]) @ G_KD.T
+        e, V = _eigh_xp(xp, S)
+        bad = e <= thr
+        einv = xp.where(bad, 0.0, 1.0 / xp.where(bad, 1.0, e))
+        sol_K = V @ (einv * (V.T @ (b_K - G_KD @ (b_D / d_D))))
+        sol_D = (b_D - G_KD.T @ sol_K) / d_D
+        if xp is np:
+            sol = np.zeros(P)
+            sol[kidx] = sol_K
+            sol[didx] = sol_D
+            sol = sol / norms
+        else:
+            sol = jnp.zeros(P).at[kidx].set(sol_K) \
+                .at[didx].set(sol_D) / norms
+        # (A^-1)_KK is exactly the Schur-complement inverse, and the
+        # timing columns are the first npar entries of K
+        Sigma_n = (V * einv) @ V.T
+    # chi2 at x, offset profiled out in the C^-1 metric (over the
+    # offc regressor — ones on TOA rows, zeros on wideband DM rows)
+    off = xp.float64(0.0)
+    if phi is None:
+        if offc is not None:
+            w = offc / sigma**2
+            off = xp.sum(r * w) / xp.sum(w * offc)
+        chi2 = xp.sum(((r - off * offc if offc is not None else r)
+                       / sigma) ** 2)
+    else:
+        if esl is None:
+            def cdot(a, b):
+                return woodbury_dot(sigma**2, U, phi, a, b)[0]
+        else:
+            Ue = U[:, esl[0]:esl[1]]
+            phie = phi[esl[0]:esl[1]]
+            Uf = xp.concatenate([U[:, :esl[0]], U[:, esl[1]:]],
+                                axis=1)
+            phif = xp.concatenate([phi[:esl[0]], phi[esl[1]:]])
+
+            def cdot(a, b):
+                return woodbury_dot_split(sigma**2, Ue, phie,
+                                          Uf, phif, a, b)[0]
+        if offc is not None:
+            off = cdot(offc, r) / cdot(offc, offc)
+        r_off = r - off * offc if offc is not None else r
+        chi2 = cdot(r_off, r_off)
+    return {"dx": sol[:npar], "offset": off, "chi2": chi2,
+            "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
+            "noise_ampls": sol[ntm:], "resid_sec": r,
+            "n_bad": xp.sum(bad),
+            "e_min": xp.min(xp.where(bad, xp.inf, e))}
 
 
 def build_gls_fullcov_step(model: TimingModel, batch: TOABatch,
@@ -629,9 +693,10 @@ def build_gls_fullcov_step(model: TimingModel, batch: TOABatch,
         chi2 = r_off @ csolve(r_off)
         return {"dx": sol[:npar], "offset": off, "chi2": chi2,
                 "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
-                "resid_sec": r, "n_bad": jnp.sum(bad)}
+                "resid_sec": r, "n_bad": jnp.sum(bad),
+                "e_min": jnp.min(jnp.where(bad, jnp.inf, e))}
 
-    def step(x, p, exact=False):
+    def step(x, p, exact=False, p_host=None):
         # exact is accepted for interface parity but moot: the dense
         # full-cov path is CPU-only by construction (see docstring)
         r, M, sigma, offc = assemble(x, p)
@@ -649,10 +714,12 @@ def _fetch_host(r, M, sigma, offc):
     if isinstance(M, np.ndarray) or plat == "cpu":
         return (np.asarray(r), np.asarray(M), np.asarray(sigma),
                 None if offc is None else np.asarray(offc))
-    parts = [jnp.ravel(r), jnp.ravel(M), jnp.ravel(sigma)]
-    if offc is not None:
-        parts.append(jnp.ravel(offc))
-    flat = np.asarray(jnp.concatenate(parts))
+    profiling.count("fetch")
+    with profiling.stage("fetch_host"):
+        parts = [jnp.ravel(r), jnp.ravel(M), jnp.ravel(sigma)]
+        if offc is not None:
+            parts.append(jnp.ravel(offc))
+        flat = np.asarray(jnp.concatenate(parts))
     n = r.shape[0]
     r_h = flat[:n]
     M_h = flat[n:n + M.size].reshape(M.shape)
@@ -688,13 +755,16 @@ def _exact_assemble_factory(batch, default_builder):
                     "run with JAX_PLATFORMS=<accel>,cpu for exact "
                     "covariances")
             return None
-        with jax.default_device(cpu):
+        with jax.default_device(cpu), profiling.stage("assemble_exact_cpu"):
             if "a" not in cache:
                 batch_np = jax.tree_util.tree_map(np.asarray, batch)
                 cache["a"] = default_builder(batch_np)
             x_np = np.asarray(x)
             p_np = jax.tree_util.tree_map(np.asarray, p)
-            return cache["a"](x_np, p_np)
+            out = cache["a"](x_np, p_np)
+            if profiling.enabled():
+                jax.block_until_ready(out)
+            return out
 
     return assemble_exact
 
@@ -728,22 +798,8 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
         host_finish = jax.default_backend() != "cpu"
 
     def _solve(xp, r, M, sigma, offc, kern):
-        dpars, Sigma_n, norms, n_bad = kern(M, r, sigma, threshold)
-        # chi2 at x with the offset profiled out (the linear best fit of
-        # the offc regressor — ones on TOA rows, zeros on wideband DM rows
-        # — to the current residuals)
-        if offc is not None:
-            w = offc / sigma**2
-            off = xp.sum(r * w) / xp.sum(w * offc)
-            r_off = r - off * offc
-        else:
-            off = xp.float64(0.0)
-            r_off = r
-        chi2 = xp.sum((r_off / sigma) ** 2)
-        npar = len(names)
-        return {"dx": dpars[:npar], "offset": off, "chi2": chi2,
-                "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
-                "resid_sec": r, "n_bad": n_bad}
+        return wls_solve(xp, r, M, sigma, offc, kern, len(names),
+                         threshold)
 
     if host_finish:
         # accelerator fit path: the device computes the physics
@@ -761,13 +817,15 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
                 model, b, names, track_mode, include_offset))
         host_kernel = fit_wls_svd if kernel is None else kernel
 
-        def step(x, p, exact=False):
-            out = assemble_exact(x, p) if exact else None
+        def step(x, p, exact=False, p_host=None):
+            out = assemble_exact(
+                x, p_host if p_host is not None else p) if exact else None
             if out is None:
                 out = assemble(x, p)
             r, M, sigma, offc = out
             r_h, M_h, s_h, offc_h = _fetch_host(r, M, sigma, offc)
-            return _solve(np, r_h, M_h, s_h, offc_h, host_kernel)
+            with profiling.stage("solve_host"):
+                return _solve(np, r_h, M_h, s_h, offc_h, host_kernel)
 
         return step
 
@@ -777,11 +835,199 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
     def solve(r, M, sigma, offc):
         return _solve(jnp, r, M, sigma, offc, kern)
 
-    def step(x, p, exact=False):
+    def step(x, p, exact=False, p_host=None):
         r, M, sigma, offc = assemble(x, p)
         return solve(r, M, sigma, offc)
 
     return step
+
+
+def wls_solve(xp, r, M, sigma, offc, kern, npar, threshold=None):
+    """One WLS solve + chi2 from a whitened assembly, xp-generic (the
+    shared finish of the step and fused-fit paths).  chi2 is evaluated
+    at x with the offset profiled out (the linear best fit of the offc
+    regressor — ones on TOA rows, zeros on wideband DM rows — to the
+    current residuals).  On the host (xp is np) the returned ``e_min``
+    is the smallest kept eigenvalue of the normalized Gram (recovered
+    as 1/||Sigma_n||_2 — exact for both kernels since Sigma_n's
+    eigenvalues are the reciprocals of the kept ones), the conditioning
+    figure `Fitter._final_step` tests against EXACT_COV_EMIN_FLOOR;
+    device callers (grids) never
+    consult it, so the extra decomposition is host-only."""
+    dpars, Sigma_n, norms, n_bad = kern(M, r, sigma, threshold)
+    if offc is not None:
+        w = offc / sigma**2
+        off = xp.sum(r * w) / xp.sum(w * offc)
+        r_off = r - off * offc
+    else:
+        off = xp.float64(0.0)
+        r_off = r
+    chi2 = xp.sum((r_off / sigma) ** 2)
+    if xp is np:
+        smax = float(np.linalg.eigvalsh(Sigma_n)[-1])
+        e_min = 1.0 / smax if smax > 0 else np.inf
+    else:
+        e_min = jnp.float64(jnp.inf)
+    return {"dx": dpars[:npar], "offset": off, "chi2": chi2,
+            "Sigma_n": Sigma_n[:npar, :npar], "norms": norms[:npar],
+            "resid_sec": r, "n_bad": n_bad, "e_min": e_min}
+
+
+#: Smallest kept normalized-Gram eigenvalue below which the final
+#: covariance must come from a CPU-exact (true-IEEE) re-assembly of the
+#: design matrix: the accelerator-assembled M carries ~1e-11 relative
+#: noise, which perturbs the normalized Gram's eigenvalues by ~1e-8..1e-7
+#: absolute at NANOGrav row counts; eigenvalues within ~100x of that get
+#: noise-grade variances.  Above the floor the device assembly (host
+#: true-f64 solve) is exact to well under quoted-uncertainty precision.
+EXACT_COV_EMIN_FLOOR = 1e-5
+
+
+def _host_noise_basis(model: TimingModel, p_host: dict):
+    """The concatenated noise basis U as host numpy from a HOST params
+    pytree — the blocks are host-built pytree leaves already, so this
+    costs zero accelerator dispatches (the prior variances phi are NOT
+    extracted here: they depend on noise parameter values and must be
+    recomputed per solve)."""
+    comps = [c for c in model.correlated_noise_components
+             if c.basis_pytree_name in p_host["const"]]
+    if not comps:
+        return None
+    return np.concatenate(
+        [np.asarray(p_host["const"][c.basis_pytree_name], np.float64)
+         for c in comps], axis=1)
+
+
+def build_fused_fit(model: TimingModel, batch: TOABatch,
+                    fit_params: Sequence[str], track_mode: str, *,
+                    threshold: Optional[float] = None,
+                    include_offset: bool = True, maxiter: int = 2,
+                    tol_chi2: float = 1e-8,
+                    exact_floor: Optional[float] = None):
+    """An ENTIRE iterated WLS Gauss-Newton fit as one XLA program + one
+    device->host transfer — the accelerator answer to VERDICT r3's
+    single-fit latency finding (each eager step over a networked TPU
+    pays ~100 ms/dispatch; a maxiter-step fit used to pay
+    2*(maxiter+1) dispatches plus per-step fetches).
+
+    The jitted program `lax.scan`s ``maxiter`` full Gauss-Newton steps
+    (the device eigh kernel — only ``dx`` is consumed, so XLA dead-code
+    eliminates each step's covariance/chi2 arithmetic), re-assembles
+    the whitened system at the converged x, and returns everything in
+    ONE flat f64 vector fetched in ONE transfer.  The FINAL solve then
+    runs on the host in true-IEEE f64 with the reference's SVD recipe
+    (accelerator Gram noise must not touch the reported covariance),
+    and if it reports a kept eigenvalue within reach of the
+    device-assembly noise (``e_min`` below ``exact_floor``), the
+    design matrix is re-assembled once on the in-process CPU backend
+    from the HOST params pytree (zero accelerator traffic) and the
+    solve repeats — the exactness tiers of `_exact_assemble_factory`,
+    now paid only when the conditioning actually demands it.
+
+    WLS only: correlated-noise (GLS) normal matrices carry physical
+    structure below the device Gram noise, so GLS iteration steps must
+    be host-solved per step (see `GLSFitter._fused_ok`).
+
+    Returns ``fit(p, p_host=None) -> (x, out)`` with ``out`` the
+    `wls_solve` host dict.  ``p_host`` is the same pytree as ``p`` with
+    host-numpy leaves (fitters pass ``resids.pdict``); without it the
+    exact tier falls back to per-leaf device fetches.
+    """
+    names = list(fit_params)
+    npar = len(names)
+    assemble = build_whitened_assembly(model, batch, names, track_mode,
+                                       include_offset)
+    inline = assemble.inline
+    n_rows = batch.ntoas
+    ncol = npar + (1 if include_offset else 0)
+    host_offc = np.ones(n_rows) if include_offset else None
+    floor = EXACT_COV_EMIN_FLOOR if exact_floor is None else exact_floor
+
+    @jax.jit
+    def run(p):
+        # while_loop, not scan: honors the eager loop's tol_chi2
+        # early-stop in-graph (a converged fit skips the remaining
+        # iterations' device work; same break placement as the eager
+        # loop — step applied, then consecutive-chi2 test)
+        def cond(c):
+            _, _, i, done = c
+            return jnp.logical_and(i < maxiter, jnp.logical_not(done))
+
+        def body(c):
+            x, prev, i, _ = c
+            r, M, sigma, offc = inline(x, p)
+            dpars, _, _, _ = fit_wls_eigh(M, r, sigma, threshold)
+            if offc is not None:
+                w = offc / sigma**2
+                off = jnp.sum(r * w) / jnp.sum(w * offc)
+                chi2 = jnp.sum(((r - off * offc) / sigma) ** 2)
+            else:
+                chi2 = jnp.sum((r / sigma) ** 2)
+            done = jnp.abs(prev - chi2) < tol_chi2
+            return x + dpars[:npar], chi2, i + 1, done
+
+        x, _, _, _ = jax.lax.while_loop(
+            cond, body, (jnp.zeros(npar), jnp.float64(jnp.inf),
+                         jnp.int32(0), jnp.bool_(False)))
+        r, M, sigma, _ = inline(x, p)
+        return jnp.concatenate([x, r, sigma, jnp.ravel(M)])
+
+    assemble_exact = _exact_assemble_factory(
+        batch, lambda b: build_whitened_assembly(
+            model, b, names, track_mode, include_offset))
+
+    def host_solve(r, M, sigma):
+        return wls_solve(np, r, M, sigma, host_offc, fit_wls_svd, npar,
+                         threshold)
+
+    def fit(p, p_host=None):
+        profiling.count("jit_call")
+        with profiling.stage("fused_device_fit"):
+            flat = run(p)
+            if profiling.enabled():
+                jax.block_until_ready(flat)
+        profiling.count("fetch")
+        with profiling.stage("fetch_host"):
+            flat = np.asarray(flat)
+        x = flat[:npar]
+        r = flat[npar:npar + n_rows]
+        sigma = flat[npar + n_rows:npar + 2 * n_rows]
+        M = flat[npar + 2 * n_rows:].reshape(n_rows, ncol)
+        with profiling.stage("solve_host"):
+            out = host_solve(r, M, sigma)
+        if float(out["e_min"]) < floor:
+            profiling.count("exact_cov_pass")
+            ex = assemble_exact(np.asarray(x),
+                                p_host if p_host is not None else p)
+            if ex is not None:
+                r, M, sigma = (np.asarray(ex[0], np.float64),
+                               np.asarray(ex[1], np.float64),
+                               np.asarray(ex[2], np.float64))
+                with profiling.stage("solve_host"):
+                    out = host_solve(r, M, sigma)
+        # Apply the (already computed, true-IEEE) final Newton step:
+        # the device-solved trajectory lands ~1e-3 sigma from the host
+        # fixed point, and one exact GN step from there is quadratically
+        # convergent — TPU and CPU fits then agree to well below quoted
+        # precision.  Residuals/chi2 are updated by the linearization
+        # the step itself is based on (dr = -M dx; exact to second
+        # order at this displacement).
+        dx = np.asarray(out["dx"], np.float64)
+        x = x + dx
+        out = dict(out)
+        r_new = out["resid_sec"] - M[:, :npar] @ dx
+        if host_offc is not None:
+            w = host_offc / sigma**2
+            off = float(np.sum(r_new * w) / np.sum(w * host_offc))
+            out["chi2"] = float(
+                np.sum(((r_new - off * host_offc) / sigma) ** 2))
+            out["offset"] = off
+        else:
+            out["chi2"] = float(np.sum((r_new / sigma) ** 2))
+        out["resid_sec"] = r_new
+        return x, out
+
+    return fit
 
 
 def build_noise_lnlike(model: TimingModel, batch: TOABatch,
@@ -995,7 +1241,9 @@ class Fitter:
         it holds host numpy arrays (the noise basis alone is ~16 MB on
         real data) and would otherwise re-upload on every jitted step
         call — ruinous over a networked TPU tunnel."""
-        return jax.device_put(self.resids.pdict)
+        profiling.count("device_put_pdict")
+        with profiling.stage("device_put_pdict"):
+            return jax.device_put(self.resids.pdict)
 
     def _cached_step(self, names, threshold, include_offset):
         """Reuse one jitted step across repeated timing fits (the
@@ -1007,6 +1255,91 @@ class Fitter:
             self._step_cache = self._make_step(names, threshold,
                                                include_offset)
         return self._step_cache
+
+    def _final_step(self, step, x, p, p_host):
+        """Final solve at the converged x: device assembly + host-exact
+        solve, escalating to a CPU-exact re-assembly ONLY when the
+        conditioning demands it (a kept eigenvalue within reach of the
+        ~1e-11 device-assembly noise).  On the CPU backend the assembly
+        is already exact, so no second pass ever runs."""
+        final = step(jnp.asarray(x), p, p_host=p_host)
+        if jax.default_backend() != "cpu" and \
+                float(final["e_min"]) < EXACT_COV_EMIN_FLOOR:
+            profiling.count("exact_cov_pass")
+            final = step(jnp.asarray(x), p, exact=True, p_host=p_host)
+        return final
+
+    # -- fused whole-fit path (accelerators) ------------------------------
+    def _fused_ok(self) -> bool:
+        """Whether fit_toas should run as ONE fused device program + one
+        transfer (build_fused_fit).  Default: on accelerators only — on
+        XLA:CPU the fused whole-fit program is MIScompiled (the same
+        scalar-rewrite corruption of the quad-single error-free
+        transforms documented in PhaseCalc.phase; measured ~20 ns
+        coherent residual shift under the 8-virtual-device test config),
+        so the CPU backend keeps the eager step loop.  The decision
+        follows the EFFECTIVE default device, not the process backend:
+        under `jax.default_device(cpu)` in an accelerator process the
+        fused program would compile for (and be corrupted by) XLA:CPU.
+        PINT_TPU_FUSED=1 forces the fused path (structural tests only —
+        CPU numbers are then approximate); =0 disables it."""
+        import os
+
+        flag = os.environ.get("PINT_TPU_FUSED", "")
+        if flag == "0":
+            return False
+        if flag == "1":
+            return True
+        from pint_tpu.utils import effective_platform
+
+        return effective_platform() != "cpu"
+
+    def _make_fused(self, names, threshold, include_offset, maxiter,
+                    tol_chi2):
+        return build_fused_fit(self.model, self.resids.batch, names,
+                               self.track_mode, threshold=threshold,
+                               include_offset=include_offset,
+                               maxiter=maxiter, tol_chi2=tol_chi2)
+
+    def _cached_fused(self, names, threshold, include_offset, maxiter,
+                      tol_chi2):
+        key = (tuple(names), threshold, include_offset, maxiter, tol_chi2)
+        if getattr(self, "_fused_cache_key", None) != key:
+            self._fused_cache_key = key
+            self._fused_cache = self._make_fused(
+                names, threshold, include_offset, maxiter, tol_chi2)
+        return self._fused_cache
+
+    def _fit_fused(self, maxiter, threshold, tol_chi2=1e-8) -> float:
+        m = self.model
+        names = self.fit_params
+        p = self._device_pdict()
+        p_host = self.resids.pdict
+        include_offset = "PhaseOffset" not in m.components
+        fit = self._cached_fused(names, threshold, include_offset, maxiter,
+                                 tol_chi2)
+        x, out = fit(p, p_host=p_host)
+        if int(out["n_bad"]):
+            warnings.warn(
+                f"{int(out['n_bad'])} degenerate parameter "
+                "combination(s) dropped by SVD threshold",
+                DegeneracyWarning)
+        Sigma = denormalize_covariance(out["Sigma_n"], out["norms"])
+        # host pdict everywhere below: basis reads and delta write-back
+        # must not round-trip the accelerator
+        self._store_noise(out, p_host)
+        # seed only when the profiled-offset residuals match the
+        # Residuals definition: weighted-mean subtraction (the default),
+        # or no subtraction AND no offset actually profiled
+        tr = getattr(self.resids, "toa", self.resids)
+        seed_ok = (tr.subtract_mean and tr.use_weighted_mean) or \
+            (not tr.subtract_mean and float(out["offset"]) == 0.0)
+        seed = (out["resid_sec"], float(out["offset"])) if seed_ok \
+            else None
+        self._finalize(p_host, x, Sigma, names, resid_seed=seed)
+        self.fitresult = FitSummary(float(out["chi2"]), self.resids.dof,
+                                    maxiter, True)
+        return float(out["chi2"])
 
     def _store_noise(self, out, p):
         """Recover per-component noise realizations from the basis
@@ -1030,18 +1363,43 @@ class Fitter:
             self.noise_resids[type(c).__name__] = U @ a
             k += w
 
+    def _seed_resids(self, r_sec: np.ndarray, offset: float):
+        """Prime the post-fit residual cache from the fused fit's final
+        assembly (unsubtracted residuals [s] + profiled offset) instead
+        of re-running the device pipeline: the offset-profiled residuals
+        ARE the weighted-mean-subtracted residuals when the offc
+        regressor is all-ones with 1/sigma^2 weights (the default
+        Residuals definition), up to the (converged-fit-negligible)
+        TZR-phase shift of the written-back parameters.  Callers guard
+        on the residual configuration actually matching."""
+        tr = getattr(self.resids, "toa", self.resids)
+        nt = tr.batch.ntoas
+        tr._phase_resids = np.asarray(
+            (r_sec[:nt] - offset) * float(self.model.F0.value))
+        tr._chi2_cache = None
+
     def _finalize(self, p: dict, x: np.ndarray, Sigma: np.ndarray,
-                  names: List[str]):
-        """Write the solution back into host parameters + uncertainties."""
+                  names: List[str], resid_seed=None):
+        """Write the solution back into host parameters + uncertainties.
+        ``x`` stays host numpy throughout: with_x then stores numpy
+        scalars in the delta leaves, so apply_deltas needs no
+        device->host fetch at all.  ``resid_seed``: optional
+        ``(r_sec, offset)`` from a fused fit's final assembly, applied
+        after the model update so post-fit bookkeeping skips one device
+        pipeline dispatch (see `_seed_resids`)."""
         m = self.model
-        p2 = m.with_x(p, jnp.asarray(x), names)
+        p2 = m.with_x(p, np.asarray(x), names)
         m.apply_deltas(p2)
         for i, n in enumerate(names):
             m[n].set_device_uncertainty(float(np.sqrt(Sigma[i, i])))
         self.parameter_covariance_matrix = np.asarray(Sigma)
         self.covariance_params = list(names)
-        self.resids.update()
-        self.update_model()
+        with profiling.stage("finalize_resid_update"):
+            self.resids.update()
+        if resid_seed is not None:
+            self._seed_resids(*resid_seed)
+        with profiling.stage("finalize_update_model"):
+            self.update_model()
 
 
 class WLSFitter(Fitter):
@@ -1051,15 +1409,18 @@ class WLSFitter(Fitter):
 
     def fit_toas(self, maxiter: int = 2, threshold: Optional[float] = None,
                  tol_chi2: float = 1e-8) -> float:
+        if self._fused_ok():
+            return self._fit_fused(maxiter, threshold, tol_chi2)
         m = self.model
         names = self.fit_params
         p = self._device_pdict()
         include_offset = "PhaseOffset" not in m.components
         step = self._cached_step(names, threshold, include_offset)
+        p_host = self.resids.pdict
         x = np.zeros(len(names))
         prev_chi2 = None
         for it in range(maxiter):
-            out = step(jnp.asarray(x), p)
+            out = step(jnp.asarray(x), p, p_host=p_host)
             if int(out["n_bad"]):
                 warnings.warn(
                     f"{int(out['n_bad'])} degenerate parameter "
@@ -1071,10 +1432,10 @@ class WLSFitter(Fitter):
                 break
             prev_chi2 = chi2
         # final chi2 at the converged x
-        final = step(jnp.asarray(x), p, exact=True)
+        final = self._final_step(step, x, p, p_host)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
-        self._store_noise(final, p)
-        self._finalize(p, x, Sigma, names)
+        self._store_noise(final, p_host)
+        self._finalize(p_host, x, Sigma, names)
         self.fitresult = FitSummary(float(final["chi2"]), self.resids.dof,
                                     maxiter, True)
         return float(final["chi2"])
@@ -1107,6 +1468,17 @@ class GLSFitter(WLSFitter):
         return build(self.model, self.resids.batch, names,
                      self.track_mode, threshold=threshold,
                      include_offset=include_offset)
+
+    def _fused_ok(self) -> bool:
+        # Never fused: a B1855-class GLS normal matrix has physical
+        # structure below the accelerator's emulated-f64 Gram noise, and
+        # a device-solved iteration step there is garbage (measured:
+        # chi2 1e8 after one fused device step vs ~4200 host-solved).
+        # The GLS step loop host-solves EVERY step from a
+        # batched-fetched device assembly instead — with the host-pdict
+        # exact pass and device-free finalize this is a ~2 s fit, not a
+        # 75 s one.
+        return False
 
 
 class DownhillWLSFitter(Fitter):
@@ -1232,8 +1604,9 @@ class DownhillWLSFitter(Fitter):
         p = self._device_pdict()
         include_offset = "PhaseOffset" not in m.components
         step = self._cached_step(names, threshold, include_offset)
+        p_host = self.resids.pdict
         x = np.zeros(len(names))
-        out = step(jnp.asarray(x), p)
+        out = step(jnp.asarray(x), p, p_host=p_host)
         chi2 = float(out["chi2"])
         converged = False
         exception = None
@@ -1242,7 +1615,7 @@ class DownhillWLSFitter(Fitter):
             dx = np.asarray(out["dx"])
             lam = 1.0
             while True:
-                trial = step(jnp.asarray(x + lam * dx), p)
+                trial = step(jnp.asarray(x + lam * dx), p, p_host=p_host)
                 trial_chi2 = float(trial["chi2"])
                 if trial_chi2 <= chi2 + max_chi2_increase:
                     break
@@ -1261,14 +1634,13 @@ class DownhillWLSFitter(Fitter):
             if lam == 1.0 and improvement < required_chi2_decrease:
                 converged = True
                 break
-        # final covariance from an exact (CPU-assembled, host-solved)
-        # re-evaluation at the solution: the iteration steps' device
-        # assemblies carry ~1e-11 relative noise, above the deepest
-        # physical eigenvalues (see build_wls_step)
-        final = step(jnp.asarray(x), p, exact=True)
-        self._store_noise(final, p)
-        self._finalize(p, x, denormalize_covariance(final["Sigma_n"],
-                                                    final["norms"]), names)
+        # final covariance: device assembly + host solve, CPU-exact
+        # re-assembly only when conditioning demands (_final_step)
+        final = self._final_step(step, x, p, p_host)
+        self._store_noise(final, p_host)
+        self._finalize(p_host, x,
+                       denormalize_covariance(final["Sigma_n"],
+                                              final["norms"]), names)
         self.fitresult = FitSummary(chi2, self.resids.dof, it + 1, converged)
         if exception is not None and not converged:
             warnings.warn(str(exception))
@@ -1315,10 +1687,11 @@ class PowellFitter(Fitter):
                        options={"maxiter": maxiter, "xtol": 1e-10,
                                 "ftol": 1e-12})
         x = res.x * scale
-        final = step(jnp.asarray(x), p, exact=True)
+        p_host = self.resids.pdict
+        final = self._final_step(step, x, p, p_host)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
-        self._store_noise(final, p)
-        self._finalize(p, x, Sigma, names)
+        self._store_noise(final, p_host)
+        self._finalize(p_host, x, Sigma, names)
         self.fitresult = FitSummary(float(final["chi2"]), self.resids.dof,
                                     int(res.nit), bool(res.success))
         return float(final["chi2"])
@@ -1408,10 +1781,11 @@ class LMFitter(Fitter):
                     break
         # covariance from the undamped step at the solution
         step = self._cached_step(names, threshold, include_offset)
-        final = step(jnp.asarray(x), p, exact=True)
+        p_host = self.resids.pdict
+        final = self._final_step(step, x, p, p_host)
         Sigma = denormalize_covariance(final["Sigma_n"], final["norms"])
-        self._store_noise(final, p)
-        self._finalize(p, x, Sigma, names)
+        self._store_noise(final, p_host)
+        self._finalize(p_host, x, Sigma, names)
         self.fitresult = FitSummary(chi2, self.resids.dof, it + 1,
                                     converged)
         return chi2

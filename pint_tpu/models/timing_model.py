@@ -325,6 +325,7 @@ class TimingModel:
             if isinstance(par, funcParameter):
                 par.bind(self)
         self._sort_components()
+        self._tzr_phase_jit = None  # structure changed: retrace
         if setup:
             comp.setup()
         if validate:
@@ -332,6 +333,7 @@ class TimingModel:
 
     def remove_component(self, name: str):
         self.components.pop(name)._parent = None
+        self._tzr_phase_jit = None  # structure changed: retrace
 
     def _sort_components(self):
         def key(item):
@@ -488,31 +490,72 @@ class TimingModel:
                 tzr_mask.update(c.mask_entries(tzr_toas))
         p = {"const": const, "delta": delta, "mask": mask}
         if self.tzr_batch is not None and "AbsPhase" in self.components:
-            # host-side (eager, exact) evaluation of the TZR reference
-            # phase at the pytree's reference parameter values; see
-            # PhaseCalc.phase for why this stays out of the jitted graph.
-            # Pinned to the CPU backend: the ~1000 eager ops of the
-            # quad-single chain each cost a device round trip on an
-            # accelerator (~13 s over a networked TPU vs 0.2 s on host).
-            import contextlib
-
+            # Evaluation of the TZR reference phase at the pytree's
+            # reference parameter values; see PhaseCalc.phase for why
+            # this stays out of the MAIN jitted graph.  Two regimes:
+            #
+            # * accelerator default backend: a standalone 1-row JITTED
+            #   program on the accelerator.  Exactness holds because the
+            #   quad-single phase arithmetic is built on f32 error-free
+            #   transforms, which TPU implements in exact IEEE f32 (the
+            #   same reason the N-row pipeline is trusted on TPU), and
+            #   the host-exact trig rides in as __sincos pytree data.
+            #   Eagerly this chain is ~1000 ops at ~100 ms tunnel round
+            #   trip each (~13 s/update); jitted it is one dispatch.
+            #
+            # * CPU-only: EAGER on the CPU backend (exact IEEE f64).
+            #   The 1-row program is deliberately NOT jitted on XLA:CPU:
+            #   compiling it trips the same pathological scalar-rewrite
+            #   passes documented in PhaseCalc.phase /
+            #   build_whitened_assembly (minutes of compile for a
+            #   program that runs in microseconds).  Pinned via
+            #   utils.host_eager (which carries the multi-process
+            #   non-addressable-device caveat).
             import jax as _jax
 
-            try:
-                # local_devices, not devices: under a multi-process
-                # runtime (pint_tpu.multihost) global cpu device 0 is
-                # non-addressable from ranks > 0, and pinning eager ops
-                # to a non-addressable device segfaults the CPU client
-                ctx = _jax.default_device(
-                    _jax.local_devices(backend="cpu")[0])
-            except RuntimeError:  # JAX_PLATFORMS excludes cpu
-                ctx = contextlib.nullcontext()
-            p_tzr = {"const": const, "delta": delta, "mask": tzr_mask}
-            with ctx:
-                ph = self.calc.phase(p_tzr, self.tzr_batch,
-                                     subtract_tzr=False, is_tzr=True)
-                const["__tzrphase__"] = np.stack(
-                    [np.asarray(w, np.float32)[0] for w in ph.words])
+            # the phase pipeline never reads the (large) noise-basis
+            # blocks; pruning them keeps the jitted call's per-update
+            # host->device upload small over a networked accelerator
+            basis_keys = {c.basis_pytree_name
+                          for c in self.correlated_noise_components}
+            p_tzr = {"const": {k: v for k, v in const.items()
+                               if k not in basis_keys},
+                     "delta": delta, "mask": tzr_mask}
+            # The EFFECTIVE device matters, not just the process
+            # backend: under a `jax.default_device(cpu)` context in an
+            # accelerator process, calling the accelerator-traced jit
+            # would silently retrace FOR CPU and hit the pathological
+            # compile.  Branch on where the computation actually lands,
+            # and pin the jitted call to the accelerator so ambient
+            # device contexts cannot retarget it.
+            from pint_tpu.utils import effective_platform
+
+            _dd = _jax.config.jax_default_device
+            if effective_platform() != "cpu":
+                if getattr(self, "_tzr_phase_jit", None) is None:
+                    import jax.numpy as _jnp
+                    calc = self.calc
+
+                    def _tzr_phase(pt, batch):
+                        ph = calc.phase(pt, batch, subtract_tzr=False,
+                                        is_tzr=True)
+                        return _jnp.stack(
+                            [w[0].astype(_jnp.float32) for w in ph.words])
+
+                    self._tzr_phase_jit = _jax.jit(_tzr_phase)
+                accel = _dd if _dd is not None else \
+                    _jax.local_devices(backend=_jax.default_backend())[0]
+                with _jax.default_device(accel):
+                    const["__tzrphase__"] = np.asarray(
+                        self._tzr_phase_jit(p_tzr, self.tzr_batch))
+            else:
+                from pint_tpu.utils import host_eager
+
+                with host_eager():
+                    ph = self.calc.phase(p_tzr, self.tzr_batch,
+                                         subtract_tzr=False, is_tzr=True)
+                    const["__tzrphase__"] = np.stack(
+                        [np.asarray(w, np.float32)[0] for w in ph.words])
         return p
 
     def apply_deltas(self, p: dict):

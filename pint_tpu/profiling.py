@@ -1,0 +1,162 @@
+"""Stage-level profiling + device-dispatch accounting.
+
+The reference ships a cProfile harness that collapses a fit into a
+per-stage table (`/root/reference/profiling/high_level_benchmark.py`,
+`prfparser.py`: "Slowest Calls" by function).  The TPU equivalent has
+different axes: what matters over a networked accelerator is (a) how
+many *dispatches* (device program launches / transfers, ~100 ms tunnel
+latency each) a fit costs and (b) how wall-clock splits between the
+jitted physics (assemble), the linear solve, host<->device transfer,
+and one-time compilation.  This module is that harness:
+
+* ``stage(name)`` — context manager accumulating wall time per stage.
+  Library call sites are pre-wired in :mod:`pint_tpu.fitter`; recording
+  is a no-op unless profiling is enabled, so the hooks are free in
+  production.
+* ``count(name)`` — increment a named dispatch counter.  The fitter
+  counts every eager jitted call and every device->host fetch, so a
+  test can assert "one fused fit = N dispatches" and catch a stray
+  ``np.asarray`` (one hidden transfer = +0.1 s over the tunnel).
+* ``enable()/disable()/report()/reset()`` — session control.  When
+  enabled, stage exits ``block_until_ready`` on nothing — timing is
+  attributed where the *wait* happens, which over an async runtime
+  means the stage that first consumes a value pays for it (the same
+  convention as the reference's cProfile table).
+* ``trace(logdir)`` — a thin wrapper over ``jax.profiler.trace`` for
+  full XLA traces (TensorBoard-viewable) when stage totals are not
+  enough.
+
+Typical use::
+
+    from pint_tpu import profiling
+    with profiling.session() as prof:
+        fitter.fit_toas()
+    print(prof.table())
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+__all__ = ["enable", "disable", "enabled", "reset", "report", "table",
+           "stage", "count", "counters", "session", "trace", "Session"]
+
+_enabled = False
+_stages: Dict[str, list] = {}   # name -> [calls, wall_s]
+_counters: Dict[str, int] = {}
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    _stages.clear()
+    _counters.clear()
+
+
+@contextlib.contextmanager
+def stage(name: str) -> Iterator[None]:
+    """Accumulate wall time under ``name`` (no-op unless enabled)."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        s = _stages.setdefault(name, [0, 0.0])
+        s[0] += 1
+        s[1] += dt
+
+
+def count(name: str, n: int = 1) -> None:
+    """Increment dispatch counter ``name`` (always on: integers are free,
+    and the dispatch-budget tests must not require profiling mode)."""
+    _counters[name] = _counters.get(name, 0) + n
+
+
+def counters() -> Dict[str, int]:
+    return dict(_counters)
+
+
+def report() -> Dict[str, Dict[str, float]]:
+    out = {k: {"calls": v[0], "wall_s": round(v[1], 4)}
+           for k, v in sorted(_stages.items())}
+    if _counters:
+        out["_dispatches"] = dict(_counters)
+    return out
+
+
+def table() -> str:
+    """The per-stage table, reference-style (prfparser's aligned rows)."""
+    rows = [f"{'stage':<24s} {'calls':>7s} {'wall_s':>10s}"]
+    total = 0.0
+    for k, (calls, wall) in sorted(_stages.items(),
+                                   key=lambda kv: -kv[1][1]):
+        rows.append(f"{k:<24s} {calls:>7d} {wall:>10.3f}")
+        total += wall
+    rows.append(f"{'TOTAL (attributed)':<24s} {'':>7s} {total:>10.3f}")
+    for k, v in sorted(_counters.items()):
+        rows.append(f"  dispatches[{k}] = {v}")
+    return "\n".join(rows)
+
+
+class Session:
+    def __init__(self):
+        self.stages: Dict[str, Dict[str, float]] = {}
+        self.dispatches: Dict[str, int] = {}
+
+    def table(self) -> str:
+        """Render THIS session's captured snapshot (not the live module
+        state, which a later reset()/session() may have replaced)."""
+        rows = [f"{'stage':<24s} {'calls':>7s} {'wall_s':>10s}"]
+        total = 0.0
+        stages = {k: v for k, v in self.stages.items()
+                  if k != "_dispatches"}
+        for k, v in sorted(stages.items(),
+                           key=lambda kv: -kv[1]["wall_s"]):
+            rows.append(f"{k:<24s} {v['calls']:>7d} {v['wall_s']:>10.3f}")
+            total += v["wall_s"]
+        rows.append(f"{'TOTAL (attributed)':<24s} {'':>7s} {total:>10.3f}")
+        for k, v in sorted(self.dispatches.items()):
+            rows.append(f"  dispatches[{k}] = {v}")
+        return "\n".join(rows)
+
+
+@contextlib.contextmanager
+def session() -> Iterator[Session]:
+    """Enable profiling, reset counters, and capture a report on exit."""
+    was = _enabled
+    reset()
+    enable()
+    s = Session()
+    try:
+        yield s
+    finally:
+        s.stages = report()
+        s.dispatches = counters()
+        if not was:
+            disable()
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Full XLA trace via ``jax.profiler`` (TensorBoard format)."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield

@@ -151,8 +151,12 @@ class Residuals:
     def _noise_basis_filtered(self):
         """(U, phi) with zero-prior-variance columns dropped — the single
         source for every correlated-noise consumer here."""
-        U = np.asarray(self.model.noise_basis(self.pdict), np.float64)
-        phi = np.asarray(self.model.noise_weights(self.pdict), np.float64)
+        from pint_tpu.utils import host_eager
+
+        with host_eager():
+            U = np.asarray(self.model.noise_basis(self.pdict), np.float64)
+            phi = np.asarray(self.model.noise_weights(self.pdict),
+                             np.float64)
         keep = phi > 0  # zero prior variance = column not present
         return U[:, keep], phi[keep]
 
@@ -182,9 +186,12 @@ class Residuals:
 
     def get_data_error(self) -> np.ndarray:
         """Scaled uncertainties [us] (EFAC/EQUAD once noise models exist)."""
+        from pint_tpu.utils import host_eager
+
         scaled = getattr(self.model, "scaled_toa_uncertainty", None)
         if scaled is not None:
-            return np.asarray(scaled(self.pdict, self.batch))
+            with host_eager():
+                return np.asarray(scaled(self.pdict, self.batch))
         return self.toas.error_us
 
     def lnlikelihood(self) -> float:
@@ -303,6 +310,7 @@ class WidebandTOAResiduals:
 
     def update(self):
         self.toa.update()
+        self._dm_resids_cache = None
 
     # -- TOA block --------------------------------------------------------
     @property
@@ -319,10 +327,19 @@ class WidebandTOAResiduals:
     def calc_dm_resids(self) -> np.ndarray:
         """measured DM - model DM [pc cm^-3] over the wideband TOAs
         (reference `WidebandDMResiduals.calc_resids`,
-        `/root/reference/src/pint/residuals.py:1077`)."""
+        `/root/reference/src/pint/residuals.py:1077`).  Cached until the
+        next update() — post-fit bookkeeping (chi2, summaries) asks for
+        these repeatedly and each recompute is a device dispatch."""
+        cached = getattr(self, "_dm_resids_cache", None)
+        if cached is not None:
+            return cached
+        from pint_tpu.utils import host_eager
+
         p = self.toa.pdict
-        model_dm = np.asarray(self.model.total_dm(p, self.toa.batch))
-        return self.dm_data - model_dm[self.dm_index]
+        with host_eager():
+            model_dm = np.asarray(self.model.total_dm(p, self.toa.batch))
+        self._dm_resids_cache = self.dm_data - model_dm[self.dm_index]
+        return self._dm_resids_cache
 
     @property
     def dm_resids(self) -> np.ndarray:

@@ -58,6 +58,42 @@ class PosVel(NamedTuple):
         return PosVel(-self.pos, -self.vel)
 
 
+def host_eager():
+    """Context manager pinning eager jax ops to the in-process CPU
+    backend: host bookkeeping paths (scaled uncertainties, noise priors,
+    DM totals) are a handful of small jnp expressions over host-numpy
+    pytrees, and letting them land on a NETWORKED accelerator costs a
+    ~100 ms round trip per op.  local_devices, not devices — under a
+    multi-process runtime global cpu device 0 is non-addressable from
+    ranks > 0 and pinning to it segfaults the CPU client.  No-op when
+    JAX_PLATFORMS excludes cpu."""
+    import contextlib
+
+    import jax
+
+    try:
+        return jax.default_device(jax.local_devices(backend="cpu")[0])
+    except RuntimeError:
+        return contextlib.nullcontext()
+
+
+def effective_platform() -> str:
+    """The platform eager ops / fresh jit traces will actually land on:
+    the `jax.default_device` override when one is active (it may be a
+    Device OR a platform string in jax 0.9), else the process default
+    backend.  Backend-conditional code MUST use this rather than
+    `jax.default_backend()` — under ``jax.default_device(cpu)`` in an
+    accelerator process, a backend check would route work to a program
+    that then compiles for (and on XLA:CPU may be miscompiled by) the
+    CPU."""
+    import jax
+
+    dd = jax.config.jax_default_device
+    if dd is None:
+        return jax.default_backend()
+    return dd if isinstance(dd, str) else dd.platform
+
+
 def get_xp(x):
     """The single numpy-vs-jax.numpy dispatch helper for this package.
 

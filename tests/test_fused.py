@@ -1,0 +1,140 @@
+"""The fused whole-fit accelerator path (`build_fused_fit`), exercised
+on the CPU backend via PINT_TPU_FUSED=1 in a subprocess.
+
+What CAN be asserted on CPU: structure — the dispatch budget (ONE jitted
+call + ONE device->host fetch per fit, the property the fused design
+exists for), convergence to the eager path's solution, uncertainty
+agreement, and the e_min/exact-covariance escalation wiring.  What
+CANNOT: exact numerical identity — on XLA:CPU the fused whole-fit
+program is subject to the scalar-rewrite miscompile documented in
+`PhaseCalc.phase` (measured ~1e-3 sigma parameter displacement under the
+8-virtual-device test config), which is why `_fused_ok` never
+auto-selects it on CPU and why the tolerances here are loose.  Exact
+TPU-vs-CPU value parity is asserted by `test_crossbackend.py`, which
+runs the fused path on the real accelerator.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+SCRIPT = r"""
+import json, warnings
+import numpy as np
+warnings.simplefilter("ignore")
+import sys
+sys.path.insert(0, "/root/repo/tests")
+from test_fitter import PAR
+from pint_tpu import profiling
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.fitter import WLSFitter
+
+def fit(fused):
+    import os
+    os.environ["PINT_TPU_FUSED"] = "1" if fused else "0"
+    m = get_model(PAR.strip().splitlines())
+    toas = make_fake_toas_uniform(
+        53650, 53850, 40, m, obs="gbt", error_us=1.0,
+        freq_mhz=np.tile([1400.0, 800.0], 20), add_noise=True, seed=7)
+    f = WLSFitter(toas, m)
+    with profiling.session() as s:
+        chi2 = f.fit_toas(maxiter=4)
+    return {
+        "chi2": chi2,
+        "vals": {n: [float(m[n].value), float(m[n].uncertainty)]
+                 for n in f.fit_params},
+        "dispatches": s.dispatches,
+        "resid_chi2": f.resids.calc_chi2(),
+    }
+
+print(json.dumps({"fused": fit(True), "eager": fit(False)}))
+"""
+
+
+@pytest.fixture(scope="module")
+def results(tmp_path_factory):
+    script = tmp_path_factory.mktemp("fused") / "fused_vs_eager.py"
+    script.write_text(SCRIPT)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, str(script)], env=env,
+                         capture_output=True, text=True, timeout=560)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no output; stderr tail: {out.stderr[-800:]}"
+    return json.loads(lines[-1])
+
+
+def test_dispatch_budget(results):
+    """THE property the fused path exists for: an entire iterated fit is
+    ONE jitted device call and ONE device->host transfer (VERDICT r3
+    item 1: 'count dispatches — at ~100 ms tunnel latency every stray
+    np.asarray is a 0.1 s tax')."""
+    d = results["fused"]["dispatches"]
+    assert d.get("jit_call", 0) == 1, d
+    assert d.get("fetch", 0) <= 1, d
+    assert d.get("device_put_pdict", 0) == 1, d
+
+
+def test_eager_path_dispatch_shape(results):
+    """The eager loop pays per-iteration assembles; the fused path must
+    be strictly cheaper in dispatches."""
+    de = results["eager"]["dispatches"]
+    df = results["fused"]["dispatches"]
+    assert df.get("jit_call", 0) < de.get("jit_call", 0), (df, de)
+
+
+def test_fused_matches_eager_loosely(results):
+    """Fit values agree within a small fraction of the quoted
+    uncertainty (loose: the CPU fused program is approximate — see
+    module docstring; TPU-exactness is test_crossbackend's job)."""
+    f, e = results["fused"]["vals"], results["eager"]["vals"]
+    for n, (v_f, u_f) in f.items():
+        v_e, u_e = e[n]
+        assert u_e > 0
+        assert abs(v_f - v_e) < 0.05 * u_e, (n, v_f, v_e, u_e)
+        assert abs(u_f / u_e - 1.0) < 0.01, (n, u_f, u_e)
+    assert results["fused"]["chi2"] == pytest.approx(
+        results["eager"]["chi2"], rel=1e-3)
+
+
+def test_post_fit_bookkeeping_consistent(results):
+    """The seeded residual cache must reproduce the chi2 the fit
+    reported (the seed IS the fit's final assembly)."""
+    r = results["fused"]
+    assert r["resid_chi2"] == pytest.approx(r["chi2"], rel=1e-6)
+
+
+def test_exact_escalation_wiring():
+    """e_min below the floor must trigger exactly one CPU-exact
+    re-assembly pass (counted via profiling)."""
+    import numpy as np
+    import warnings
+    warnings.simplefilter("ignore")
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_fitter import PAR
+
+    from pint_tpu import profiling
+    from pint_tpu.fitter import build_fused_fit
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.fitter import WLSFitter
+
+    m = get_model(PAR.strip().splitlines())
+    toas = make_fake_toas_uniform(
+        53650, 53850, 40, m, obs="gbt", error_us=1.0,
+        freq_mhz=np.tile([1400.0, 800.0], 20), add_noise=True, seed=7)
+    f = WLSFitter(toas, m)
+    names = f.fit_params
+    p = f.resids.pdict
+    # floor=inf forces the escalation regardless of conditioning
+    fit = build_fused_fit(m, f.resids.batch, names, f.track_mode,
+                          maxiter=2, exact_floor=float("inf"))
+    profiling.reset()
+    x, out = fit(p, p_host=p)
+    assert profiling.counters().get("exact_cov_pass", 0) == 1
+    assert np.isfinite(out["chi2"])
