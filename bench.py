@@ -177,6 +177,14 @@ def bench_wideband():
 def bench_ensemble(nfits: int = 32):
     """Vmapped many-fit batch: one XLA program solving `nfits`
     perturbed WLS problems at once (the many-pulsar batch shape)."""
+    return bench_ensemble_sweep(sizes=(nfits,))
+
+
+def bench_ensemble_sweep(sizes=(32, 128, 512, 2048)):
+    """Device-saturation evidence on the one real chip (VERDICT r3
+    item 8): fits/sec vs batch size for the vmapped ensemble.  On a
+    single chip throughput should RISE with batch size until the MXU
+    saturates — the scaling story a single device can tell."""
     from pint_tpu.examples import simulate_j0740_class
     from pint_tpu.fitter import WLSFitter
     from pint_tpu.gridutils import grid_chisq_flat
@@ -187,23 +195,33 @@ def bench_ensemble(nfits: int = 32):
     model.SINI.frozen = True
     f = WLSFitter(toas, model)
     rng = np.random.default_rng(0)
-    grid = {
-        "M2": 0.25 + 0.02 * rng.standard_normal(nfits),
-        "SINI": np.clip(0.99 + 0.004 * rng.standard_normal(nfits),
-                        0.9, 0.9999),
-    }
-    t0 = time.time()
-    grid_chisq_flat(f, grid, maxiter=2)
-    compile_s = time.time() - t0
-    times = []
-    for _ in range(3):
+    out = {}
+    for nfits in sizes:
+        grid = {
+            "M2": 0.25 + 0.02 * rng.standard_normal(nfits),
+            "SINI": np.clip(0.99 + 0.004 * rng.standard_normal(nfits),
+                            0.9, 0.9999),
+        }
         t0 = time.time()
         grid_chisq_flat(f, grid, maxiter=2)
-        times.append(time.time() - t0)
-    t = min(times)
-    return {"wall_s": round(t, 4), "fits_per_sec": round(nfits / t, 1),
-            "compile_s": round(compile_s, 2), "nfits": nfits,
-            "ntoas_each": 500}
+        compile_s = time.time() - t0
+        times = []
+        for _ in range(3):
+            t0 = time.time()
+            grid_chisq_flat(f, grid, maxiter=2)
+            times.append(time.time() - t0)
+        t = min(times)
+        out[str(nfits)] = {"wall_s": round(t, 4),
+                           "fits_per_sec": round(nfits / t, 1),
+                           "compile_s": round(compile_s, 2)}
+        log(f"  ensemble[{nfits}]: {out[str(nfits)]}")
+    first = out[str(sizes[0])]
+    return {"wall_s": first["wall_s"],
+            "fits_per_sec": first["fits_per_sec"],
+            "compile_s": first["compile_s"], "nfits": sizes[0],
+            "ntoas_each": 500,
+            "saturation_curve": {k: v["fits_per_sec"]
+                                 for k, v in out.items()}}
 
 
 def bench_sharded_scaling():
@@ -311,7 +329,11 @@ def _run_in_subprocess(func_name: str, timeout_s: float = 900):
         f"jax.config.update('jax_compilation_cache_dir', {os.path.join(CACHE, 'xla_cache')!r})\n"
         "jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)\n"
         "import bench\n"
-        f"print('@@RESULT@@' + json.dumps(bench.{func_name}()))\n"
+        "from pint_tpu import profiling\n"
+        "with profiling.session() as prof:\n"
+        f"    res = bench.{func_name}()\n"
+        "print('@@TABLE@@\\n' + prof.table(), file=sys.stderr)\n"
+        "print('@@RESULT@@' + json.dumps(res))\n"
     )
     env = dict(os.environ)
     if env.get("JAX_PLATFORMS", "") == "axon":
@@ -319,6 +341,8 @@ def _run_in_subprocess(func_name: str, timeout_s: float = 900):
     out = subprocess.run([sys.executable, "-u", "-c", code], env=env,
                          capture_output=True, text=True,
                          timeout=timeout_s)
+    if "@@TABLE@@" in out.stderr:
+        log(out.stderr.split("@@TABLE@@", 1)[1].strip())
     for line in out.stdout.splitlines():
         if line.startswith("@@RESULT@@"):
             return json.loads(line[len("@@RESULT@@"):])
@@ -363,9 +387,11 @@ def main():
     budget = float(os.environ.get("PINT_TPU_BENCH_BUDGET_S", 1500))
     t_start = time.time()
     submetrics = {}
+    from pint_tpu import profiling
+
     for name, fn in (
             ("ngc6440e_wls", bench_ngc6440e),
-            ("ensemble_32", bench_ensemble),
+            ("ensemble_sweep", bench_ensemble_sweep),
             ("b1855_gls_real",
              lambda: _run_in_subprocess("bench_b1855_gls")),
             ("wideband", lambda: _run_in_subprocess("bench_wideband")),
@@ -377,9 +403,13 @@ def main():
             continue
         try:
             t1 = time.time()
-            submetrics[name] = fn()
+            # per-config stage table (the reference's per-stage profile
+            # analogue: designmatrix/solve/transfer/compile split)
+            with profiling.session() as prof:
+                submetrics[name] = fn()
             log(f"{name}: {submetrics[name]} ({time.time()-t1:.1f} s "
                 "total incl. load)")
+            log(f"--- {name} stage table ---\n{prof.table()}")
         except Exception as e:  # keep the headline alive
             submetrics[name] = {"error": f"{type(e).__name__}: {e}"}
             log(f"{name} FAILED: {e}")

@@ -42,3 +42,61 @@ def pytest_report_header(config):
     import jax
 
     return f"jax devices: {jax.device_count()} ({jax.default_backend()})"
+
+
+# --- smoke / full test tiers -------------------------------------------------
+# ``pytest -m "not slow"`` is the SMOKE tier: whole-surface sanity that
+# completes in a few minutes cold on one core.  The full tier (everything)
+# takes ~55 min here.  Assignments below were measured with
+# ``--durations`` (2026-08); a renamed test simply drops back into the
+# smoke tier until re-tuned, so the list can only fail open.
+
+#: files whose every test is depth/perf coverage (real-data parity,
+#: subprocess-spawning, or multi-fit recovery loops)
+_SLOW_FILES = {
+    "test_multihost.py", "test_crossbackend.py", "test_noisefit.py",
+    "test_fused.py", "test_binary_ddk.py", "test_binary_ddgr_btx.py",
+    "test_modelselect.py", "test_solar_wind_swm1.py", "test_real_data.py",
+    "test_tempo2_parity.py", "test_parallel.py", "test_bayesian.py",
+    "test_tooling.py", "test_cli_new.py", "test_cli_tcb.py",
+    "test_residstats_frames.py", "test_wideband.py", "test_gls.py",
+    "test_spk_writer.py",
+}
+
+#: (file, test-name prefix) for heavyweight tests in otherwise-fast files
+_SLOW_TESTS = {
+    ("test_fitter.py", "TestPowellAndLM"),
+    ("test_fitter.py", "TestEighKernel"),
+    ("test_fitter.py", "TestJitConsistency"),
+    ("test_fitter.py", "TestDownhill"),
+    ("test_components.py", "TestIFunc"),
+    ("test_components.py", "TestGlitch"),
+    ("test_accuracy_obs.py", "TestSelfConsistency"),
+    ("test_accuracy_obs.py", "TestFDJumpDM"),
+    ("test_binary_dd.py", "TestFitRoundtrip"),
+    ("test_binary_dd.py", "TestOutOfRangeRobustness"),
+    ("test_binary_ell1.py", "TestFitRoundtrip"),
+    ("test_aux_components.py", "TestPLFlavors"),
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: depth/perf coverage excluded from the smoke tier "
+        '(run smoke with -m "not slow")')
+
+
+def pytest_collection_modifyitems(config, items):
+    import os
+
+    import pytest as _pytest
+
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        if fname in _SLOW_FILES or any(
+                fname == f and item.name.startswith(p) or
+                fname == f and getattr(item, "cls", None) is not None
+                and item.cls.__name__ == p
+                for f, p in _SLOW_TESTS):
+            item.add_marker(_pytest.mark.slow)

@@ -514,13 +514,17 @@ def _ecl_to_icrs(v):
     return np.stack([x, ce * y - se * z, se * y + ce * z], axis=-1)
 
 
-def _kepler_posvel_au(name, t_cy):
-    """Heliocentric J2000-ecliptic (pos [au], vel [au/day]) from mean elements."""
+def _kepler_posvel_au(name, t_cy, dL_rad=0.0, da_frac=0.0):
+    """Heliocentric J2000-ecliptic (pos [au], vel [au/day]) from mean
+    elements.  ``dL_rad``/``da_frac``: corrections to the mean longitude
+    [rad] and semi-major axis [fractional] — the giant-planet parameters
+    the DE405-anchored IC fit solves for (see
+    `IntegratedEphemeris._integrate_window`)."""
     a0, e0, i0, L0, w0, O0, da, de, di, dL, dw, dO = _KEPLER_ELEMENTS[name]
-    a = a0 + da * t_cy
+    a = (a0 + da * t_cy) * (1.0 + da_frac)
     e = e0 + de * t_cy
     inc = np.deg2rad(i0 + di * t_cy)
-    L = np.deg2rad(L0 + dL * t_cy)
+    L = np.deg2rad(L0 + dL * t_cy) + dL_rad
     wbar = np.deg2rad(w0 + dw * t_cy)
     Om = np.deg2rad(O0 + dO * t_cy)
     w = wbar - Om  # argument of perihelion
@@ -716,7 +720,7 @@ class BuiltinEphemeris:
 #: bodies carried by the N-body integration, in state-vector order
 _NBODY_NAMES = ("sun", "mercury", "venus", "emb", "mars", "jupiter",
                 "saturn", "uranus", "neptune")
-_NBODY_VERSION = 2  # bump to invalidate on-disk caches
+_NBODY_VERSION = 4  # bump to invalidate on-disk caches
 C_M_S = 299792458.0
 
 
@@ -814,6 +818,36 @@ class IntegratedEphemeris(BuiltinEphemeris):
             d = os.path.join(os.path.expanduser("~"), ".cache", "pint_tpu")
         return d
 
+    #: widest window the anchor extension may create [days] — beyond
+    #: this the query epoch is too far from the DE405 table for the
+    #: anchored fit to help, and the analytic-anchored build is used
+    _ANCHOR_EXTEND_MAX = 20000.0
+
+    @staticmethod
+    def _anchor_range():
+        """(lo, hi) MJD of the DE405 anchor table, or None when absent
+        or not enabled.
+
+        The anchor is OPT-IN (``PINT_TPU_DE_ANCHOR=1``), not the
+        default: fitting the initial conditions to the 2-year DE405
+        table nails the in-window trajectory (measured 1366 km -> 7 km
+        vs the table, i.e. 4.4 ms -> 23 us of light time;
+        tests/test_de_anchor.py) but EXTRAPOLATES worse than the
+        analytic-anchored fit on multi-year real datasets (B1855
+        tempo2-gap median 190 -> 272 us), because the giant-planet
+        mean-element errors dominate away from the anchor and no
+        longer-span JPL truth exists in this zero-download environment
+        to constrain them (see pint_tpu.ephemcal for the triangulation
+        attempt and its holdout numbers).  Enable it for work INSIDE
+        MJD ~52540-53280, or when a longer anchor table is supplied."""
+        if os.environ.get("PINT_TPU_DE_ANCHOR") != "1":
+            return None
+        try:
+            from pint_tpu.data import de_anchor
+        except ImportError:
+            return None
+        return (float(de_anchor.MJD_TDB[0]), float(de_anchor.MJD_TDB[-1]))
+
     def _window_key(self, mjd):
         """The quantized window covering this query, a pure function of
         the query epochs ALONE.  Earlier designs extended one global
@@ -824,9 +858,19 @@ class IntegratedEphemeris(BuiltinEphemeris):
         failures).  Deterministic quantization means the same dataset
         always gets the same integration no matter what else the process
         touched; distinct datasets may use overlapping windows (disk
-        cache makes rebuilds cheap)."""
+        cache makes rebuilds cheap).
+
+        When the DE405 anchor table is available and the union stays
+        under _ANCHOR_EXTEND_MAX days, the window is extended to cover
+        the table so the build can fit its initial conditions to real
+        JPL-ephemeris positions (still a pure function of the query)."""
         mjd = np.atleast_1d(np.asarray(mjd, np.float64))
         lo, hi = float(np.min(mjd)), float(np.max(mjd))
+        ar = self._anchor_range()
+        if ar is not None:
+            ulo, uhi = min(lo, ar[0] - 50.0), max(hi, ar[1] + 50.0)
+            if uhi - ulo <= self._ANCHOR_EXTEND_MAX:
+                lo, hi = ulo, uhi
         q = self._QUANTUM
         wlo = float(np.floor((lo - self._PAD) / q) * q)
         whi = float(np.ceil((hi + self._PAD) / q) * q)
@@ -846,6 +890,13 @@ class IntegratedEphemeris(BuiltinEphemeris):
         sp = self._windows.get(key)
         if sp is None:
             sp = self._windows[key] = self._build(*key)
+        else:
+            self._windows[key] = self._windows.pop(key)  # LRU touch
+        # bounded LRU: a long-lived process touching many datasets must
+        # not accumulate spline sets forever (the disk cache makes a
+        # rebuild cheap)
+        while len(self._windows) > 8:
+            self._windows.pop(next(iter(self._windows)))
         return sp
 
     def pinned_to(self, mjd_span):
@@ -860,7 +911,16 @@ class IntegratedEphemeris(BuiltinEphemeris):
     def _build(self, wlo, whi):
         from scipy.interpolate import CubicSpline
 
-        tag = f"nbody_{int(wlo)}_{int(whi)}_v{_NBODY_VERSION}.npz"
+        ar = self._anchor_range()
+        anch = "a" if (ar is not None and wlo <= ar[0]
+                       and ar[1] <= whi) else ""
+        gc = self._stored_gcorr()
+        if gc:
+            import hashlib
+            h = hashlib.sha1(repr(sorted(gc.items())).encode()) \
+                .hexdigest()[:8]
+            anch += f"c{h}"
+        tag = f"nbody_{int(wlo)}_{int(whi)}_v{_NBODY_VERSION}{anch}.npz"
         path = os.path.join(self._cache_dir(), tag)
         grid = None
         states = None
@@ -874,7 +934,12 @@ class IntegratedEphemeris(BuiltinEphemeris):
             grid, states = self._integrate_window(wlo, whi)
             try:
                 os.makedirs(self._cache_dir(), exist_ok=True)
-                tmp = path + f".tmp{os.getpid()}"
+                # the tmp name must END in .npz: np.savez appends the
+                # suffix otherwise and the atomic rename then targets a
+                # file that does not exist (the disk cache silently
+                # never persisted — found as hundreds of orphaned
+                # *.tmpPID.npz files)
+                tmp = path + f".tmp{os.getpid()}.npz"
                 np.savez_compressed(tmp, grid=grid, states=states)
                 os.replace(tmp, path)
             except OSError:
@@ -891,9 +956,12 @@ class IntegratedEphemeris(BuiltinEphemeris):
             mjd, (mjd - _J2000_MJD) / 36525.0)
         return emb_p
 
-    def _base_ic(self, mjd0):
+    def _base_ic(self, mjd0, gcorr=None):
+        """Initial state from the analytic theory; ``gcorr`` maps a
+        planet name to its (dL_rad, da_frac) mean-element correction
+        (the giant-planet fit parameters of the anchored build)."""
         t = (mjd0 - _J2000_MJD) / 36525.0
-        helio = self._helio_all(np.array([t]))
+        gcorr = gcorr or {}
         pos = [np.zeros(3)]
         vel = [np.zeros(3)]
         for nm in _NBODY_NAMES[1:]:
@@ -904,12 +972,73 @@ class IntegratedEphemeris(BuiltinEphemeris):
                 pos.append(p[0])
                 vel.append((pp[0] - pm[0]) / (0.02 * DAY_S))
             else:
-                p, v = helio[nm]
+                dl, dafr = gcorr.get(nm, (0.0, 0.0))
+                p, v = _kepler_posvel_au(nm, np.array([t]), dl, dafr)
                 pos.append(_ecl_to_icrs(p)[0] * AU_KM * 1e3)
                 vel.append(_ecl_to_icrs(v)[0] * AU_KM * 1e3 / DAY_S)
         return np.array(pos), np.array(vel)
 
-    def _integrate_window(self, wlo, whi):
+    #: giant-planet mean-element corrections the anchored fit solves
+    #: for, as (planet, which) with which in {"dL" [rad], "da" [frac]}
+    _GIANT_PARAMS = (("jupiter", "dL"), ("jupiter", "da"),
+                     ("saturn", "dL"), ("saturn", "da"),
+                     ("uranus", "dL"))
+    #: finite-difference steps for the frozen sensitivity matrix:
+    #: EMB pos [m], EMB vel [m/s], then per _GIANT_PARAMS entry
+    _FIT_STEPS = [1e4] * 3 + [1e-3] * 3 + [1e-5, 1e-7, 1e-5, 1e-7, 1e-4]
+
+    def _anchor_emb_bary(self):
+        """(mjd_tdb, emb_pos_m) of the DE405 anchor table, converted
+        geocenter->EMB with the lunar series (mu*moon_geo ~ 4671 km, so
+        the series' ~50-100 km Moon error enters at only ~1 km)."""
+        from pint_tpu.data import de_anchor
+
+        mjd = np.asarray(de_anchor.MJD_TDB, np.float64)
+        t_cy = (mjd - _J2000_MJD) / 36525.0
+        mp_km, _ = _moon_geocentric_km(t_cy)
+        M = _ecl_date_to_icrs_matrix(t_cy)
+        mp = np.einsum("...ij,...j->...i", M, mp_km) * 1e3
+        return mjd, np.asarray(de_anchor.EARTH_POS_M, np.float64) \
+            + _MOON_FRAC * mp
+
+    @staticmethod
+    def _stored_gcorr():
+        """Giant-planet mean-element corrections from the baked-in
+        multi-dataset calibration (see :mod:`pint_tpu.ephemcal`), as a
+        {planet: (dL_rad, da_frac)} dict; empty when the calibration
+        data is absent or disabled (PINT_TPU_NO_EPHEMCAL=1)."""
+        if os.environ.get("PINT_TPU_NO_EPHEMCAL") == "1":
+            return {}
+        try:
+            from pint_tpu.data import ephem_calibration
+        except ImportError:
+            return {}
+        return dict(ephem_calibration.GIANT_CORRECTIONS)
+
+    def _integrate_window(self, wlo, whi, gcorr_base=None,
+                          free_giants=None):
+        """Integrate the window and fit the initial conditions.
+
+        Two regimes:
+
+        * **DE405-anchored** (the default whenever the window covers the
+          anchor table): the fit target is the table's 730 daily
+          BARYCENTRIC EMB positions — true JPL-ephemeris information.
+          Free parameters: the EMB state (6), optionally
+          mean-longitude/semi-major corrections for the giant planets
+          (``free_giants`` — these move the Sun-vs-SSB term, the
+          dominant error of the mean-element theory: measured ~1400 km
+          Earth-SSB error unanchored), and a constant frame offset (3,
+          absorbing bodies outside the 9-body system — Pluto alone
+          shifts the DE SSB by ~40 km).  When the baked-in calibration
+          supplies giant corrections (``gcorr_base``, default
+          `_stored_gcorr`), the giants are FIXED there — the
+          calibration fit them against multi-year sky-projected truth,
+          which a 2-year anchor cannot constrain in extrapolation.
+        * **analytic-anchored** (table absent/disabled/too far): the
+          fit target is the truncated-VSOP87 heliocentric EMB over the
+          whole window, EMB state only — the zero-data fallback.
+        """
         from scipy.integrate import solve_ivp
 
         gm = _nbody_gm()
@@ -918,11 +1047,41 @@ class IntegratedEphemeris(BuiltinEphemeris):
         grid = np.arange(wlo, whi + self._STEP / 2, self._STEP)
         ts = grid - mjd0
 
-        def run(dic):
-            pos, vel = self._base_ic(mjd0)
+        anchor = None
+        ar = self._anchor_range()
+        if ar is not None and wlo <= ar[0] and ar[1] <= whi:
+            anchor = self._anchor_emb_bary()
+
+        base = self._stored_gcorr() if gcorr_base is None else gcorr_base
+        if free_giants is None:
+            # The giants float ONLY in (opt-in) anchored builds: their
+            # Sun-vs-SSB error is quasi-static-but-rotating, which the
+            # 6 EMB dofs + offset cannot represent (measured in-window
+            # floor 218 km without them, 7 km with).  Anchored mode is
+            # an IN-WINDOW tool — a 2-year anchor cannot determine the
+            # giants' slow terms, so the fitted values must not be
+            # trusted in extrapolation (see _anchor_range).
+            free_giants = self._GIANT_PARAMS if anchor is not None \
+                else ()
+        if anchor is None:
+            free_giants = ()
+        ngiant = len(free_giants)
+        npar = 6 + ngiant
+        _giant_steps = dict(zip(self._GIANT_PARAMS,
+                                self._FIT_STEPS[6:]))
+        steps = list(self._FIT_STEPS[:6]) + \
+            [_giant_steps[g] for g in free_giants]
+
+        def run(theta):
+            gcorr = {nm: tuple(v) for nm, v in base.items()}
+            for (nm, which), v in zip(free_giants, theta[6:]):
+                dl, dafr = gcorr.get(nm, (0.0, 0.0))
+                gcorr[nm] = (dl + v, dafr) if which == "dL" else \
+                    (dl, dafr + v)
+            pos, vel = self._base_ic(mjd0, gcorr)
             pos, vel = pos.copy(), vel.copy()
-            pos[3] += dic[:3]
-            vel[3] += dic[3:]
+            pos[3] += theta[:3]
+            vel[3] += theta[3:6]
             mtot = gm.sum()
             pos -= (gm[:, None] * pos).sum(0) / mtot
             vel -= (gm[:, None] * vel).sum(0) / mtot
@@ -934,25 +1093,90 @@ class IntegratedEphemeris(BuiltinEphemeris):
                            t_eval=ts[ts < 0][::-1] * DAY_S, **kw)
             return np.concatenate([bw.y[:, ::-1], fw.y], axis=1).T
 
+        if anchor is not None:
+            from scipy.interpolate import CubicSpline
+
+            amjd, aemb = anchor
+
+            def predict(Y):
+                # barycentric EMB of the integration at anchor epochs
+                return CubicSpline(grid, Y[:, 9:12])(amjd)
+
+            # Hybrid fit target:
+            # * the anchor rows (sigma ~10 m), with the constant frame
+            #   offset profiled out EXACTLY (per-axis demean) — offset
+            #   and IC columns are near-degenerate for quasi-static
+            #   residuals, and an unscaled min-norm lstsq would split a
+            #   static shift across orbital dofs, matching it in-window
+            #   while swinging ~20x harder outside (measured: 74 km
+            #   static perturbation -> 1400 km 1.5 yr past the anchor);
+            # * the truncated-VSOP87 heliocentric EMB over the WHOLE
+            #   window at its own ~40 km truncation grade — a weak
+            #   tether that bounds extrapolation drift far from the
+            #   anchor (a 2-year perfect anchor alone EXTRAPOLATES
+            #   worse than fitting mediocre data everywhere: measured
+            #   190 -> 768 us median on the B1855 holdout).
+            # Anchor-dominant weights: anchored mode is OPT-IN for
+            # in-window DE-grade accuracy (see _anchor_range), so the
+            # anchor rows must win outright wherever they constrain;
+            # the VSOP tether only keeps the far field from running
+            # away (the two targets disagree systematically by
+            # ~1400 km — one trajectory cannot satisfy both, and
+            # balanced weights were measured to give the worst of both:
+            # 727 us in-window AND 272 us on the B1855 holdout).
+            ana = self._analytic_emb_helio(grid)
+            wa, wv = 1.0 / 10.0, 1.0 / 40e3     # [1/m]
+
+            def resid_vec(Y):
+                ra = predict(Y) - aemb
+                ra = ra - ra.mean(axis=0)
+                rv = (Y[:, 9:12] - Y[:, 0:3]) - ana
+                return np.concatenate([wa * ra.ravel(),
+                                       wv * rv.ravel()])
+
+            theta = np.zeros(npar)
+            J = None
+            for _ in range(3):
+                Y = run(theta)
+                r0 = resid_vec(Y)
+                if J is None:  # frozen sensitivity (near-linear)
+                    cols = []
+                    for k in range(npar):
+                        th2 = theta.copy()
+                        th2[k] += steps[k]
+                        cols.append((resid_vec(run(th2)) - r0)
+                                    / steps[k])
+                    J = np.column_stack(cols)
+                upd, *_ = np.linalg.lstsq(J, -r0, rcond=None)
+                theta = theta + upd
+            Y = run(theta)
+            # the frame offset is whatever constant remains vs DE405
+            off = -(predict(Y) - aemb).mean(axis=0)
+            nstate = 3 * len(_NBODY_NAMES)
+            states = Y[:, :nstate].copy()
+            # translate every body into the DE405 SSB frame
+            states += np.tile(off, len(_NBODY_NAMES))
+            return grid, states
+
         ana = self._analytic_emb_helio(grid)
         dic = np.zeros(6)
         J = None
         for _ in range(3):
-            Y = run(dic)
+            Y = run(np.concatenate([dic, np.zeros(ngiant)]))
             emb = Y[:, 9:12] - Y[:, 0:3]
             res = (emb - ana).ravel()
             if J is None:  # frozen sensitivity (the problem is near-linear)
                 J = np.zeros((res.size, 6))
-                steps = [1e4] * 3 + [1e-3] * 3
+                steps = self._FIT_STEPS[:6]
                 for k in range(6):
                     d2 = dic.copy()
                     d2[k] += steps[k]
-                    Yk = run(d2)
+                    Yk = run(np.concatenate([d2, np.zeros(ngiant)]))
                     J[:, k] = ((Yk[:, 9:12] - Yk[:, 0:3]) - emb).ravel() \
                         / steps[k]
             upd, *_ = np.linalg.lstsq(J, -res, rcond=None)
             dic = dic + upd
-        Y = run(dic)
+        Y = run(np.concatenate([dic, np.zeros(ngiant)]))
         nstate = 3 * len(_NBODY_NAMES)
         return grid, Y[:, :nstate]
 
@@ -984,6 +1208,121 @@ class IntegratedEphemeris(BuiltinEphemeris):
             return PosVel(splines[key](mjd),
                           splines[key](mjd, 1) / DAY_S)
         return super().posvel(body, mjd_tdb)
+
+
+# --- SPK writer ---------------------------------------------------------------
+
+#: (body, center) pairs written by write_spk, with their NAIF codes and
+#: Chebyshev record length [days] (the real DE kernels use the same
+#: chain topology: SSB->EMB->{Earth,Moon}, SSB->Sun, SSB->planet
+#: barys).  Records are 4 days for EVERY body, aligned to 4-day MJD
+#: boundaries: the integrated ephemeris serves cubic splines with
+#: 4-day knots (IntegratedEphemeris._STEP) on a 4-day-aligned grid, so
+#: knot-aligned records see an exactly-cubic source and the Chebyshev
+#: fit is exact — longer records straddle knots, where the source is
+#: only C^2 and high-order convergence collapses (measured: 74 km
+#: Mercury error with 8-day records vs sub-mm aligned).
+_WRITE_PAIRS = (
+    (("emb", "ssb"), (3, 0), 4.0),
+    (("earth", "emb"), (399, 3), 4.0),
+    (("moon", "emb"), (301, 3), 4.0),
+    (("sun", "ssb"), (10, 0), 4.0),
+    (("mercury", "ssb"), (1, 0), 4.0),
+    (("venus", "ssb"), (2, 0), 4.0),
+    (("mars", "ssb"), (4, 0), 4.0),
+    (("jupiter", "ssb"), (5, 0), 4.0),
+    (("saturn", "ssb"), (6, 0), 4.0),
+    (("uranus", "ssb"), (7, 0), 4.0),
+    (("neptune", "ssb"), (8, 0), 4.0),
+)
+
+
+def write_spk(path: str, eph, mjd_lo: float, mjd_hi: float,
+              ncoef: int = 13) -> str:
+    """Write a JPL-format SPK (``.bsp``) kernel from any ephemeris
+    object with a ``posvel(body, mjd_tdb)`` method — the inverse of
+    :class:`SPKEphemeris` (DAF + type-2 Chebyshev position segments,
+    little-endian).
+
+    This is how the builtin integrated ephemeris's "drop in a .bsp for
+    full precision" claim becomes testable without network access: a
+    kernel written from the integrator and read back through the SPK
+    path must reproduce the direct path exactly
+    (tests/test_spk_writer.py), so when a REAL ``de421.bsp`` is placed
+    in ``$PINT_TPU_EPHEM_DIR`` the same plumbing serves full JPL
+    precision.  Reference counterpart: the kernel files consumed via
+    jplephem in `solar_system_ephemerides.py:18-45`.
+    """
+    import struct
+
+    from numpy.polynomial import chebyshev as _cheb
+
+    # 4-day-aligned start (see _WRITE_PAIRS: knot alignment)
+    et_lo = mjd_tdb_to_et(4.0 * np.floor(mjd_lo / 4.0))
+    et_hi = mjd_tdb_to_et(mjd_hi)
+
+    segments = []  # (target, center, init, intlen, records)
+    for (body, center), (tcode, ccode), days in _WRITE_PAIRS:
+        intlen = days * DAY_S
+        n = int(np.ceil((et_hi - et_lo) / intlen))
+        init = et_lo
+        # Chebyshev-Gauss nodes per record; one batched posvel call
+        k = np.arange(ncoef)
+        nodes = np.cos(np.pi * (k + 0.5) / ncoef)[::-1]  # (-1, 1)
+        mids = init + (np.arange(n) + 0.5) * intlen
+        radius = intlen / 2.0
+        et = (mids[:, None] + nodes[None, :] * radius).ravel()
+        mjd = et / DAY_S + _J2000_MJD
+        p = eph.posvel(body, mjd).pos
+        if center != "ssb":
+            p = p - eph.posvel(center, mjd).pos
+        p_km = (p / 1e3).reshape(n, ncoef, 3)
+        recs = np.empty((n, 2 + 3 * ncoef))
+        recs[:, 0] = mids
+        recs[:, 1] = radius
+        for i in range(n):
+            # interpolation through the Gauss nodes (exact fit)
+            c = _cheb.chebfit(nodes, p_km[i], ncoef - 1)  # (ncoef, 3)
+            recs[i, 2:] = c.T.ravel()
+        segments.append((tcode, ccode, init, intlen, recs))
+
+    # --- DAF assembly (record = 1024 bytes = 128 f64 words) ------------
+    nd, ni = 2, 6
+    data_word = 3 * 128 + 1          # 1-based word address of record 4
+    seg_meta = []
+    blobs = []
+    w = data_word
+    for tcode, ccode, init, intlen, recs in segments:
+        n, rsize = recs.shape
+        words = np.concatenate(
+            [recs.ravel(), [init, intlen, float(rsize), float(n)]])
+        seg_meta.append((tcode, ccode, w, w + words.size - 1,
+                         init, init + n * intlen))
+        blobs.append(words)
+        w += words.size
+    free = w
+
+    fr = bytearray(1024)
+    fr[0:8] = b"DAF/SPK "
+    struct.pack_into("<ii", fr, 8, nd, ni)
+    fr[16:76] = b"pint_tpu write_spk".ljust(60)
+    struct.pack_into("<iii", fr, 76, 2, 2, free)
+    fr[88:96] = b"LTL-IEEE"
+
+    sr = bytearray(1024)
+    struct.pack_into("<ddd", sr, 0, 0.0, 0.0, float(len(seg_meta)))
+    ss = nd + (ni + 1) // 2          # summary size [words]
+    for k, (tc, cc, beg, end, e0, e1) in enumerate(seg_meta):
+        off = (3 + k * ss) * 8
+        struct.pack_into("<dd", sr, off, e0, e1)
+        struct.pack_into("<iiiiii", sr, off + 16, tc, cc, 1, 2, beg, end)
+    nr = bytearray(1024)
+
+    with open(path, "wb") as f:
+        f.write(bytes(fr) + bytes(sr) + bytes(nr))
+        for words in blobs:
+            f.write(np.asarray(words, "<f8").tobytes())
+    return path
 
 
 # --- loader -------------------------------------------------------------------

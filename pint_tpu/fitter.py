@@ -1262,8 +1262,10 @@ class Fitter:
         conditioning demands it (a kept eigenvalue within reach of the
         ~1e-11 device-assembly noise).  On the CPU backend the assembly
         is already exact, so no second pass ever runs."""
+        from pint_tpu.utils import effective_platform
+
         final = step(jnp.asarray(x), p, p_host=p_host)
-        if jax.default_backend() != "cpu" and \
+        if effective_platform() != "cpu" and \
                 float(final["e_min"]) < EXACT_COV_EMIN_FLOOR:
             profiling.count("exact_cov_pass")
             final = step(jnp.asarray(x), p, exact=True, p_host=p_host)

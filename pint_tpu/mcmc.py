@@ -47,12 +47,26 @@ class EnsembleResult(NamedTuple):
 
 
 def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
-                    a: float = 2.0, thin: int = 1) -> EnsembleResult:
+                    a: float = 2.0, thin: int = 1,
+                    checkpoint: str = None, checkpoint_every: int = 0,
+                    resume: bool = False) -> EnsembleResult:
     """Goodman-Weare stretch-move ensemble sampler, fully on device.
 
     ``x0``: (nwalkers, ndim) start positions (nwalkers even, >= 2*ndim
     recommended).  Returns the chain INCLUDING burn-in; slice it yourself.
+
+    Checkpoint/resume (reference `event_optimize --backend` HDF5 emcee
+    backend, `/root/reference/src/pint/scripts/event_optimize.py`):
+    with ``checkpoint`` set, the accumulated chain + sampler state is
+    written to that ``.npz`` atomically every ``checkpoint_every`` steps
+    (0 = only at the end); ``resume=True`` continues a matching
+    checkpoint from where it stopped.  The RNG key sequence is derived
+    from (seed, nsteps) and indexed by absolute step, so a killed and
+    resumed run reproduces the uninterrupted chain EXACTLY (bitwise on
+    a given backend) — asserted by tests/test_mcmc_resume.py.
     """
+    import os
+
     x0 = jnp.asarray(x0, jnp.float64)
     nw, nd = x0.shape
     if nw % 2 or nw < 4:
@@ -89,17 +103,64 @@ def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
         return (x, lnp), (x, lnp, nacc)
 
     keys = jax.random.split(jax.random.PRNGKey(seed), nsteps)
-    lnp0 = vln(x0)
 
     @jax.jit
     def run(x0, lnp0, keys):
         (_, _), (chain, lnps, nacc) = jax.lax.scan(step, (x0, lnp0), keys)
         return chain, lnps, jnp.sum(nacc)
 
-    chain, lnps, nacc = run(x0, lnp0, keys)
-    chain = np.asarray(chain[::thin])
-    lnps = np.asarray(lnps[::thin])
-    return EnsembleResult(chain, lnps, float(nacc) / (nsteps * nw))
+    chains, lnplist = [], []
+    nacc_total = 0.0
+    start = 0
+    truncated = False
+    x, lnp = x0, None
+    if resume and checkpoint and os.path.exists(checkpoint):
+        with np.load(checkpoint) as f:
+            if int(f["seed"]) != seed or f["chain"].shape[1:] != (nw, nd):
+                raise ValueError(
+                    f"checkpoint {checkpoint} does not match this "
+                    "sampler configuration (seed/walkers/ndim)")
+            start = min(int(f["steps_done"]), nsteps)
+            truncated = int(f["steps_done"]) > nsteps
+            chains = [f["chain"][:start]]
+            lnplist = [f["lnpost"][:start]]
+            nacc_total = float(f["nacc"])
+            x = jnp.asarray(f["x_last"])
+            lnp = jnp.asarray(f["lnp_last"])
+    if lnp is None:
+        lnp = vln(x0)   # lazily: a resumed run restores it instead
+
+    def _save():
+        if not checkpoint:
+            return
+        tmp = checkpoint + f".tmp{os.getpid()}.npz"
+        np.savez_compressed(
+            tmp, chain=np.concatenate(chains) if chains else
+            np.zeros((0, nw, nd)),
+            lnpost=np.concatenate(lnplist) if lnplist else
+            np.zeros((0, nw)),
+            nacc=nacc_total, steps_done=k, seed=seed,
+            x_last=np.asarray(x), lnp_last=np.asarray(lnp))
+        os.replace(tmp, checkpoint)
+
+    k = start
+    chunk = checkpoint_every if (checkpoint and checkpoint_every) \
+        else nsteps
+    while k < nsteps:
+        k2 = min(nsteps, k + chunk)
+        c, lp, nacc = run(x, lnp, keys[k:k2])
+        x, lnp = c[-1], lp[-1]
+        chains.append(np.asarray(c))
+        lnplist.append(np.asarray(lp))
+        nacc_total += float(nacc)
+        k = k2
+        _save()
+    chain = np.concatenate(chains)
+    lnps = np.concatenate(lnplist)
+    # a checkpoint truncated to fewer steps than it recorded cannot
+    # attribute its acceptance count to the kept prefix
+    acc = float("nan") if truncated else nacc_total / (nsteps * nw)
+    return EnsembleResult(chain[::thin], lnps[::thin], acc)
 
 
 class HMCResult(NamedTuple):

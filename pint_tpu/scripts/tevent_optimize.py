@@ -73,6 +73,14 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--outfile", default=None,
                         help="write the post-fit par here")
+    parser.add_argument("--backend-file", default=None,
+                        help="chain checkpoint .npz (reference "
+                             "event_optimize --backend analogue)")
+    parser.add_argument("--checkpoint-every", type=int, default=100,
+                        help="steps between checkpoint writes")
+    parser.add_argument("--resume", action="store_true",
+                        help="continue from --backend-file; reproduces "
+                             "the uninterrupted chain exactly")
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args(argv)
     if args.quiet:
@@ -106,7 +114,10 @@ def main(argv=None):
     nw = args.nwalkers + (args.nwalkers % 2)
     dx0 = rng.standard_normal((nw, bt.nparams)) * \
         bt.scales()[None, :] * 0.1
-    res = ensemble_sample(lnpost, dx0, args.nsteps, seed=args.seed)
+    res = ensemble_sample(lnpost, dx0, args.nsteps, seed=args.seed,
+                          checkpoint=args.backend_file,
+                          checkpoint_every=args.checkpoint_every,
+                          resume=args.resume)
     flat = res.chain[args.burn:].reshape(-1, bt.nparams)
     refs = bt.start_point()
     print(f"acceptance {res.acceptance:.2f}")

@@ -1,0 +1,176 @@
+"""Per-component derivative oracle (VERDICT r3 item 7): one
+parametrized sweep checking the jacfwd design-matrix column of every
+component family's free parameters against central finite differences
+of the residual function — the autodiff analogue of the reference's
+registry-wide derivative validation
+(`/root/reference/src/pint/models/timing_model.py:2231`,
+`tests/test_derivative_utils.py`), which tests every registered
+``d_delay_d_param``/``d_phase_d_param`` numerically.
+
+Each case is a minimal model exposing the component's parameters as the
+ONLY free parameters, so a wrong derivative cannot hide behind a strong
+column from another component.  The noise-ML gradient (autodiff of the
+jitted lnlikelihood, used by the downhill noise fits) is swept the same
+way at the end.
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import build_resid_sec_fn, build_noise_lnlike
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+pytestmark = pytest.mark.slow
+
+BASE = """
+PSR DERIVSWEEP
+RAJ 07:40:45.79
+DECJ 66:20:33.5
+F0 346.53199992
+F1 -1.46e-15
+PEPOCH 55000
+POSEPOCH 55000
+DM 14.96
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+DDK_EXTRA = """
+PMRA -15.0
+PMDEC 8.0
+PX 1.5
+BINARY DDK
+PB 7.75
+A1 9.23
+T0 55000.2
+ECC 0.05
+OM 75.0
+M2 0.3
+KIN 70.0 1
+KOM 40.0 1
+K96 1
+"""
+
+DDGR_EXTRA = """
+BINARY DDGR
+PB 0.10225156248
+A1 1.415032
+T0 55000.05
+ECC 0.0877775
+OM 87.0331
+M2 1.2489 1
+MTOT 2.58708 1
+"""
+
+#: (case id, extra par lines, free params, FD step per param)
+CASES = [
+    ("spindown", "F2 1e-26 1\n", {"F2": 1e-28}),
+    ("astrometry_pm", "PMRA -3.0 1\nPMDEC 2.0 1\nPX 0.9 1\n",
+     {"PMRA": 1e-3, "PMDEC": 1e-3, "PX": 1e-3}),
+    ("dispersion", "DM1 1e-3 1\nDM2 1e-5 1\n",
+     {"DM1": 1e-4, "DM2": 1e-5}),
+    ("dmx", "DMX 6.0\nDMX_0001 1e-3 1\nDMXR1_0001 54800\n"
+     "DMXR2_0001 55200\n", {"DMX_0001": 1e-6}),
+    ("solar_wind", "NE_SW 8.0 1\nSWM 0\n", {"NE_SW": 1e-3}),
+    ("solar_wind_swm1", "NE_SW 8.0 1\nSWM 1\nSWP 2.2 1\n",
+     {"NE_SW": 1e-3, "SWP": 1e-3}),
+    ("chromatic", "CM 0.02 1\nTNCHROMIDX 4\n", {"CM": 1e-3}),
+    ("fd", "FD1 1e-5 1\nFD2 -2e-6 1\n", {"FD1": 1e-8, "FD2": 1e-8}),
+    ("fdjump", "FD1 1e-5\nFD1JUMP -fe 430 2e-5 1\n",
+     {"FD1JUMP1": 1e-8}),
+    ("glitch", "GLEP_1 55000\nGLPH_1 0.2 1\nGLF0_1 1e-7 1\n"
+     "GLF0D_1 1e-8 1\nGLTD_1 20 1\n",
+     {"GLPH_1": 1e-5, "GLF0_1": 1e-11, "GLF0D_1": 1e-11,
+      "GLTD_1": 1e-4}),
+    # WAVE<i>/IFUNC<i> are pair parameters: data-bearing, not
+    # fit-vector members (same stance as the reference's
+    # pairParameters); their physics is covered functionally in
+    # test_components.py.  The fittable red-noise-whitening surface is
+    # WaveX below.
+    ("wavex", "WXEPOCH 55000\nWXFREQ_0001 0.005\nWXSIN_0001 1e-6 1\n"
+     "WXCOS_0001 -1e-6 1\n", {"WXSIN_0001": 1e-9, "WXCOS_0001": 1e-9}),
+    ("jump", "JUMP -fe 430 1e-4 1\n", {"JUMP1": 1e-7}),
+    ("phase_offset", "PHOFF 0.01 1\n", {"PHOFF": 1e-6}),
+    ("troposphere", "CORRECT_TROPOSPHERE Y\nPX 0.9 1\n", {"PX": 1e-3}),
+    ("ddk", DDK_EXTRA, {"KIN": 1e-4, "KOM": 1e-4}),
+    ("ddgr", DDGR_EXTRA, {"M2": 1e-7, "MTOT": 1e-8}),
+]
+
+
+def _build(extra, ntoas=24):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model((BASE + extra).strip().splitlines())
+        toas = make_fake_toas_uniform(
+            54700, 55300, ntoas, m, obs="gbt", error_us=1.0,
+            freq_mhz=np.tile([1400.0, 430.0], (ntoas + 1) // 2)[:ntoas],
+            add_noise=True, seed=9)
+        # receiver flags for the mask-selected components (-fe groups)
+        for k, f in enumerate(toas.flags):
+            f["fe"] = "430" if k % 2 else "1400"
+    return m, toas
+
+
+@pytest.mark.parametrize("case,extra,steps",
+                         [(c, e, s) for c, e, s in CASES],
+                         ids=[c for c, _, _ in CASES])
+def test_jacfwd_matches_fd(case, extra, steps):
+    m, toas = _build(extra)
+    r = Residuals(toas, m)
+    names = list(steps)
+    assert set(names) <= set(m.free_params), (names, m.free_params)
+    rf = build_resid_sec_fn(m, r.batch, names, r.track_mode)
+    p = r.pdict
+    x0 = np.zeros(len(names))
+    J = np.asarray(jax.jit(jax.jacfwd(rf))(x0, p))
+    rf_j = jax.jit(rf)
+    for i, name in enumerate(names):
+        scale = np.max(np.abs(J[:, i])) + 1e-30
+        # adaptive step: target ~3e-7 s of residual change — far above
+        # the quad-single rounding floor (~1e-9 s), far below a pulse
+        # period (device units vary by ~20 orders across parameters, so
+        # fixed steps cannot work; the jacobian's own scale sets h, and
+        # an order-of-magnitude-wrong jacobian still lands the FD in a
+        # measurable regime where the mismatch shows)
+        h = min(3e-7 / scale, steps[name])
+        e = np.zeros(len(names))
+        e[i] = h
+        num = (np.asarray(rf_j(x0 + e, p)) -
+               np.asarray(rf_j(x0 - e, p))) / (2 * h)
+        err = np.max(np.abs(num - J[:, i])) / scale
+        # tolerance: linearization grade + the quad-single rounding
+        # floor (~1e-9 s) propagated through the FD division
+        tol = 2e-3 + 5e-9 / (h * scale)
+        assert err < tol, \
+            f"{case}.{name}: rel deriv err {err:.2e} (tol {tol:.2e})"
+
+
+def test_noise_lnlike_grad_matches_fd():
+    """Autodiff gradient of the noise ML objective (EFAC/EQUAD/red
+    amplitude) vs central differences — the derivative the downhill
+    noise fits trust."""
+    extra = ("EFAC -fe 1400 1.2 1\nEQUAD -fe 1400 0.5 1\n"
+             "TNREDAMP -13.5 1\nTNREDGAM 3.1\nTNREDC 5\n")
+    m, toas = _build(extra, ntoas=30)
+    r = Residuals(toas, m)
+    names = [n for n in m.free_params]
+    lnl = build_noise_lnlike(m, r.batch, names, r.track_mode)
+    g = jax.jit(jax.grad(lnl))
+    p = r.pdict
+    x0 = np.zeros(len(names))
+    g0 = np.asarray(g(x0, p))
+    for i, name in enumerate(names):
+        h = 1e-5
+        e = np.zeros(len(names))
+        e[i] = h
+        num = (float(lnl(x0 + e, p)) - float(lnl(x0 - e, p))) / (2 * h)
+        denom = max(abs(num), abs(g0[i]), 1e-12)
+        assert abs(num - g0[i]) / denom < 2e-3, \
+            f"{name}: grad {g0[i]} vs fd {num}"
