@@ -1,0 +1,287 @@
+"""Interactive plk-style residual workbench on bare matplotlib.
+
+Reference: `pintk`'s plk panel (`/root/reference/src/pint/pintk/plk.py`,
+a 1.8k-LoC Tkinter embedding).  This re-architecture drops the Tk layer
+entirely and drives a plain matplotlib Figure with event handlers, which
+buys two things the reference design cannot offer here:
+
+* it runs on ANY matplotlib backend — an interactive desktop backend
+  gives the click-select/fit/undo workflow of tempo2's plk, while the
+  Agg backend gives the same object headlessly (plots to files); and
+* it is fully TESTABLE without a display: the test suite synthesizes
+  matplotlib button/key events against an Agg canvas and asserts the
+  state machine (`tests/test_plk.py`) — the reference's GUI logic has
+  no headless coverage at all.
+
+Workflow (keys mirror plk's):
+
+=========  ========================================================
+click      select nearest TOA (shift-click adds to the selection)
+drag       rubber-band a time range into the selection
+``f``      fit the current (non-deleted) TOAs, replot post-fit
+``u``      undo the last fit/delete (full model + state restore)
+``d``      delete the selected TOAs (excluded from later fits)
+``c``      clear the selection
+``r``      reset everything (model, deletions, selection)
+``w``      write ``plk.par`` (post-fit model)
+=========  ========================================================
+
+The scripted entry point is ``tpintk --gui``; library use::
+
+    from pint_tpu.plk import PlkPanel
+    panel = PlkPanel(parfile, timfile)
+    panel.show()        # interactive backends; omit under Agg
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["PlkPanel", "run_auto_fit"]
+
+
+def run_auto_fit(toas, model, maxiter=None):
+    """Auto-fitter run + the standard status line — the ONE fit path
+    shared by the plk panel and the tpintk REPL."""
+    from pint_tpu.fitter import Fitter
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fitter = Fitter.auto(toas, model)
+        kw = {"maxiter": maxiter} if maxiter else {}
+        chi2 = fitter.fit_toas(**kw)
+    r = fitter.resids
+    msg = (f"{type(fitter).__name__}: chi2={chi2:.2f} dof={r.dof} "
+           f"rms={r.rms_weighted() * 1e6:.3f} us")
+    return fitter, msg
+
+
+class PlkPanel:
+    """plk state machine bound to a matplotlib figure."""
+
+    def __init__(self, parfile: str, timfile: str, fig=None):
+        import matplotlib.pyplot as plt
+
+        from pint_tpu.models import get_model
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.toa import get_TOAs
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            self.model = get_model(parfile)
+            self.toas = get_TOAs(timfile, model=self.model)
+        self.parfile = parfile
+        n = self.toas.ntoas
+        self.selected = np.zeros(n, bool)
+        self.deleted = np.zeros(n, bool)
+        self.fitter = None
+        self.postfit: Optional[np.ndarray] = None
+        #: undo stack of (par-values snapshot, deleted mask, postfit)
+        self._undo: List[tuple] = []
+        self.message = ""
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            self.prefit = Residuals(self.toas, self.model)
+        self.mjds = np.asarray(self.prefit.batch.tdbld)
+        self.errs_us = np.asarray(self.prefit.get_data_error())
+        self.fig = fig if fig is not None else plt.figure(figsize=(10, 6))
+        self.ax = self.fig.add_subplot(111)
+        self._press_px = None
+        # our key bindings ('f','r','c',...) collide with matplotlib's
+        # default navigation keymap (fullscreen/home/back) on
+        # interactive backends; detach the default handler
+        try:
+            mgr = self.fig.canvas.manager
+            if mgr is not None and getattr(mgr, "key_press_handler_id",
+                                           None) is not None:
+                self.fig.canvas.mpl_disconnect(mgr.key_press_handler_id)
+        except Exception:
+            pass
+        self.fig.canvas.mpl_connect("button_press_event", self._on_press)
+        self.fig.canvas.mpl_connect("button_release_event",
+                                    self._on_release)
+        self.fig.canvas.mpl_connect("key_press_event", self._on_key)
+        self.replot()
+
+    # -- state snapshots ---------------------------------------------------
+    def _snapshot(self):
+        vals = {n: (self.model[n].value, self.model[n].uncertainty)
+                for n in self.model.params
+                if self.model[n].value is not None}
+        self._undo.append((vals, self.deleted.copy(),
+                           None if self.postfit is None
+                           else self.postfit.copy()))
+
+    def _restore(self, vals):
+        for n, (v, u) in vals.items():
+            try:
+                self.model[n].value = v
+                self.model[n].uncertainty = u
+            except Exception:
+                pass
+
+    def undo(self):
+        """Restore the state before the last fit/delete (plk 'u')."""
+        if not self._undo:
+            self.message = "nothing to undo"
+            return
+        vals, deleted, postfit = self._undo.pop()
+        self._restore(vals)
+        self.deleted = deleted
+        self.postfit = postfit
+        self.fitter = None
+        self.message = "undone"
+        self.replot()
+
+    # -- actions -----------------------------------------------------------
+    def fit(self, maxiter: Optional[int] = None):
+        """Fit the non-deleted TOAs (plk 'f')."""
+        keep = ~self.deleted
+        if not keep.any():
+            self.message = "no TOAs left to fit"
+            self.replot()
+            return
+        self._snapshot()
+        toas = self.toas.select(keep) if self.deleted.any() else self.toas
+        try:
+            self.fitter, self.message = run_auto_fit(toas, self.model,
+                                                     maxiter)
+        except Exception as e:
+            self._undo.pop()   # a failed fit must not leave an entry
+            self.message = f"fit failed: {type(e).__name__}: {e}"
+            self.replot()
+            return
+        full = np.full(self.toas.ntoas, np.nan)
+        full[keep] = np.asarray(self.fitter.resids.time_resids)
+        self.postfit = full
+        self.replot()
+
+    def delete_selected(self):
+        """Remove the selected TOAs from subsequent fits (plk 'd')."""
+        if not self.selected.any():
+            self.message = "nothing selected"
+            return
+        self._snapshot()
+        self.deleted |= self.selected
+        self.selected[:] = False
+        self.message = f"{int(self.deleted.sum())} TOA(s) deleted"
+        self.replot()
+
+    def clear_selection(self):
+        self.selected[:] = False
+        self.message = "selection cleared"
+        self.replot()
+
+    def reset(self):
+        """Back to the loaded par/tim (plk 'r')."""
+        if self._undo:
+            vals, _, _ = self._undo[0]  # oldest snapshot = loaded state
+            self._undo.clear()
+            self._restore(vals)
+        self.deleted[:] = False
+        self.selected[:] = False
+        self.postfit = None
+        self.fitter = None
+        self.message = "reset"
+        self.replot()
+
+    def write_par(self, path: str = "plk.par") -> str:
+        self.model.write_parfile(path)
+        self.message = f"wrote {path}"
+        return path
+
+    # -- event handlers ----------------------------------------------------
+    def _nav_active(self):
+        """True while a toolbar tool (pan/zoom) owns the mouse."""
+        tb = getattr(self.fig.canvas, "toolbar", None)
+        return bool(tb is not None and getattr(tb, "mode", ""))
+
+    def _on_press(self, event):
+        from matplotlib.backend_bases import MouseButton
+
+        if (event.inaxes is not self.ax or event.xdata is None
+                or self._nav_active()
+                or event.button != MouseButton.LEFT):
+            return
+        self._press_px = (event.x, event.xdata)
+
+    def _on_release(self, event):
+        if self._press_px is None or event.xdata is None \
+                or self._nav_active():
+            self._press_px = None
+            return
+        px0, x0 = self._press_px
+        x1 = event.xdata
+        self._press_px = None
+        add = bool(getattr(event, "key", None) == "shift")
+        if abs(event.x - px0) > 5:  # drag beyond click jitter [pixels]
+            lo, hi = sorted((x0, x1))
+            sel = (self.mjds >= lo) & (self.mjds <= hi) & ~self.deleted
+            self.selected = (self.selected | sel) if add else sel
+            self.message = f"{int(self.selected.sum())} TOA(s) selected"
+        else:  # click: nearest TOA in DISPLAY space (co-epoch TOAs at
+            # different residuals must be individually pickable)
+            alive = ~self.deleted
+            if not alive.any():
+                return
+            r_us, _ = self._current_resids_us()
+            pts = self.ax.transData.transform(
+                np.column_stack([self.mjds[alive],
+                                 np.nan_to_num(r_us[alive])]))
+            d2 = (pts[:, 0] - event.x) ** 2 + (pts[:, 1] - event.y) ** 2
+            i = int(np.flatnonzero(alive)[np.argmin(d2)])
+            if not add:
+                self.selected[:] = False
+            self.selected[i] = ~self.selected[i] if add else True
+            self.message = f"TOA {i} @ MJD {self.mjds[i]:.4f}"
+        self.replot()
+
+    def _on_key(self, event):
+        key = (event.key or "").lower()
+        if key == "f":
+            self.fit()
+        elif key == "u":
+            self.undo()
+        elif key == "d":
+            self.delete_selected()
+        elif key == "c":
+            self.clear_selection()
+        elif key == "r":
+            self.reset()
+        elif key == "w":
+            self.write_par()
+            self.replot()
+
+    # -- drawing -----------------------------------------------------------
+    def _current_resids_us(self):
+        if self.postfit is not None:
+            return self.postfit * 1e6, "post-fit"
+        return np.asarray(self.prefit.time_resids) * 1e6, "pre-fit"
+
+    def replot(self):
+        r_us, label = self._current_resids_us()
+        ax = self.ax
+        ax.clear()
+        alive = ~self.deleted
+        ax.errorbar(self.mjds[alive], r_us[alive],
+                    yerr=self.errs_us[alive], fmt=".", ms=4, lw=0.7,
+                    color="#46769c", ecolor="#b8c8d8", zorder=2)
+        if self.selected.any():
+            s = self.selected & alive
+            ax.plot(self.mjds[s], r_us[s], "o", ms=7, mfc="none",
+                    mec="#c25b4e", mew=1.5, zorder=3)
+        ax.axhline(0.0, color="0.75", lw=0.8, zorder=1)
+        ax.set_xlabel("MJD (TDB)")
+        ax.set_ylabel(f"{label} residual [us]")
+        psr = getattr(self.model, "PSR", None)
+        name = psr.value if psr is not None and psr.value else "pulsar"
+        ax.set_title(f"{name} — {label}   {self.message}", fontsize=10)
+        self.fig.canvas.draw_idle()
+
+    def show(self):  # pragma: no cover - needs an interactive backend
+        import matplotlib.pyplot as plt
+
+        plt.show()

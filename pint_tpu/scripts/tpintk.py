@@ -45,15 +45,12 @@ class PintkSession:
 
     # -- commands ----------------------------------------------------------
     def cmd_fit(self, maxiter: str = "") -> str:
-        from pint_tpu.fitter import Fitter
+        from pint_tpu.plk import run_auto_fit
 
-        self.fitter = Fitter.auto(self.toas, self.model)
-        kw = {"maxiter": int(maxiter)} if maxiter else {}
-        chi2 = self.fitter.fit_toas(**kw)
+        self.fitter, msg = run_auto_fit(
+            self.toas, self.model, int(maxiter) if maxiter else None)
         self.postfit = self.fitter.resids
-        r = self.postfit
-        return (f"{type(self.fitter).__name__}: chi2={chi2:.2f} "
-                f"dof={r.dof} rms={r.rms_weighted()*1e6:.3f} us")
+        return msg
 
     def cmd_plot(self, outfile: str = "tpintk.png") -> str:
         import matplotlib
@@ -148,10 +145,21 @@ def main(argv=None):
     parser.add_argument("timfile")
     parser.add_argument("--command", "-c", action="append", default=None,
                         help="run this command and exit (repeatable)")
+    parser.add_argument("--gui", action="store_true",
+                        help="open the interactive plk panel "
+                             "(matplotlib; needs an interactive "
+                             "backend/display)")
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args(argv)
     if args.quiet:
         warnings.filterwarnings("ignore")
+
+    if args.gui:
+        from pint_tpu.plk import PlkPanel
+
+        panel = PlkPanel(args.parfile, args.timfile)
+        panel.show()
+        return 0
 
     sess = PintkSession(args.parfile, args.timfile)
     print(f"Loaded {sess.toas.ntoas} TOAs; free params: "

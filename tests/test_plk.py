@@ -1,0 +1,134 @@
+"""The plk panel's interaction state machine, driven headlessly by
+synthesizing matplotlib events against an Agg canvas — click-select,
+rubber-band range select, fit, delete, undo, reset (the workflow of
+`/root/reference/src/pint/pintk/plk.py`, whose Tk-bound logic has no
+display-free coverage at all)."""
+
+import os
+import warnings
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+from matplotlib.backend_bases import KeyEvent, MouseButton, MouseEvent
+
+from pint_tpu.plk import PlkPanel
+
+pytestmark = pytest.mark.slow
+
+REFDATA = "/root/reference/tests/datafile"
+needs_data = pytest.mark.skipif(
+    not os.path.isdir(REFDATA), reason="reference datafiles not present")
+
+
+@pytest.fixture(scope="module")
+def panel():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return PlkPanel(os.path.join(REFDATA, "NGC6440E.par"),
+                        os.path.join(REFDATA, "NGC6440E.tim"))
+
+
+def _xy(panel, mjd, y_us=0.0):
+    """Display coordinates of (mjd, y_us) on the panel's axes."""
+    return panel.ax.transData.transform((mjd, y_us))
+
+
+def _click_toa(panel, i, key=None):
+    """Click directly on TOA i's plotted point (2-D picking)."""
+    r_us, _ = panel._current_resids_us()
+    _click(panel, float(panel.mjds[i]), key=key,
+           y_us=float(np.nan_to_num(r_us[i])))
+
+
+def _click(panel, mjd, key=None, y_us=0.0):
+    x, y = _xy(panel, mjd, y_us)
+    canvas = panel.fig.canvas
+    down = MouseEvent("button_press_event", canvas, x, y,
+                      MouseButton.LEFT, key=key)
+    panel._on_press(down)
+    up = MouseEvent("button_release_event", canvas, x, y,
+                    MouseButton.LEFT, key=key)
+    panel._on_release(up)
+
+
+def _drag(panel, mjd0, mjd1):
+    canvas = panel.fig.canvas
+    x0, y0 = _xy(panel, mjd0)
+    x1, y1 = _xy(panel, mjd1)
+    panel._on_press(MouseEvent("button_press_event", canvas, x0, y0,
+                               MouseButton.LEFT))
+    panel._on_release(MouseEvent("button_release_event", canvas, x1, y1,
+                                 MouseButton.LEFT))
+
+
+def _key(panel, k):
+    panel._on_key(KeyEvent("key_press_event", panel.fig.canvas, k))
+
+
+@needs_data
+def test_click_selects_nearest(panel):
+    panel.reset()
+    _click_toa(panel, 10)
+    assert panel.selected.sum() == 1
+    assert panel.selected[10]
+    # shift-click adds
+    _click_toa(panel, 20, key="shift")
+    assert panel.selected.sum() == 2
+    _key(panel, "c")
+    assert not panel.selected.any()
+
+
+@needs_data
+def test_drag_range_selects(panel):
+    panel.reset()
+    lo, hi = np.percentile(panel.mjds, [10, 40])
+    _drag(panel, lo, hi)
+    expect = (panel.mjds >= min(lo, hi)) & (panel.mjds <= max(lo, hi))
+    assert panel.selected.sum() == expect.sum() > 0
+
+
+@needs_data
+def test_fit_delete_undo_cycle(panel):
+    panel.reset()
+    f0_before = float(panel.model.F0.value)
+    _key(panel, "f")                       # fit
+    assert panel.postfit is not None
+    assert "chi2" in panel.message
+    f0_fit = float(panel.model.F0.value)
+    rms_all = np.nanstd(panel.postfit)
+
+    # delete a TOA and fit again: the deleted row must be excluded
+    _click_toa(panel, 0)
+    _key(panel, "d")
+    assert panel.deleted.sum() == 1
+    _key(panel, "f")
+    assert np.isnan(panel.postfit[np.flatnonzero(panel.deleted)[0]])
+
+    # undo twice: back past the delete to the first post-fit state
+    _key(panel, "u")
+    assert panel.deleted.sum() == 1        # undid the 2nd fit
+    _key(panel, "u")
+    assert panel.deleted.sum() == 0        # undid the delete
+    _key(panel, "u")
+    assert float(panel.model.F0.value) == pytest.approx(f0_before,
+                                                        abs=0.0)
+    # reset clears everything
+    _key(panel, "f")
+    _key(panel, "r")
+    assert panel.postfit is None and not panel.deleted.any()
+    assert float(panel.model.F0.value) == pytest.approx(f0_before,
+                                                        abs=0.0)
+    assert rms_all == rms_all              # fit ran and produced numbers
+
+
+@needs_data
+def test_write_par(panel, tmp_path):
+    panel.reset()
+    _key(panel, "f")
+    out = panel.write_par(str(tmp_path / "plk.par"))
+    text = open(out).read()
+    assert "F0" in text and "PSR" in text
