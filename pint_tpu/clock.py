@@ -193,15 +193,36 @@ _cache: dict = {}
 
 
 def clock_search_dirs() -> List[str]:
+    from pint_tpu.clockcorr import clock_cache_dir
+
     dirs = []
-    for env, sub in (("PINT_TPU_CLOCK_DIR", ""), ("PINT_CLOCK_OVERRIDE", ""),
-                     ("TEMPO2", "clock"), ("TEMPO", "clock")):
+    for env, sub in (("PINT_TPU_CLOCK_DIR", ""),
+                     ("PINT_CLOCK_OVERRIDE", "")):
         v = os.environ.get(env)
         if v:
-            dirs.append(os.path.join(v, sub) if sub else v)
+            dirs.append(v)
+    # the global-repository download cache (pint_tpu.clockcorr) comes
+    # BEFORE any TEMPO/TEMPO2 install dirs: freshly downloaded IPTA
+    # corrections must not be shadowed by a stale env installation
+    # (explicit PINT_TPU_CLOCK_DIR/PINT_CLOCK_OVERRIDE still win above)
+    cache = clock_cache_dir()
+    if cache not in dirs:
+        dirs.append(cache)
+    for env, sub in (("TEMPO2", "clock"), ("TEMPO", "clock")):
+        v = os.environ.get(env)
+        if v:
+            dirs.append(os.path.join(v, sub))
     dirs.append(os.path.join(os.path.dirname(__file__), "data", "clock"))
     dirs.append(os.getcwd())
     return dirs
+
+
+def reset_cache() -> None:
+    """Forget cached clock-file lookups (including cached MISSES) and
+    one-time warnings — called by `pint_tpu.clockcorr.update_clock_files`
+    so fresh downloads are picked up within the same process."""
+    _cache.clear()
+    _warned.clear()
 
 
 def find_clock_file(name: str, fmt="tempo", obscode=None, limits="warn",
@@ -230,8 +251,9 @@ def find_clock_file(name: str, fmt="tempo", obscode=None, limits="warn",
     cf = _cache[key]
     if cf is None:
         msg = (f"Clock file {name!r} not found in {clock_search_dirs()} — "
-               f"this zero-network environment cannot download it (the reference "
-               f"fetches it from the IPTA repository); corrections treated as 0.")
+               f"run pint_tpu.clockcorr.update_clock_files() where the "
+               f"IPTA repository is reachable (this environment has no "
+               f"network); corrections treated as 0.")
         if limits == "error":
             raise ClockCorrectionError(msg)
         if name not in _warned:

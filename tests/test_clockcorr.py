@@ -1,0 +1,161 @@
+"""The global clock-correction client (pint_tpu.clockcorr), exercised
+end-to-end against a LOOPBACK HTTP server — the full download / index /
+expiry / fallback machinery runs with zero egress, so the only thing
+real use adds is a reachable URL (reference analogue:
+`pint.observatory.global_clock_corrections`, which has no offline
+coverage of its download path)."""
+
+import http.server
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu import clockcorr
+
+INDEX = """# File                          update  invalid-before
+T2runtime/clock/gps2utc.clk     7.0     ---   GPS to UTC
+tempo/clock/time_fake.dat       30.0    2020-01-01  a tempo-format file
+"""
+
+GPS2UTC = """# UTC(GPS) UTC
+50000.0 1.0e-6
+51000.0 3.0e-6
+"""
+
+TIME_FAKE = """   MJD       EECO-REF    NIST-REF NS      DATE    COMMENTS
+=========    ========    ======== ==    ========  ========
+ 50000.00       0.000       2.000 1
+ 51000.00       0.000       4.000 1
+"""
+
+
+@pytest.fixture(scope="module")
+def repo(tmp_path_factory):
+    """A loopback 'IPTA repository' serving index + clock files."""
+    root = tmp_path_factory.mktemp("ipta")
+    (root / "T2runtime" / "clock").mkdir(parents=True)
+    (root / "tempo" / "clock").mkdir(parents=True)
+    (root / "index.txt").write_text(INDEX)
+    (root / "T2runtime" / "clock" / "gps2utc.clk").write_text(GPS2UTC)
+    (root / "tempo" / "clock" / "time_fake.dat").write_text(TIME_FAKE)
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=str(root), **kw)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/", root
+    srv.shutdown()
+
+
+def test_index_parses(repo, tmp_path):
+    url, _ = repo
+    idx = clockcorr.Index(url_base=url, cache_dir=str(tmp_path))
+    assert set(idx.files) == {"gps2utc.clk", "time_fake.dat"}
+    e = idx.files["time_fake.dat"]
+    assert e.update_interval_days == 30.0
+    assert e.invalid_if_older_than is not None
+    assert idx.files["gps2utc.clk"].invalid_if_older_than is None
+
+
+def test_update_and_parse_through_clock_layer(repo, tmp_path,
+                                              monkeypatch):
+    url, _ = repo
+    cache = tmp_path / "clockcache"
+    monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(cache))
+    paths = clockcorr.update_clock_files(url_base=url)
+    assert {os.path.basename(p) for p in paths} == \
+        {"gps2utc.clk", "time_fake.dat"}
+    # downloads land on the search path and parse through ClockFile
+    from pint_tpu import clock as clockmod
+
+    assert str(cache) in clockmod.clock_search_dirs()
+    cf = clockmod.ClockFile.read(
+        os.path.join(str(cache), "gps2utc.clk"), fmt="tempo2")
+    assert np.allclose(cf.evaluate([50500.0]), 2.0e-6)
+
+
+def test_expiry_policies(repo, tmp_path):
+    url, root = repo
+    cache = str(tmp_path / "c2")
+    p = clockcorr.get_file("T2runtime/clock/gps2utc.clk",
+                           url_base=url, cache_dir=cache)
+    first_stat = os.stat(p)
+    # fresh: if_expired serves the cache without re-downloading
+    (root / "T2runtime" / "clock" / "gps2utc.clk").write_text(
+        GPS2UTC + "52000.0 9.0e-6\n")
+    p2 = clockcorr.get_file("T2runtime/clock/gps2utc.clk",
+                            url_base=url, cache_dir=cache)
+    assert open(p2).read().count("9.0e-6") == 0
+    # expired: re-downloads the new content
+    os.utime(p, (time.time() - 10 * 86400,) * 2)
+    p3 = clockcorr.get_file("T2runtime/clock/gps2utc.clk",
+                            url_base=url, cache_dir=cache,
+                            update_interval_days=7.0)
+    assert "9.0e-6" in open(p3).read()
+    # if_missing never refreshes an existing file
+    os.utime(p, (time.time() - 100 * 86400,) * 2)
+    clockcorr.get_file("T2runtime/clock/gps2utc.clk", url_base=url,
+                       cache_dir=cache, download_policy="if_missing")
+    assert os.stat(p).st_mtime_ns != first_stat.st_mtime_ns  # from p3
+    # never + absent -> FileNotFoundError
+    with pytest.raises(FileNotFoundError):
+        clockcorr.get_file("T2runtime/clock/nonexistent.clk",
+                           url_base=url, cache_dir=cache,
+                           download_policy="never")
+
+
+def test_download_failure_falls_back_to_expired_cache(repo, tmp_path):
+    url, _ = repo
+    cache = str(tmp_path / "c3")
+    p = clockcorr.get_file("T2runtime/clock/gps2utc.clk",
+                           url_base=url, cache_dir=cache)
+    os.utime(p, (time.time() - 30 * 86400,) * 2)
+    # unreachable server: the expired copy is served with a warning
+    with pytest.warns(UserWarning, match="expired cached copy"):
+        p2 = clockcorr.get_file("T2runtime/clock/gps2utc.clk",
+                                url_base="http://127.0.0.1:1/",
+                                cache_dir=cache)
+    assert p2 == p
+
+
+def test_known_invalid_cache_never_served_on_failure(repo, tmp_path):
+    url, _ = repo
+    cache = str(tmp_path / "c4")
+    p = clockcorr.get_file("T2runtime/clock/gps2utc.clk",
+                           url_base=url, cache_dir=cache)
+    # mark the cached copy older than the index's invalid-before date
+    os.utime(p, (time.time() - 86400.0,) * 2)
+    with pytest.raises(OSError):
+        clockcorr.get_file("T2runtime/clock/gps2utc.clk",
+                           url_base="http://127.0.0.1:1/",
+                           cache_dir=cache,
+                           invalid_if_older_than=time.time())
+
+
+def test_update_invalidates_clock_lookup_cache(repo, tmp_path,
+                                               monkeypatch):
+    from pint_tpu import clock as clockmod
+
+    url, _ = repo
+    cache = tmp_path / "c5"
+    monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(cache))
+    clockmod.reset_cache()
+    # a miss is cached...
+    with pytest.warns(UserWarning, match="not found"):
+        assert clockmod.find_clock_file("gps2utc.clk",
+                                        fmt="tempo2") is None
+    # ...until update_clock_files() fetches and invalidates
+    clockcorr.update_clock_files(["gps2utc.clk"], url_base=url)
+    cf = clockmod.find_clock_file("gps2utc.clk", fmt="tempo2")
+    assert cf is not None
+    assert np.allclose(cf.evaluate([50500.0]), 2.0e-6)
+    clockmod.reset_cache()
