@@ -56,6 +56,17 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _util(ntoa, nfit, wall_s, niter=1, nbatch=1):
+    """Achieved-GFLOP/s + MFU floor of the solves (one place, so the
+    analytic count and its niter/nbatch inputs cannot drift per-config;
+    nfit is the fitter's free-param count, +1 for the offset column)."""
+    from pint_tpu import profiling
+
+    return profiling.mfu_report(
+        profiling.solve_flops(ntoa, nfit + 1, niter=niter,
+                              nbatch=nbatch), wall_s)
+
+
 def get_dataset():
     from pint_tpu.examples import simulate_j0740_realistic
     from pint_tpu.models import get_model
@@ -107,7 +118,10 @@ def bench_headline_grid():
         chi2 = grid_chisq_flat(fitter, grid, maxiter=2)
         times.append(time.time() - t0)
     log(f"steady-state grid times: {[f'{x:.3f}' for x in times]}")
-    return min(times), setup_s, compile_s
+    util = _util(toas.ntoas, len(fitter.fit_params), min(times),
+                 niter=2, nbatch=len(grid["M2"]))
+    log(f"headline solve utilization: {util}")
+    return min(times), setup_s, compile_s, util
 
 
 def bench_ngc6440e():
@@ -129,8 +143,10 @@ def bench_ngc6440e():
         f.fit_toas(maxiter=4)
         times.append(time.time() - t0)
     t = min(times)
-    return {"wall_s": round(t, 4), "fits_per_sec": round(1.0 / t, 2),
-            "compile_s": round(compile_s, 2), "ntoas": toas.ntoas}
+    out = {"wall_s": round(t, 4), "fits_per_sec": round(1.0 / t, 2),
+           "compile_s": round(compile_s, 2), "ntoas": toas.ntoas}
+    out.update(_util(toas.ntoas, len(f.fit_params), t, niter=4))
+    return out
 
 
 def bench_b1855_gls():
@@ -149,8 +165,10 @@ def bench_b1855_gls():
     t0 = time.time()
     f.fit_toas(maxiter=1)       # steady state: same jitted step
     t = time.time() - t0
-    return {"wall_s": round(t, 3), "compile_s": round(compile_s, 2),
-            "ntoas": toas.ntoas, "nfit": len(f.fit_params)}
+    out = {"wall_s": round(t, 3), "compile_s": round(compile_s, 2),
+           "ntoas": toas.ntoas, "nfit": len(f.fit_params)}
+    out.update(_util(toas.ntoas, len(f.fit_params), t))
+    return out
 
 
 def bench_wideband():
@@ -170,8 +188,10 @@ def bench_wideband():
     t0 = time.time()
     f.fit_toas(maxiter=1)       # steady state: same jitted step
     t = time.time() - t0
-    return {"wall_s": round(t, 3), "compile_s": round(compile_s, 2),
-            "ntoas": toas.ntoas, "nfit": len(f.fit_params)}
+    out = {"wall_s": round(t, 3), "compile_s": round(compile_s, 2),
+           "ntoas": toas.ntoas, "nfit": len(f.fit_params)}
+    out.update(_util(toas.ntoas, len(f.fit_params), t))
+    return out
 
 
 def bench_ensemble(nfits: int = 32):
@@ -214,6 +234,8 @@ def bench_ensemble_sweep(sizes=(32, 128, 512, 2048)):
         out[str(nfits)] = {"wall_s": round(t, 4),
                            "fits_per_sec": round(nfits / t, 1),
                            "compile_s": round(compile_s, 2)}
+        out[str(nfits)].update(_util(toas.ntoas, len(f.fit_params), t,
+                                     niter=2, nbatch=nfits))
         log(f"  ensemble[{nfits}]: {out[str(nfits)]}")
     first = out[str(sizes[0])]
     return {"wall_s": first["wall_s"],
@@ -364,7 +386,7 @@ def main():
     os.environ.setdefault("PINT_TPU_CACHE", os.path.join(CACHE, "ephem"))
     log("jax devices:", jax.devices())
 
-    t, setup_s, compile_s = bench_headline_grid()
+    t, setup_s, compile_s, headline_util = bench_headline_grid()
 
     def release_device():
         # drop compiled executables and live buffers between phases: the
@@ -422,6 +444,8 @@ def main():
         "vs_baseline": round(BASELINE_S / t, 1),
         "setup_s": round(setup_s, 1),
         "compile_s": round(compile_s, 1),
+        # analytic solve-FLOP floor / measured wall (profiling.solve_flops)
+        "solve_utilization": headline_util,
         "submetrics": submetrics,
     }))
 

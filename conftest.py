@@ -80,11 +80,23 @@ _SLOW_TESTS = {
 }
 
 
+#: the PARITY tier (``pytest -m parity``, ~2 min): the load-bearing
+#: correctness evidence — tempo2 absolute/uncertainty parity, the GLS
+#: stack, and one cross-backend fit — re-verifiable inside a single
+#: 600 s driver budget without waiting on the ~55-min full tier.
+_PARITY_FILES = {"test_tempo2_parity.py", "test_gls.py"}
+_PARITY_TESTS = {("test_crossbackend.py", "test_cpu_tpu_fit_parity")}
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: depth/perf coverage excluded from the smoke tier "
         '(run smoke with -m "not slow")')
+    config.addinivalue_line(
+        "markers",
+        "parity: the headline tempo2/GLS/cross-backend correctness "
+        "evidence (run with -m parity, ~2 min)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -100,3 +112,7 @@ def pytest_collection_modifyitems(config, items):
                 and item.cls.__name__ == p
                 for f, p in _SLOW_TESTS):
             item.add_marker(_pytest.mark.slow)
+        if fname in _PARITY_FILES or any(
+                fname == f and item.name.startswith(p)
+                for f, p in _PARITY_TESTS):
+            item.add_marker(_pytest.mark.parity)

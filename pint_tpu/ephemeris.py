@@ -940,8 +940,26 @@ class IntegratedEphemeris(BuiltinEphemeris):
                 # never persisted — found as hundreds of orphaned
                 # *.tmpPID.npz files)
                 tmp = path + f".tmp{os.getpid()}.npz"
-                np.savez_compressed(tmp, grid=grid, states=states)
-                os.replace(tmp, path)
+                try:
+                    np.savez_compressed(tmp, grid=grid, states=states)
+                    os.replace(tmp, path)
+                finally:
+                    # a killed/failed write must not orphan its tmp
+                    # (the driver's 600 s budget DOES kill mid-write)
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                # sweep tmp orphans from writers that died before the
+                # finally could run (SIGKILL) — anything older than 1 h
+                # is dead, its PID notwithstanding
+                import glob
+                import time
+                for stale in glob.glob(
+                        os.path.join(self._cache_dir(), "*.tmp*.npz")):
+                    try:
+                        if time.time() - os.path.getmtime(stale) > 3600:
+                            os.unlink(stale)
+                    except OSError:
+                        pass
             except OSError:
                 pass
         return {

@@ -160,3 +160,68 @@ def trace(logdir: str) -> Iterator[None]:
 
     with jax.profiler.trace(logdir):
         yield
+
+
+# --- FLOP / MFU accounting ---------------------------------------------------
+# The reference's profiling culture is per-stage wall-clock attribution
+# (`/root/reference/profiling/README.txt`); on an accelerator the missing
+# axis is *utilization* — achieved FLOP/s against the chip's peak — so a
+# perf regression shows up as falling MFU even when wall-clock noise
+# hides it.  Counts here are ANALYTIC (the dense-linear-algebra floor of
+# the solves: Gram + eigendecomposition + back-substitution), not XLA
+# cost-model output: `Compiled.cost_analysis()` would need a second
+# compile of each program over the tunneled backend, and the jacfwd
+# physics FLOPs it would add are not the MXU-relevant part.  Treat the
+# reported MFU as a floor.
+
+#: peak dense-matmul FLOP/s per chip by ``device_kind`` prefix (bf16
+#: systolic peak — the number TPU MFU is conventionally quoted against;
+#: longest prefix wins, so "TPU v5" does not shadow "TPU v5 lite")
+_PEAK_FLOPS = {
+    "TPU v6": 918e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+}
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """bf16 peak FLOP/s of ``device`` (default: jax.devices()[0]), or
+    None when the kind is unknown (e.g. the CPU backend)."""
+    import jax
+
+    if device is None:
+        devs = jax.devices()
+        if not devs:
+            return None
+        device = devs[0]
+    kind = getattr(device, "device_kind", "") or ""
+    best = None
+    for prefix, peak in _PEAK_FLOPS.items():
+        if kind.startswith(prefix) and (best is None
+                                        or len(prefix) > len(best[0])):
+            best = (prefix, peak)
+    return best[1] if best else None
+
+
+def solve_flops(ntoa: int, npar: int, niter: int = 1,
+                nbatch: int = 1) -> float:
+    """Analytic FLOPs of ``nbatch`` x ``niter`` whitened WLS/GLS
+    normal-equation solves: Gram ``2*N*P^2`` + eigh ``~9*P^3`` +
+    matvec applications ``~6*N*P``."""
+    gram = 2.0 * ntoa * npar * npar
+    eigh = 9.0 * float(npar) ** 3
+    apply_ = 6.0 * ntoa * npar
+    return float(nbatch) * niter * (gram + eigh + apply_)
+
+
+def mfu_report(flops: float, wall_s: float, device=None) -> dict:
+    """``{"gflops_per_s": ..., "mfu_pct": ...}`` for ``flops`` of work
+    done in ``wall_s`` (mfu_pct absent when the device peak is unknown).
+    """
+    out = {"gflops_per_s": round(flops / wall_s / 1e9, 3)}
+    peak = device_peak_flops(device)
+    if peak:
+        out["mfu_pct"] = round(100.0 * flops / wall_s / peak, 5)
+    return out

@@ -1,0 +1,52 @@
+"""Unit tests for the analytic FLOP / MFU accounting
+(:mod:`pint_tpu.profiling`; VERDICT r4 item 9).  Pure Python over fake
+device objects — no backend required."""
+
+import numpy as np
+
+from pint_tpu import profiling
+
+
+class _FakeDevice:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+class TestPeakFlops:
+    def test_longest_prefix_wins(self):
+        # "TPU v5 lite" (v5e) must NOT be scored against the v5p peak
+        v5e = profiling.device_peak_flops(_FakeDevice("TPU v5 lite"))
+        v5p = profiling.device_peak_flops(_FakeDevice("TPU v5"))
+        assert v5e == 197e12
+        assert v5p == 459e12
+
+    def test_unknown_kind_is_none(self):
+        assert profiling.device_peak_flops(_FakeDevice("cpu")) is None
+        assert profiling.device_peak_flops(_FakeDevice("")) is None
+
+
+class TestSolveFlops:
+    def test_gram_dominates_at_scale(self):
+        n, p = 12500, 88
+        f = profiling.solve_flops(n, p)
+        gram = 2.0 * n * p * p
+        assert f > gram
+        assert f < 2.0 * gram  # eigh + applies are subdominant here
+
+    def test_batch_and_iter_scale_linearly(self):
+        base = profiling.solve_flops(1000, 20)
+        assert np.isclose(profiling.solve_flops(1000, 20, niter=3), 3 * base)
+        assert np.isclose(profiling.solve_flops(1000, 20, nbatch=7), 7 * base)
+
+
+class TestMfuReport:
+    def test_known_device(self):
+        rep = profiling.mfu_report(197e12 * 0.5, 1.0,
+                                   device=_FakeDevice("TPU v5 lite"))
+        assert rep["mfu_pct"] == 50.0
+        assert rep["gflops_per_s"] == round(197e12 * 0.5 / 1e9, 3)
+
+    def test_unknown_device_omits_mfu(self):
+        rep = profiling.mfu_report(1e9, 1.0, device=_FakeDevice("cpu"))
+        assert "mfu_pct" not in rep
+        assert rep["gflops_per_s"] == 1.0
