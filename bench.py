@@ -214,6 +214,7 @@ def bench_ensemble_sweep(sizes=(32, 128, 512, 2048)):
     item 8): fits/sec vs batch size for the vmapped ensemble.  On a
     single chip throughput should RISE with batch size until the MXU
     saturates — the scaling story a single device can tell."""
+    from pint_tpu import profiling
     from pint_tpu.examples import simulate_j0740_class
     from pint_tpu.fitter import WLSFitter
     from pint_tpu.gridutils import grid_chisq_flat

@@ -58,7 +58,6 @@ test artifacts alone.
 from __future__ import annotations
 
 import os
-import sys
 
 import numpy as np
 
@@ -400,9 +399,14 @@ def fit_correction(obs, exclude=(), knot_days=60.0, cm_knot_days=180.0,
 
     _, kn = design(np.array([tmin]))
     nk = len(kn) - 4
-    grid_cm = _knot_grid(tmin, tmax, cm_knot_days)
-    _, kn_cm = _bspline_design(np.array([tmin]), grid_cm)
-    ncm = len(kn_cm) - 4
+    # cm columns exist only when the amplitude ridge is enabled (see
+    # the ridge comment below for why cm ships disabled)
+    if cm_amp_m:
+        grid_cm = _knot_grid(tmin, tmax, cm_knot_days)
+        _, kn_cm = _bspline_design(np.array([tmin]), grid_cm)
+        ncm = len(kn_cm) - 4
+    else:
+        ncm = 0
     nset = len(los_names)
     ncol = 3 * nk + ncm + nset
 
@@ -428,11 +432,12 @@ def fit_correction(obs, exclude=(), knot_days=60.0, cm_knot_days=180.0,
         t, y, n = obs[nm]["mjd"], obs[nm]["y"], obs[nm]["n"]
         sig = SIGMA_ROEMER_M if nm == "j1744" else SIGMA_LOS_M
         B, _ = design(t)
-        Bcm = _bspline_design(t, grid_cm)[0].toarray()
         blk = blank(len(t))
         for ax in range(3):
             blk[:, ax * nk:(ax + 1) * nk] = n[:, ax:ax + 1] * B
-        blk[:, 3 * nk:3 * nk + ncm] = Bcm
+        if ncm:
+            blk[:, 3 * nk:3 * nk + ncm] = \
+                _bspline_design(t, grid_cm)[0].toarray()
         blk[:, 3 * nk + ncm + k] = 1.0
         rows_A.append(blk)
         rows_b.append(y * C)
@@ -448,12 +453,6 @@ def fit_correction(obs, exclude=(), knot_days=60.0, cm_knot_days=180.0,
         rows_A.append(blk)
         rows_b.append(np.zeros(D.shape[0]))
         rows_w.append(np.full(D.shape[0], 1.0 / lam_smooth))
-    Dc = _second_diff(ncm)
-    blk = blank(Dc.shape[0])
-    blk[:, 3 * nk:3 * nk + ncm] = Dc
-    rows_A.append(blk)
-    rows_b.append(np.zeros(Dc.shape[0]))
-    rows_w.append(np.full(Dc.shape[0], 1.0 / lam_cm))
     # Common-mode AMPLITUDE ridge: cm models clock-chain/TDB-series
     # differences vs tempo2 — physically <= a few hundred ns (~100 m).
     # Without this ridge, the RA-clustering of the pulsars (4 of 7
@@ -462,20 +461,21 @@ def fit_correction(obs, exclude=(), knot_days=60.0, cm_knot_days=180.0,
     # geometry that the served 3-axis correction would then LACK).
     # Curvature smoothing alone cannot prevent that (a smooth huge cm
     # is curvature-free); pinning every coefficient to 0 at ~cm_amp_m
-    # keeps cm to its physical job.  cm_amp_m=None drops cm entirely.
-    if cm_amp_m:
+    # keeps cm to its physical job.  Even ridged, cm was measured to
+    # degrade the SERVED accuracy, so the default is cm_amp_m=None —
+    # no cm columns at all.
+    if ncm:
+        Dc = _second_diff(ncm)
+        blk = blank(Dc.shape[0])
+        blk[:, 3 * nk:3 * nk + ncm] = Dc
+        rows_A.append(blk)
+        rows_b.append(np.zeros(Dc.shape[0]))
+        rows_w.append(np.full(Dc.shape[0], 1.0 / lam_cm))
         blk = blank(ncm)
         blk[:, 3 * nk:3 * nk + ncm] = np.eye(ncm)
         rows_A.append(blk)
         rows_b.append(np.zeros(ncm))
         rows_w.append(np.full(ncm, 1.0 / cm_amp_m))
-    else:
-        # cm disabled: pin its coefficients exactly
-        blk = blank(ncm)
-        blk[:, 3 * nk:3 * nk + ncm] = np.eye(ncm)
-        rows_A.append(blk)
-        rows_b.append(np.zeros(ncm))
-        rows_w.append(np.full(ncm, 1.0 / 1e-6))
 
     A = np.vstack(rows_A)
     b = np.concatenate(rows_b)
@@ -483,7 +483,8 @@ def fit_correction(obs, exclude=(), knot_days=60.0, cm_knot_days=180.0,
     x, *_ = np.linalg.lstsq(A * w[:, None], b * w, rcond=None)
 
     cx = [BSpline(kn, x[ax * nk:(ax + 1) * nk], 3) for ax in range(3)]
-    cm = BSpline(kn_cm, x[3 * nk:3 * nk + ncm], 3)
+    cm = (BSpline(kn_cm, x[3 * nk:3 * nk + ncm], 3) if ncm
+          else (lambda t: np.zeros(np.shape(t))))
     consts = dict(zip(los_names, x[3 * nk + ncm:]))
 
     def delta(t):
@@ -569,7 +570,8 @@ def bake(fit, path=None, grid_days=4.0, taper_days=600.0):
     lines += [f"    {v!r}," for v in grid.tolist()]
     lines += ["])", "", "#: geocenter correction [m], ICRS axes",
               "CORR_M = np.array(["]
-    lines += [f"    ({r[0]!r}, {r[1]!r}, {r[2]!r})," for r in vals]
+    lines += [f"    ({x!r}, {y!r}, {z!r}),"
+              for x, y, z in (r.tolist() for r in vals)]
     lines += ["])", ""]
     with open(path, "w") as f:
         f.write("\n".join(lines))

@@ -937,8 +937,6 @@ class IntegratedEphemeris(BuiltinEphemeris):
         return _PinnedEphemeris(self, self._window_key(mjd_span))
 
     def _build(self, wlo, whi):
-        from scipy.interpolate import CubicSpline
-
         ar = self._anchor_range()
         anch = "a" if (ar is not None and wlo <= ar[0]
                        and ar[1] <= whi) else ""

@@ -41,7 +41,8 @@ import time
 from typing import Dict, Iterator, Optional
 
 __all__ = ["enable", "disable", "enabled", "reset", "report", "table",
-           "stage", "count", "counters", "session", "trace", "Session"]
+           "stage", "count", "counters", "session", "paused", "trace",
+           "Session", "device_peak_flops", "solve_flops", "mfu_report"]
 
 _enabled = False
 _stages: Dict[str, list] = {}   # name -> [calls, wall_s]
