@@ -31,7 +31,8 @@ from pint_tpu.fitsio import read_fits
 from pint_tpu.toa import TOAs
 
 __all__ = ["load_event_TOAs", "load_fits_TOAs", "get_event_TOAs",
-           "load_FPorbit", "get_satellite_observatory"]
+           "get_Fermi_TOAs", "calc_lat_weights", "load_FPorbit",
+           "get_satellite_observatory"]
 
 #: missions whose event files this loader understands (reference keeps a
 #: HEASOFT-derived mission db, `event_toas.py:75-168`)
@@ -54,15 +55,30 @@ def load_fits_TOAs(eventfile: str, extname: str = "EVENTS",
                    timecolumn: str = "TIME",
                    weightcolumn: Optional[str] = None,
                    minmjd: float = -np.inf,
-                   maxmjd: float = np.inf) -> TOAs:
+                   maxmjd: float = np.inf,
+                   obs: Optional[str] = None,
+                   extra_columns: Sequence[str] = ()) -> TOAs:
     """Load photon TOAs from a FITS event file (reference
-    `load_fits_TOAs`, `/root/reference/src/pint/event_toas.py:245`)."""
+    `load_fits_TOAs`, `/root/reference/src/pint/event_toas.py:245`).
+
+    ``obs``: a registered observatory name for spacecraft-frame
+    (TIMEREF=LOCAL) events — typically a :class:`SatelliteObs` created
+    by :func:`get_satellite_observatory` from the mission orbit file
+    (reference `photonphase --orbfile`)."""
     hdus = read_fits(eventfile)
     ev = None
     for h in hdus:
         if h.name.upper() == extname.upper() and timecolumn in h:
             ev = h
             break
+    if ev is None:
+        # mission-specific extension names (XTE_SE, SC_DATA, ...): the
+        # reference reads the FIRST binary table (get_fits_TOAs
+        # extension=1, `/root/reference/src/pint/event_toas.py:300`)
+        for h in hdus:
+            if timecolumn in h and h.name.upper() != "GTI":
+                ev = h
+                break
     if ev is None:
         raise ValueError(f"no {extname} binary table with a {timecolumn} "
                          f"column in {eventfile}")
@@ -77,6 +93,15 @@ def load_fits_TOAs(eventfile: str, extname: str = "EVENTS",
     tz = float(hdr.get("TIMEZERO", 0.0))
 
     t_sec = np.asarray(ev[timecolumn], np.float64) + tz
+    # min/max MJD select on the file's OWN time scale, BEFORE any
+    # scale conversion (reference read_fits_event_mjds + mask,
+    # `/root/reference/src/pint/event_toas.py:414`): a TT->UTC shift
+    # would otherwise move the window by ~67 s
+    mjd_raw = day0 + frac0 + t_sec / 86400.0
+    keep = (mjd_raw >= minmjd) & (mjd_raw <= maxmjd)
+    if not keep.any():
+        raise ValueError("no events inside [minmjd, maxmjd]")
+    t_sec = t_sec[keep]
     # two-part epoch: integer days from the seconds column, fraction exact
     day = day0 + np.floor(t_sec / 86400.0).astype(np.int64)
     frac = frac0 + (t_sec - np.floor(t_sec / 86400.0) * 86400.0) / 86400.0
@@ -95,27 +120,36 @@ def load_fits_TOAs(eventfile: str, extname: str = "EVENTS",
         elif timesys != "UTC":
             raise ValueError(f"unsupported TIMESYS {timesys} for "
                              "geocentric events")
+    elif obs is not None:
+        # spacecraft-frame events with an orbit-backed observatory:
+        # event TIME is mission elapsed TT at the spacecraft; our TOA
+        # epochs are site UTC, so undo TT host-side (exact), as for
+        # the geocenter (the satellite has no ground clock chain)
+        if timesys == "TT":
+            times = mjdmod.tai_to_utc(mjdmod.tt_to_tai(times))
+        elif timesys != "UTC":
+            raise ValueError(f"unsupported TIMESYS {timesys} for "
+                             "spacecraft-frame events")
     else:
         raise ValueError(
             f"events are in the spacecraft frame (TIMEREF={timeref}); "
-            "barycenter them first (e.g. barycorr) — orbit-file support "
-            "needs a mission orbit reader")
+            "pass obs=<satellite observatory> (see "
+            "get_satellite_observatory) or barycenter them first "
+            "(e.g. barycorr)")
 
     weights = None
     if weightcolumn is not None:
-        weights = np.asarray(ev[weightcolumn], np.float64)
-    energies = np.asarray(ev["PI"], np.float64) if "PI" in ev else None
+        weights = np.asarray(ev[weightcolumn], np.float64)[keep]
+    energies = np.asarray(ev["PI"], np.float64)[keep] if "PI" in ev \
+        else None
 
-    mask = (times.mjd_float >= minmjd) & (times.mjd_float <= maxmjd)
-    idx = np.flatnonzero(mask)
-    if len(idx) == 0:
-        raise ValueError("no events inside [minmjd, maxmjd]")
-    sel = mjdmod.MJD(np.asarray(times.day)[idx], np.asarray(times.frac)[idx])
-    out = TOAs.from_columns(sel, 0.0, np.inf, obs, filename=eventfile)
+    out = TOAs.from_columns(times, 0.0, np.inf, obs, filename=eventfile)
     # per-photon columns stay vectorized (a dict-of-strings per photon
     # would cost minutes + GBs at 1e7 events); TOAs.select carries them
-    out.energies = None if energies is None else energies[idx]
-    out.weights = None if weights is None else weights[idx]
+    out.energies = energies
+    out.weights = weights
+    out.extra = {c: np.asarray(ev[c], np.float64)[keep]
+                 for c in extra_columns if c in ev}
     return out
 
 
@@ -138,34 +172,123 @@ def get_event_TOAs(eventfile: str, ephem: str = "DE421",
     return toas
 
 
+def calc_lat_weights(energies_mev, angsep_deg, logeref: float = 4.1,
+                     logesig: float = 0.5):
+    """Fermi-LAT photon weights from the energy-dependent PSF alone
+    (no spectral model) — the physics of Philippe Bruel's
+    SearchPulsation weighting (reference `calc_lat_weights`,
+    `/root/reference/src/pint/fermi_toas.py:20-67`): a King-like PSF
+    footprint ``(1 + th^2 / (2 g s(E)^2))^-g`` times a log-normal
+    energy prior centred on ``logeref``.
+
+    Parameters: photon energies [MeV], angular separations from the
+    target [deg]; returns per-photon target probabilities in [0, 1].
+    """
+    energies = np.asarray(energies_mev, np.float64)
+    th = np.asarray(angsep_deg, np.float64)
+    # PSF shape constants from the SearchPulsation optimization
+    psfpar0, psfpar1, psfpar2 = 5.445, 0.848, 0.084
+    gam, scalepsf = 2.0, 3.0
+    logE = np.log10(energies)
+    sigma = np.sqrt(psfpar0**2 * (100.0 / energies) ** (2.0 * psfpar1)
+                    + psfpar2**2) / scalepsf
+    fgeom = (1.0 + th * th / (2.0 * gam * sigma * sigma)) ** -gam
+    return fgeom * np.exp(-(((logE - logeref) / np.sqrt(2.0) / logesig)
+                            ** 2))
+
+
+def _angsep_deg(ra1, dec1, ra2, dec2):
+    """Great-circle separation [deg] (Vincenty form, stable at all
+    separations)."""
+    r1, d1, r2, d2 = map(np.deg2rad, (ra1, dec1, ra2, dec2))
+    dl = r2 - r1
+    num = np.hypot(np.cos(d2) * np.sin(dl),
+                   np.cos(d1) * np.sin(d2)
+                   - np.sin(d1) * np.cos(d2) * np.cos(dl))
+    den = np.sin(d1) * np.sin(d2) + np.cos(d1) * np.cos(d2) * np.cos(dl)
+    return np.rad2deg(np.arctan2(num, den))
+
+
+def get_Fermi_TOAs(ft1name: str, weightcolumn: Optional[str] = None,
+                   targetcoord=None, logeref: float = 4.1,
+                   logesig: float = 0.5, minweight: float = 0.0,
+                   minmjd: float = -np.inf, maxmjd: float = np.inf,
+                   ephem: str = "DE421", planets: bool = False,
+                   obs: Optional[str] = None) -> TOAs:
+    """Load Fermi FT1 photons, with optional PSF-computed weights
+    (reference `get_Fermi_TOAs`,
+    `/root/reference/src/pint/fermi_toas.py:113`: weightcolumn='CALC'
+    computes SearchPulsation weights from ENERGY + angular separation
+    to ``targetcoord`` = (ra_deg, dec_deg))."""
+    calc = weightcolumn is not None and weightcolumn.upper() == "CALC"
+    toas = load_fits_TOAs(
+        ft1name, weightcolumn=None if calc else weightcolumn,
+        minmjd=minmjd, maxmjd=maxmjd, obs=obs,
+        extra_columns=("ENERGY", "RA", "DEC"))
+    if calc:
+        if targetcoord is None:
+            raise ValueError("weightcolumn='CALC' needs targetcoord="
+                             "(ra_deg, dec_deg)")
+        ex = toas.extra
+        if any(c not in ex for c in ("ENERGY", "RA", "DEC")):
+            raise ValueError("FT1 file lacks ENERGY/RA/DEC columns "
+                             "needed for CALC weights")
+        sep = _angsep_deg(ex["RA"], ex["DEC"], targetcoord[0],
+                          targetcoord[1])
+        toas.weights = calc_lat_weights(ex["ENERGY"], sep,
+                                        logeref=logeref,
+                                        logesig=logesig)
+    if toas.weights is not None and minweight > 0.0:
+        # select carries the photon columns (weights/energies/extra)
+        toas = toas.select(toas.weights >= minweight)
+    toas.apply_clock_corrections()
+    toas.compute_TDBs(ephem=ephem)
+    toas.compute_posvels(ephem=ephem, planets=planets)
+    return toas
+
+
 def load_FPorbit(orbit_filename: str):
-    """Parse an FPorbit-style FITS orbit file (NICER/RXTE) into
-    ``(mjd_tt, pos_m, vel_ms)`` arrays (reference `load_FPorbit`,
-    `/root/reference/src/pint/observatory/satellite_obs.py:87`)."""
+    """Parse a satellite orbit FITS file into ``(mjd_tt, pos_m,
+    vel_ms)`` arrays.  Handles both FPorbit-style tables
+    (NICER/RXTE: TIME + X/Y/Z [+VX/VY/VZ] columns; reference
+    `load_FPorbit`, `/root/reference/src/pint/observatory/
+    satellite_obs.py:87`) and Fermi FT2 spacecraft files (START +
+    SC_POSITION 3-vector [m] ECI; reference `load_FT2`, ibid:25-85)."""
     hdus = read_fits(orbit_filename)
-    orb = None
+    orb = kind = None
     for h in hdus:
         if "X" in h and "TIME" in h:
-            orb = h
+            orb, kind = h, "fporbit"
+            break
+        if "SC_POSITION" in h and "START" in h:
+            orb, kind = h, "ft2"
             break
     if orb is None:
-        raise ValueError(f"no orbit table (TIME/X/Y/Z) in {orbit_filename}")
+        raise ValueError(f"no orbit table (TIME/X/Y/Z or "
+                         f"START/SC_POSITION) in {orbit_filename}")
     hdr = orb.header
     timesys = str(hdr.get("TIMESYS", "TT")).strip().upper()
     if timesys != "TT":
         warnings.warn(f"orbit file TIMESYS={timesys}; treating as TT")
     day0, frac0 = _mjdref(hdr)
     tz = float(hdr.get("TIMEZERO", 0.0))
-    t_sec = np.asarray(orb["TIME"], np.float64) + tz
+    tcol = "TIME" if kind == "fporbit" else "START"
+    t_sec = np.asarray(orb[tcol], np.float64) + tz
     mjd_tt = day0 + frac0 + t_sec / 86400.0
-    pos = np.stack([np.asarray(orb[c], np.float64)
-                    for c in ("X", "Y", "Z")], axis=-1)
+    if kind == "fporbit":
+        pos = np.stack([np.asarray(orb[c], np.float64)
+                        for c in ("X", "Y", "Z")], axis=-1)
+    else:
+        pos = np.asarray(orb["SC_POSITION"], np.float64).reshape(-1, 3)
     # sort FIRST: differentiation needs monotonic time
     order = np.argsort(mjd_tt)
     mjd_tt, t_sec, pos = mjd_tt[order], t_sec[order], pos[order]
-    if "VX" in orb:
+    if kind == "fporbit" and "VX" in orb:
         vel = np.stack([np.asarray(orb[c], np.float64)
                         for c in ("VX", "VY", "VZ")], axis=-1)[order]
+    elif kind == "ft2" and "SC_VELOCITY" in orb:
+        vel = np.asarray(orb["SC_VELOCITY"],
+                         np.float64).reshape(-1, 3)[order]
     else:
         # central differences; matches the reference fallback for FT2
         # files without velocity columns (satellite_obs.py:60-70)

@@ -94,6 +94,24 @@ class Astrometry(DelayComponent):
         """Unit vector SSB→pulsar at each TOA, shape (N, 3)."""
         raise NotImplementedError
 
+    def radec_deg(self):
+        """Catalog (ra, dec) in ICRS degrees, from the parameter values
+        (no proper-motion propagation) — the target coordinate for
+        photon-weight computations (reference `fermiphase`'s
+        ``modelin.coords_as_ICRS()`` use, `fermi_toas.py:173`).
+        AngleParam values are ALWAYS radians; frame rotation reuses the
+        module's helpers so the convention cannot drift (see
+        host_psr_dir)."""
+        import math as _m
+
+        lon = float(self.params[self._angle_names[0]].value)
+        lat = float(self.params[self._angle_names[1]].value)
+        n = _sph_dir(lon, lat)
+        if self._angle_names[0] == "ELONG":
+            n = _rot_eq_to_ecl(self.obliquity()).T @ n
+        return (float(_m.degrees(_m.atan2(n[1], n[0]))) % 360.0,
+                float(_m.degrees(_m.asin(n[2]))))
+
     #: (pm_lon_name, pm_lat_name) in this frame — set by subclasses
     _pm_names = ()
 

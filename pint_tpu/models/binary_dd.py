@@ -153,6 +153,16 @@ class BinaryDDBase(OrbwaveMixin, DelayComponent):
         alternative epochs."""
         return dt
 
+    def orbital_phase(self, p: dict, batch: TOABatch,
+                      delay) -> jnp.ndarray:
+        """Fractional orbital phase in [0, 1) at each TOA, measured from
+        T0 (reference `photonphase --addorbphase`,
+        `/root/reference/src/pint/scripts/photonphase.py:277-283`)."""
+        dt = self.dt_extra(p, batch, dt_seconds_qs(p, batch, delay, "T0")[1])
+        orbits, _ = self._apply_orbwaves(
+            p, batch, delay, *orbits_and_freq(p, dt, self.fb_names()))
+        return orbits - jnp.floor(orbits)
+
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         dt = self.dt_extra(p, batch, dt_seconds_qs(p, batch, delay, "T0")[1])
         orbits, forb = self._apply_orbwaves(

@@ -195,6 +195,15 @@ class BinaryELL1Base(OrbwaveMixin, DelayComponent):
         under OMDOT/LNEDOT (reference `ELL1k_model.py:120-134`)."""
         return 0.0
 
+    def orbital_phase(self, p: dict, batch: TOABatch,
+                      delay) -> jnp.ndarray:
+        """Fractional orbital phase in [0, 1) at each TOA, measured from
+        TASC (reference `photonphase --addorbphase`,
+        `/root/reference/src/pint/scripts/photonphase.py:277-283`)."""
+        dt = self._ttasc(p, batch, delay)
+        orbits, _ = self._orbits_and_freq(p, dt, batch, delay)
+        return orbits - jnp.floor(orbits)
+
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         dt = self._ttasc(p, batch, delay)
         orbits, forb = self._orbits_and_freq(p, dt, batch, delay)

@@ -714,6 +714,21 @@ class TimingModel:
         return sigma
 
     # -- physics ----------------------------------------------------------
+    def orbital_phase(self, p: dict, batch: TOABatch) -> jnp.ndarray:
+        """Fractional orbital phase in [0, 1) at each TOA (reference
+        `photonphase --addorbphase`,
+        `/root/reference/src/pint/scripts/photonphase.py:277-283`:
+        ``modelin.binary_instance.orbits()`` after ``modelin.delay``).
+        Raises if the model has no binary component."""
+        binary = [c for c in self.calc.delay_components
+                  if getattr(c, "category", "") == "pulsar_system"]
+        if not binary:
+            raise ValueError(
+                "orbital_phase requires a binary model (no BINARY in "
+                "the par file)")
+        d = self.calc.delay(p, batch, upto="pulsar_system")
+        return binary[0].orbital_phase(p, batch, d)
+
     def total_dm(self, p: dict, batch: TOABatch) -> jnp.ndarray:
         """Model DM at each TOA [pc cm^-3]: the sum over every component
         exposing ``dm_value`` (reference ``TimingModel.total_dm``,

@@ -223,6 +223,17 @@ class SatelliteObs(Observatory):
 
     def posvel_gcrs(self, tt_mjd, ut1_mjd=None, eop=null_eop):
         t = np.asarray(tt_mjd, np.float64)
+        # np.interp clamps silently; an event outside the orbit table
+        # would get the frozen endpoint position (km-scale error, ms of
+        # barycentering) — refuse instead (the reference errors too).
+        # 60 s of slack tolerates edge rounding.
+        slack = 60.0 / 86400.0
+        if t.size and (t.min() < self.mjd_tt[0] - slack
+                       or t.max() > self.mjd_tt[-1] + slack):
+            raise ValueError(
+                f"TOAs (MJD {t.min():.3f}-{t.max():.3f}) fall outside "
+                f"the orbit table of observatory {self.name!r} "
+                f"(MJD {self.mjd_tt[0]:.3f}-{self.mjd_tt[-1]:.3f})")
         pos = np.stack([np.interp(t, self.mjd_tt, self.pos[:, i]) for i in range(3)], -1)
         vel = np.stack([np.interp(t, self.mjd_tt, self.vel[:, i]) for i in range(3)], -1)
         return PosVel(pos, vel)
@@ -235,6 +246,11 @@ _alias_map: Dict[str, str] = {}
 
 
 def register(obs: Observatory, overwrite=False):
+    # a user registration into a fresh process must not pre-empt the
+    # built-in site table (_load_defaults only fills an EMPTY registry,
+    # so registering first would silently hide every default site)
+    if not _loading:
+        _load_defaults()
     if obs.name in _registry and not overwrite:
         raise ObservatoryError(f"observatory {obs.name!r} already registered")
     _registry[obs.name] = obs
@@ -246,9 +262,14 @@ def register(obs: Observatory, overwrite=False):
         _alias_map[obs.itoa_code.lower()] = obs.name
 
 
+_loading = False
+
+
 def _load_defaults():
-    if _registry:
+    global _loading
+    if _registry or _loading:
         return
+    _loading = True
     from pint_tpu.data.observatories_data import SITES
 
     for (name, xyz, tcode, icode, aliases, clock_file, gps, bogus) in SITES:
@@ -260,6 +281,7 @@ def _load_defaults():
     register(BarycenterObs("barycenter", aliases=["bat", "ssb", "bary", "@"]))
     register(GeocenterObs("geocenter", aliases=["coe", "geo"]))
     register(T2SpacecraftObs("stl_geo", aliases=["spacecraft"]))
+    _loading = False
 
 
 def get_observatory(name: str) -> Observatory:

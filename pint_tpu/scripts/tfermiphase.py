@@ -2,8 +2,12 @@
 
 Reference: `fermiphase` (`/root/reference/src/pint/scripts/fermiphase.py`):
 load a Fermi FT1 event file + par file, compute each photon's phase,
-report the (weighted) H-test, optionally write the phases out.  Writing
-a PULSE_PHASE column back into the FITS file is not supported (no FITS
+report the (weighted) H-test, optionally write the phases out.  The
+weight column may be 'CALC' to compute SearchPulsation PSF weights from
+photon ENERGY + angular separation to the model's sky position
+(`pint_tpu.event_toas.calc_lat_weights`, validated against the
+reference's H-test golden in tests/test_real_events.py).  Writing a
+PULSE_PHASE column back into the FITS file is not supported (no FITS
 writer in this zero-dependency stack); phases go to a text file instead.
 """
 
@@ -23,8 +27,8 @@ def main(argv=None):
     parser.add_argument("parfile", help="par file to construct the model")
     parser.add_argument("weightcol", nargs="?", default=None,
                         help="photon-weight column name (e.g. from "
-                             "gtsrcprob); the reference's CALC mode is "
-                             "not supported")
+                             "gtsrcprob), or CALC to compute PSF "
+                             "weights from ENERGY + target separation")
     parser.add_argument("--ephem", default="DE421")
     parser.add_argument("--planets", action="store_true")
     parser.add_argument("--minMJD", type=float, default=None)
@@ -39,28 +43,27 @@ def main(argv=None):
     import numpy as np
 
     from pint_tpu import qs
-    from pint_tpu.event_toas import load_event_TOAs
+    from pint_tpu.event_toas import get_Fermi_TOAs
     from pint_tpu.models import get_model
     from pint_tpu.residuals import Residuals
     from pint_tpu.templates import hm, sf_hm
 
     model = get_model(args.parfile)
-    kw = {"mission": "fermi"}
+    kw = {}
     if args.weightcol:
-        if args.weightcol.upper() == "CALC":
-            print("CALC weights are not supported (the reference computes "
-                  "them from a spectral model); give a weight column",
-                  file=sys.stderr)
-            return 1
         kw["weightcolumn"] = args.weightcol
+        if args.weightcol.upper() == "CALC":
+            # target = the model's sky position (reference fermiphase
+            # builds the SkyCoord from modelin, fermiphase.py:77)
+            astro = [c for c in model.components.values()
+                     if hasattr(c, "psr_dir")][0]
+            kw["targetcoord"] = astro.radec_deg()
     if args.minMJD is not None:
         kw["minmjd"] = args.minMJD
     if args.maxMJD is not None:
         kw["maxmjd"] = args.maxMJD
-    toas = load_event_TOAs(args.eventfile, **kw)
-    toas.apply_clock_corrections()
-    toas.compute_TDBs(ephem=args.ephem)
-    toas.compute_posvels(ephem=args.ephem, planets=args.planets)
+    toas = get_Fermi_TOAs(args.eventfile, ephem=args.ephem,
+                          planets=args.planets, **kw)
     print(f"Read {toas.ntoas} Fermi photons from {args.eventfile}")
     r = Residuals(toas, model, subtract_mean=False)
     ph = model.calc.phase(r.pdict, r.batch)

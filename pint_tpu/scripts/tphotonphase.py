@@ -23,8 +23,15 @@ def main(argv=None):
     parser.add_argument("--planets", action="store_true")
     parser.add_argument("--minMJD", type=float, default=None)
     parser.add_argument("--maxMJD", type=float, default=None)
+    parser.add_argument("--orbfile", default=None,
+                        help="FPorbit/FT2 orbit file for topocentric "
+                             "(spacecraft-frame) events")
+    parser.add_argument("--addorbphase", action="store_true",
+                        help="also compute each photon's fractional "
+                             "ORBIT_PHASE (binary models only)")
     parser.add_argument("--outfile", default=None,
-                        help="write 'MJD phase' rows to this file")
+                        help="write 'MJD phase [orbphase]' rows to this "
+                             "file")
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args(argv)
     if args.quiet:
@@ -33,7 +40,8 @@ def main(argv=None):
     import numpy as np
 
     from pint_tpu import qs
-    from pint_tpu.event_toas import get_event_TOAs
+    from pint_tpu.event_toas import (get_event_TOAs,
+                                     get_satellite_observatory)
     from pint_tpu.models import get_model
     from pint_tpu.residuals import Residuals
     from pint_tpu.templates import hm, sf_hm
@@ -44,8 +52,16 @@ def main(argv=None):
         kw["minmjd"] = args.minMJD
     if args.maxMJD is not None:
         kw["maxmjd"] = args.maxMJD
+    if args.orbfile:
+        # reference: get_satellite_observatory(mission, orbfile) then
+        # events load in the spacecraft frame (photonphase.py:230-246)
+        get_satellite_observatory("satellite", args.orbfile)
+        kw["obs"] = "satellite"
+    # reference: planets follow the model's PLANET_SHAPIRO
+    # (photonphase.py:167)
+    planets = args.planets or model.planets_flag
     toas = get_event_TOAs(args.eventfile, ephem=args.ephem,
-                          planets=args.planets, **kw)
+                          planets=planets, **kw)
     print(f"Read {toas.ntoas} photons from {args.eventfile}")
     r = Residuals(toas, model, subtract_mean=False)
     ph = model.calc.phase(r.pdict, r.batch)
@@ -53,12 +69,20 @@ def main(argv=None):
     phases = np.asarray(qs.to_f64(frac)) % 1.0
     h = hm(phases)
     print(f"Htest: {h:.2f} (sig ~ {sf_hm(h):.3g})")
+    orbphases = None
+    if args.addorbphase:
+        orbphases = np.asarray(model.orbital_phase(r.pdict, r.batch))
+        print(f"Orbit phases: {orbphases[0]:.4f} .. {orbphases[-1]:.4f}")
     if args.outfile:
         mjds = np.asarray(toas.utc.mjd_float)
         with open(args.outfile, "w") as f:
-            f.write("# MJD phase\n")
-            for m, p in zip(mjds, phases):
-                f.write(f"{m:.12f} {p:.9f}\n")
+            f.write("# MJD phase" +
+                    (" orbphase\n" if orbphases is not None else "\n"))
+            for i, (m, p) in enumerate(zip(mjds, phases)):
+                row = f"{m:.12f} {p:.9f}"
+                if orbphases is not None:
+                    row += f" {orbphases[i]:.9f}"
+                f.write(row + "\n")
         print(f"Wrote phases to {args.outfile}")
     return 0
 

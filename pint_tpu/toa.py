@@ -466,6 +466,9 @@ class TOAs:
             col = getattr(self, attr, None)
             if col is not None:
                 setattr(out, attr, np.asarray(col)[idx])
+        extra = getattr(self, "extra", None)
+        if extra is not None:
+            out.extra = {k: np.asarray(v)[idx] for k, v in extra.items()}
         out.index = self.index[mask]
         out.tdb = None if self.tdb is None else MJD(self.tdb.day[mask],
                                                     self.tdb.frac[mask])
