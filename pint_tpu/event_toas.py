@@ -71,10 +71,12 @@ def load_fits_TOAs(eventfile: str, extname: str = "EVENTS",
         if h.name.upper() == extname.upper() and timecolumn in h:
             ev = h
             break
-    if ev is None:
-        # mission-specific extension names (XTE_SE, SC_DATA, ...): the
-        # reference reads the FIRST binary table (get_fits_TOAs
-        # extension=1, `/root/reference/src/pint/event_toas.py:300`)
+    if ev is None and extname == "EVENTS":
+        # mission-specific extension names (XTE_SE, SC_DATA, ...): with
+        # the DEFAULT extname, fall back to the first binary table with
+        # a time column, as the reference does (get_fits_TOAs
+        # extension=1, `/root/reference/src/pint/event_toas.py:300`).
+        # An explicitly requested extname still errors when absent.
         for h in hdus:
             if timecolumn in h and h.name.upper() != "GTI":
                 ev = h
@@ -224,7 +226,9 @@ def get_Fermi_TOAs(ft1name: str, weightcolumn: Optional[str] = None,
     toas = load_fits_TOAs(
         ft1name, weightcolumn=None if calc else weightcolumn,
         minmjd=minmjd, maxmjd=maxmjd, obs=obs,
-        extra_columns=("ENERGY", "RA", "DEC"))
+        # the photon columns are only needed for CALC weights; at 1e7
+        # photons they are ~240 MB of dead arrays otherwise
+        extra_columns=("ENERGY", "RA", "DEC") if calc else ())
     if calc:
         if targetcoord is None:
             raise ValueError("weightcolumn='CALC' needs targetcoord="

@@ -226,8 +226,11 @@ class SatelliteObs(Observatory):
         # np.interp clamps silently; an event outside the orbit table
         # would get the frozen endpoint position (km-scale error, ms of
         # barycentering) — refuse instead (the reference errors too).
-        # 60 s of slack tolerates edge rounding.
-        slack = 60.0 / 86400.0
+        # Slack: ~2 table sample intervals (clamp error within slack
+        # stays at the interpolation-error scale), not a fixed minute.
+        step = np.median(np.diff(self.mjd_tt)) if len(self.mjd_tt) > 1 \
+            else 1.0 / 86400.0
+        slack = 2.0 * float(step)
         if t.size and (t.min() < self.mjd_tt[0] - slack
                        or t.max() > self.mjd_tt[-1] + slack):
             raise ValueError(

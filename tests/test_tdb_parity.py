@@ -1,0 +1,74 @@
+"""TDB-TT chain parity against tempo2's own golden tt2tb columns.
+
+The reference computes TDB through astropy/ERFA's full 787-term FB90
+series (`Observatory.get_TDBs`); this package carries a truncated
+table + the topocentric term (:mod:`pint_tpu.tdbseries`).  Measured
+against the tempo2 truth shipped in the reference's artifacts, the
+full pipeline (geocentric series + topocentric term + exact two-part
+arithmetic) agrees to:
+
+* J1744-1134 golden per-TOA ``tt2tb`` (GBT, ~8 yr): 66 ns median,
+  193 ns max;
+* tempo2Test/T2output.dat daily ``tt2tdb`` (Arecibo, 2 yr): 63 ns
+  median, 256 ns max.
+
+The remaining ~70 ns per-TOA scatter is not harmonically modelable
+from the available truth (prewhitening fits reach 8 ns in-sample but
+DEGRADE a held-out era — measured 99 -> 50-65 ns — so no empirical
+correction ships); it is 2 orders below the ~8 us ephemeris accuracy
+floor.  These tests track the measured grade as a regression bound.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu import mjd as mjdmod
+
+DATA = "/root/reference/tests/datafile"
+T2DIR = "/root/reference/tempo2Test"
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.path.isfile(os.path.join(T2DIR, "T2output.dat")),
+        reason="reference tempo2 artifacts not present"),
+]
+
+
+def _pipeline_tdb_minus_tt(t):
+    tt = mjdmod.tai_to_tt(mjdmod.utc_to_tai(t.utc))
+    return ((np.asarray(t.tdb.day) - np.asarray(tt.day)) * 86400.0
+            + (np.asarray(t.tdb.frac) - np.asarray(tt.frac)) * 86400.0)
+
+
+def test_tdb_vs_tempo2_daily():
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import get_TOAs
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(os.path.join(T2DIR, "J0000+0000.par"))
+        t = get_TOAs(os.path.join(T2DIR, "J0000+0000.tim"), model=m)
+    gold = np.loadtxt(os.path.join(T2DIR, "T2output.dat"))[:, 3]
+    d = _pipeline_tdb_minus_tt(t) - gold
+    assert np.median(np.abs(d)) < 150e-9, np.median(np.abs(d))
+    assert np.abs(d).max() < 400e-9, np.abs(d).max()
+
+
+def test_tdb_vs_tempo2_j1744_per_toa():
+    from pint_tpu.ephemcal import ROEMER_SET, _read_golden
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import get_TOAs
+
+    _, par, tim, golden, _ = ROEMER_SET
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(os.path.join(DATA, par))
+        t = get_TOAs(os.path.join(DATA, tim), model=m)
+    gold = _read_golden(golden)[:, 2]
+    d = _pipeline_tdb_minus_tt(t) - gold
+    assert np.median(np.abs(d)) < 150e-9, np.median(np.abs(d))
+    assert np.abs(d).max() < 400e-9, np.abs(d).max()
