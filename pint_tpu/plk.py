@@ -24,7 +24,20 @@ drag       rubber-band a time range into the selection
 ``c``      clear the selection
 ``r``      reset everything (model, deletions, selection)
 ``w``      write ``plk.par`` (post-fit model)
+``m``      cycle the color mode (default/freq/obs/name/jump)
 =========  ========================================================
+
+The surrounding pintk workbench (reference `pintk/paredit.py`,
+`timedit.py`, `colormodes.py`) maps to:
+
+* :class:`ParEditor` / :class:`TimEditor` — text-level par/tim editing
+  bound to the panel: edit ``.text``, ``apply()`` rebuilds the model /
+  TOAs in place (undoable), ``reset()`` discards edits, ``write()``
+  saves.  No Tk text widget — any editor (or test) manipulates the
+  ``text`` attribute directly.
+* ``set_color_mode(mode)`` — color residuals by frequency band,
+  observatory, ``-name`` flag group, or JUMP assignment, with a legend
+  (reference `colormodes.py`'s Default/Freq/Obs/Name/Jump modes).
 
 The scripted entry point is ``tpintk --gui``; library use::
 
@@ -40,7 +53,12 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["PlkPanel", "run_auto_fit"]
+__all__ = ["PlkPanel", "ParEditor", "TimEditor", "run_auto_fit"]
+
+#: categorical palette for the non-default color modes (distinct at
+#: small marker sizes on white)
+_PALETTE = ("#46769c", "#c25b4e", "#5d9e63", "#8d6cab", "#c2903e",
+            "#4ea5b5", "#a84f79", "#7a7a32", "#5565c2", "#b0553a")
 
 
 def run_auto_fit(toas, model, maxiter=None):
@@ -100,11 +118,128 @@ class PlkPanel:
                 self.fig.canvas.mpl_disconnect(mgr.key_press_handler_id)
         except Exception:
             pass
+        self.color_mode = "default"
         self.fig.canvas.mpl_connect("button_press_event", self._on_press)
         self.fig.canvas.mpl_connect("button_release_event",
                                     self._on_release)
         self.fig.canvas.mpl_connect("key_press_event", self._on_key)
         self.replot()
+
+    # -- workbench editors -------------------------------------------------
+    @property
+    def paredit(self) -> "ParEditor":
+        """The par editor bound to this panel (created on first use)."""
+        if getattr(self, "_paredit", None) is None:
+            self._paredit = ParEditor(self)
+        return self._paredit
+
+    @property
+    def timedit(self) -> "TimEditor":
+        """The tim editor bound to this panel (created on first use)."""
+        if getattr(self, "_timedit", None) is None:
+            self._timedit = TimEditor(self)
+        return self._timedit
+
+    def set_model(self, model):
+        """Replace the timing model (ParEditor.apply): recompute the
+        pre-fit residuals, keep deletions/selection, drop post-fit
+        state.  Undoable — but via the EDITOR's revert (the undo stack
+        snapshots parameter VALUES of the live model object, which a
+        model swap replaces wholesale)."""
+        from pint_tpu.residuals import Residuals
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            self.prefit = Residuals(self.toas, model)
+        self.model = model
+        # error bars are MODEL-scaled (EFAC/EQUAD); refresh with the
+        # new model or the plot shows stale uncertainties
+        self.errs_us = np.asarray(self.prefit.get_data_error())
+        self._undo.clear()
+        self.postfit = None
+        self.fitter = None
+        self.message = "model replaced (par edit)"
+        self.replot()
+
+    def set_toas(self, toas):
+        """Replace the TOAs (TimEditor.apply): per-TOA state resets.
+        Residuals are computed BEFORE any panel state is touched, so a
+        failure leaves the panel fully consistent."""
+        from pint_tpu.residuals import Residuals
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            prefit = Residuals(toas, self.model)
+        self.toas = toas
+        self.prefit = prefit
+        n = toas.ntoas
+        self.selected = np.zeros(n, bool)
+        self.deleted = np.zeros(n, bool)
+        self.mjds = np.asarray(self.prefit.batch.tdbld)
+        self.errs_us = np.asarray(self.prefit.get_data_error())
+        self._undo.clear()
+        self.postfit = None
+        self.fitter = None
+        self.message = "TOAs replaced (tim edit)"
+        self.replot()
+
+    # -- color modes -------------------------------------------------------
+    #: cycle order for the 'm' key (reference colormodes.py mode set)
+    COLOR_MODES = ("default", "freq", "obs", "name", "jump")
+
+    def set_color_mode(self, mode: str):
+        if mode not in self.COLOR_MODES:
+            raise ValueError(f"unknown color mode {mode!r}; pick from "
+                             f"{self.COLOR_MODES}")
+        self.color_mode = mode
+        self.message = f"color mode: {mode}"
+        self.replot()
+
+    def _color_groups(self):
+        """``(labels_per_toa, {label: color})`` for the current mode;
+        None in default mode."""
+        n = self.toas.ntoas
+        mode = self.color_mode
+        if mode == "default":
+            return None, None
+        if mode == "freq":
+            # the reference's fixed bands (colormodes.py FreqMode)
+            f = np.asarray(self.prefit.batch.freq_mhz)
+            edges = [(0.0, 300.0, "<300 MHz"), (300.0, 400.0, "300-400"),
+                     (400.0, 500.0, "400-500"), (500.0, 700.0, "500-700"),
+                     (700.0, 1000.0, "700-1000"),
+                     (1000.0, 1800.0, "1000-1800"),
+                     (1800.0, 3000.0, "1800-3000"),
+                     (3000.0, np.inf, ">3000")]
+            labels = np.empty(n, object)
+            for lo, hi, lab in edges:
+                labels[(f >= lo) & (f < hi)] = lab
+            labels[~np.isfinite(f)] = "inf"
+            order = [lab for _, _, lab in edges] + ["inf"]
+            uniq = [lab for lab in order if (labels == lab).any()]
+            cmap = {lab: _PALETTE[i % len(_PALETTE)]
+                    for i, lab in enumerate(uniq)}
+            return labels, cmap
+        elif mode == "obs":
+            labels = np.asarray([str(o) for o in self.toas.obs],
+                                object)
+        elif mode == "name":
+            labels = np.asarray(
+                [fl.get("name", fl.get("f", "?"))
+                 for fl in self.toas.flags], object)
+        elif mode == "jump":
+            labels = np.full(n, "no jump", object)
+            from pint_tpu.models.parameter import MaskParam
+
+            for nm in self.model.params:
+                par = self.model[nm]
+                if isinstance(par, MaskParam) and nm.startswith("JUMP"):
+                    m = par.select_mask(self.toas)
+                    labels[np.asarray(m)] = nm
+        uniq = sorted(set(labels))
+        cmap = {lab: _PALETTE[i % len(_PALETTE)]
+                for i, lab in enumerate(uniq)}
+        return labels, cmap
 
     # -- state snapshots ---------------------------------------------------
     def _snapshot(self):
@@ -254,6 +389,10 @@ class PlkPanel:
         elif key == "w":
             self.write_par()
             self.replot()
+        elif key == "m":
+            i = self.COLOR_MODES.index(self.color_mode)
+            self.set_color_mode(
+                self.COLOR_MODES[(i + 1) % len(self.COLOR_MODES)])
 
     # -- drawing -----------------------------------------------------------
     def _current_resids_us(self):
@@ -266,9 +405,22 @@ class PlkPanel:
         ax = self.ax
         ax.clear()
         alive = ~self.deleted
-        ax.errorbar(self.mjds[alive], r_us[alive],
-                    yerr=self.errs_us[alive], fmt=".", ms=4, lw=0.7,
-                    color="#46769c", ecolor="#b8c8d8", zorder=2)
+        labels, cmap = self._color_groups()
+        if labels is None:
+            ax.errorbar(self.mjds[alive], r_us[alive],
+                        yerr=self.errs_us[alive], fmt=".", ms=4, lw=0.7,
+                        color="#46769c", ecolor="#b8c8d8", zorder=2)
+        else:
+            for lab, color in cmap.items():
+                s = alive & (labels == lab)
+                if not s.any():
+                    continue
+                ax.errorbar(self.mjds[s], r_us[s],
+                            yerr=self.errs_us[s], fmt=".", ms=4,
+                            lw=0.7, color=color, ecolor="#c8c8c8",
+                            zorder=2, label=str(lab))
+            ax.legend(loc="best", fontsize=7, ncol=2,
+                      title=self.color_mode, title_fontsize=7)
         if self.selected.any():
             s = self.selected & alive
             ax.plot(self.mjds[s], r_us[s], "o", ms=7, mfc="none",
@@ -285,3 +437,119 @@ class PlkPanel:
         import matplotlib.pyplot as plt
 
         plt.show()
+
+
+class ParEditor:
+    """Text-level par editing bound to a :class:`PlkPanel` (reference
+    `pintk/paredit.py`'s ParWidget, minus the Tk text box: ``text`` IS
+    the editor buffer).
+
+    Workflow: read/modify ``.text`` -> :meth:`apply` (rebuild the model
+    and the panel's pre-fit residuals; a bad par is rejected with the
+    error in ``panel.message`` — the edited text stays in the buffer
+    for fixing) -> fit/undo on the panel as usual -> :meth:`write`.  :meth:`reset` re-serializes the panel's CURRENT
+    model (discarding unapplied edits); :meth:`reload` goes back to the
+    par file loaded on disk."""
+
+    def __init__(self, panel: PlkPanel):
+        self.panel = panel
+        self.text = panel.model.as_parfile()
+
+    def reset(self):
+        """Discard unapplied edits (reference ParActionsWidget
+        'remove changes')."""
+        self.text = self.panel.model.as_parfile()
+
+    def reload(self):
+        """Back to the on-disk par file (reference 'reset par file')."""
+        with open(self.panel.parfile) as fh:
+            self.text = fh.read()
+
+    def apply(self) -> bool:
+        """Build a model from ``text`` and install it in the panel;
+        returns False (panel message set, text kept) when the par does
+        not parse."""
+        from pint_tpu.models import get_model
+
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                model = get_model(self.text.splitlines())
+            # get_model is lenient (unknown lines warn-and-drop), so
+            # a garbage buffer can yield a componentless model; the
+            # panel needs at least a spin model to compute phases
+            if "Spindown" not in model.components:
+                raise ValueError(
+                    "parsed model has no spin component (F0 missing "
+                    "or unparseable par text)")
+        except Exception as e:
+            self.panel.message = (f"par edit rejected: "
+                                  f"{type(e).__name__}: {e}")
+            self.panel.replot()
+            return False
+        self.panel.set_model(model)
+        return True
+
+    def write(self, path: str = "edited.par") -> str:
+        with open(path, "w") as fh:
+            fh.write(self.text)
+        self.panel.message = f"wrote {path}"
+        return path
+
+
+class TimEditor:
+    """Text-level tim editing bound to a :class:`PlkPanel` (reference
+    `pintk/timedit.py`'s TimWidget).  ``apply()`` re-runs the full TOA
+    preparation pipeline on the edited text."""
+
+    def __init__(self, panel: PlkPanel):
+        self.panel = panel
+        self.text = self._read_tim()
+
+    def _read_tim(self) -> str:
+        fn = self.panel.toas.filename
+        if not isinstance(fn, str):
+            raise ValueError(
+                "these TOAs carry no tim-file path (built from arrays "
+                "or a non-string source); TimEditor needs a loaded tim")
+        with open(fn) as fh:
+            return fh.read()
+
+    def reset(self):
+        """Discard unapplied edits: re-read the panel's loaded tim."""
+        self.text = self._read_tim()
+
+    def apply(self) -> bool:
+        """Parse ``text`` as a tim file and install the TOAs; returns
+        False (message set, panel untouched) on a parse/prepare
+        error."""
+        import os
+        import tempfile
+
+        from pint_tpu.toa import get_TOAs
+
+        fd, tmp = tempfile.mkstemp(suffix=".tim")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                fh.write(self.text)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                toas = get_TOAs(tmp, model=self.panel.model)
+            toas.filename = self.panel.toas.filename
+            # set_toas computes residuals before touching panel state,
+            # so a model/TOA mismatch rejects cleanly too
+            self.panel.set_toas(toas)
+        except Exception as e:
+            self.panel.message = (f"tim edit rejected: "
+                                  f"{type(e).__name__}: {e}")
+            self.panel.replot()
+            return False
+        finally:
+            os.unlink(tmp)
+        return True
+
+    def write(self, path: str = "edited.tim") -> str:
+        with open(path, "w") as fh:
+            fh.write(self.text)
+        self.panel.message = f"wrote {path}"
+        return path

@@ -132,3 +132,112 @@ def test_write_par(panel, tmp_path):
     out = panel.write_par(str(tmp_path / "plk.par"))
     text = open(out).read()
     assert "F0" in text and "PSR" in text
+
+
+@needs_data
+def test_color_modes(panel):
+    panel.reset()
+    assert panel.color_mode == "default"
+    panel.set_color_mode("freq")
+    labels, cmap = panel._color_groups()
+    assert labels is not None and len(labels) == panel.toas.ntoas
+    assert set(labels) == set(cmap)
+    panel.set_color_mode("obs")
+    labels, cmap = panel._color_groups()
+    assert set(labels) <= set(np.asarray(panel.toas.obs))
+    # 'm' cycles through every mode and wraps
+    panel.set_color_mode("default")
+    seen = []
+    for _ in panel.COLOR_MODES:
+        _key(panel, "m")
+        seen.append(panel.color_mode)
+    assert seen[-1] == "default" and set(seen) == set(panel.COLOR_MODES)
+    with pytest.raises(ValueError):
+        panel.set_color_mode("nope")
+
+
+@needs_data
+def test_jump_color_mode():
+    """JUMP grouping on a dataset that has real JUMPs (B1855 9yv1)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        p = PlkPanel(os.path.join(REFDATA, "B1855+09_NANOGrav_9yv1.gls.par"),
+                     os.path.join(REFDATA, "B1855+09_NANOGrav_9yv1.tim"))
+    p.set_color_mode("jump")
+    labels, cmap = p._color_groups()
+    jump_labels = {l for l in set(labels) if l.startswith("JUMP")}
+    assert jump_labels, "expected at least one JUMP group"
+    assert "no jump" in set(labels)
+
+
+@needs_data
+def test_paredit_roundtrip(panel, tmp_path):
+    """Edit-par -> apply -> refit -> reject-bad-par -> write (the
+    reference paredit workflow, headless)."""
+    panel.reset()
+    ed = panel.paredit
+    assert "F0" in ed.text
+    # perturb F1 via the text buffer and apply
+    f0_orig = float(panel.model.F0.value)
+    lines = []
+    for ln in ed.text.splitlines():
+        if ln.startswith("F0"):
+            parts = ln.split()
+            parts[1] = repr(f0_orig + 1e-9)
+            ln = " ".join(parts)
+        lines.append(ln)
+    ed.text = "\n".join(lines)
+    assert ed.apply()
+    assert float(panel.model.F0.value) == pytest.approx(f0_orig + 1e-9)
+    # refit pulls F0 back toward the data...
+    _key(panel, "f")
+    assert abs(float(panel.model.F0.value) - f0_orig) < 1e-9
+    # ...and undo restores the edited (pre-fit) par exactly
+    _key(panel, "u")
+    assert float(panel.model.F0.value) == pytest.approx(f0_orig + 1e-9,
+                                                        abs=0.0)
+    _key(panel, "f")
+    # a broken par is rejected, panel keeps the applied model
+    good_f0 = float(panel.model.F0.value)
+    ed.text = "this is not a par file"
+    assert not ed.apply()
+    assert "rejected" in panel.message
+    assert float(panel.model.F0.value) == good_f0
+    # reset re-serializes the live model; write saves the buffer
+    ed.reset()
+    assert "F0" in ed.text
+    out = ed.write(str(tmp_path / "ed.par"))
+    assert "F0" in open(out).read()
+    # reload returns to the on-disk par
+    ed.reload()
+    assert "F0" in ed.text
+
+
+@needs_data
+def test_timedit_roundtrip(panel, tmp_path):
+    panel.reset()
+    ed = panel.timedit
+    n0 = panel.toas.ntoas
+    # drop the last TOA line
+    lines = ed.text.rstrip("\n").splitlines()
+    toa_idx = [i for i, ln in enumerate(lines)
+               if ln.strip() and not ln.lstrip().startswith(("C", "#",
+                                                             "FORMAT",
+                                                             "MODE"))]
+    del lines[toa_idx[-1]]
+    ed.text = "\n".join(lines) + "\n"
+    assert ed.apply()
+    assert panel.toas.ntoas == n0 - 1
+    assert panel.selected.shape[0] == n0 - 1
+    # garbage tim is rejected, panel untouched
+    ed.text = "FORMAT 1\nnot a toa line at all\n"
+    nkeep = panel.toas.ntoas
+    assert not ed.apply()
+    assert "rejected" in panel.message
+    assert panel.toas.ntoas == nkeep
+    # reset restores the on-disk text; apply returns to full set
+    ed.reset()
+    assert ed.apply()
+    assert panel.toas.ntoas == n0
+    out = ed.write(str(tmp_path / "ed.tim"))
+    assert os.path.getsize(out) > 0
