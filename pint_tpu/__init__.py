@@ -37,16 +37,64 @@ jax.config.update("jax_enable_x64", True)
 # pytest run / CLI invocation / bench subprocess should pay the compile once
 # per machine, not once per process.  PINT_TPU_XLA_CACHE=0 disables; =1 (or
 # unset) uses the default ~/.cache location; any other value is the cache
-# directory.  An explicit JAX_COMPILATION_CACHE_DIR (or a prior programmatic
-# setting) wins.
+# BASE directory — entries land in <base>/<host-fingerprint> (see below).
+# An explicit JAX_COMPILATION_CACHE_DIR (or a prior programmatic setting)
+# wins and is used verbatim.
+#
+# MEASURED (2026-08, tunneled v5e): a cache HIT loads a big executable in
+# ~10 s (trace + deserialize + upload over the ~10-20 MB/s tunnel) vs
+# 120-160 s compiling cold — so a warm bench's "compile_s" is load cost,
+# not a recompile.  The cache directory carries a HOST FINGERPRINT
+# segment: XLA:CPU entries are AOT-compiled against the build host's CPU
+# features, and loading them on a different machine generation logs
+# "machine feature mismatch ... could lead to SIGILL" — a shared cache
+# dir across hosts risks exactly that.
+
+
+def _host_key() -> str:
+    """8-hex fingerprint of the host CPU generation (the features XLA:CPU
+    AOT results are specialized to)."""
+    import hashlib
+    import platform
+
+    src = platform.machine() + platform.processor()
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    src += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1(src.encode()).hexdigest()[:8]
+
+
 _cache_flag = _os.environ.get("PINT_TPU_XLA_CACHE", "1")
 if _cache_flag != "0":
     if jax.config.jax_compilation_cache_dir is None:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            _os.path.expanduser(
-                _cache_flag if _cache_flag not in ("", "1") else
-                "~/.cache/pint_tpu/xla"))
+        _base = _os.path.expanduser(
+            _cache_flag if _cache_flag not in ("", "1") else
+            "~/.cache/pint_tpu/xla")
+        _dir = _os.path.join(_base, _host_key())
+        # migrate pre-fingerprint flat entries once — ONLY for the
+        # package-owned default location (a user-supplied base may be a
+        # shared directory like ~/.cache whose files must not be
+        # linked); foreign-host entries whose program keys never match
+        # are simply dead files
+        if _cache_flag in ("", "1") and _os.path.isdir(_base) \
+                and not _os.path.isdir(_dir):
+            try:
+                _os.makedirs(_dir, exist_ok=True)
+                for _f in _os.listdir(_base):
+                    _src = _os.path.join(_base, _f)
+                    if _os.path.isfile(_src):
+                        try:
+                            _os.link(_src, _os.path.join(_dir, _f))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
+        jax.config.update("jax_compilation_cache_dir", _dir)
     if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in _os.environ:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           1.0)
