@@ -96,6 +96,28 @@ ROEMER_SET = ("j1744", "J1744-1134.basic.par",
               "J1744-1134.Rcvr1_2.GASP.8y.x.tim",
               "J1744-1134.basic.par.tempo2_test", 3)
 
+#: Fermi-LAT photon "truth": the J0030 GEO FT1 file carries a
+#: tempo2-Fermi-plugin PULSE_PHASE column (DE405) along RA ~0h over
+#: 2008-2016 — a direction/era the radio goldens barely constrain.
+#: MEASURED (2026-08) TO BE UNUSABLE as calibration input: the column's
+#: producing par is unknown, so the phase difference mixes
+#: timing-model differences (F0/astrometry offsets are smooth annual/
+#: secular curves, exactly degenerate with line-of-sight ephemeris
+#: error in a single direction) with the geometry — adding it degraded
+#: the B1855 holdout 11 -> 78 us (right sign; 245 us wrong sign).
+#: `collect_fermi_gap` remains as the harness for the day a
+#: same-par photon dataset exists; it is NOT in `collect_all`.
+FERMI_GAP_SET = ("j0030_fermi", "PSRJ0030+0451_psrcat.par",
+                 "J0030+0451_P8_15.0deg_239557517_458611204_"
+                 "ft1weights_GEO_wt.gt.0.4.fits")
+
+
+def _los_names():
+    """The line-of-sight dataset names, in fit/report order (single
+    source for fit_correction and main — a dataset present in the
+    observables but missing here would be silently unfit)."""
+    return list(GAP_SETS) + ["j1744", FERMI_GAP_SET[0]]
+
 #: per-TOA "sigma" [m] — not measurement noise (identical TOAs cancel in
 #: the difference) but the size of NON-ephemeris model differences vs
 #: tempo2 (TDB series ~100 ns, clock interpolation, binary integration)
@@ -232,6 +254,48 @@ def collect_roemer():
     assert gold.shape[0] == len(ours), (gold.shape, len(ours))
     return {"mjd": np.asarray(batch.tdbld), "y": gold[:, col] - ours,
             "n": n, "tt2tb": gold[:, 2]}
+
+
+def collect_fermi_gap():
+    """Per-photon ``(mjd_tdb, y_sec, n)`` from the J0030 GEO FT1 file's
+    tempo2-plugin PULSE_PHASE column.  NOT used by `collect_all` — see
+    the FERMI_GAP_SET note: without the producing par, timing-model
+    differences contaminate the curve inseparably.
+
+    Sign: our model phase minus the plugin's is ``F0 * (delay_gold -
+    delay_ours)``; with the barycentric correction entering the delay
+    as ``-n.r/c``, that is ``-n.delta/c`` — so y (= truth-minus-ours
+    light time, like every other row here) is MINUS the wrapped phase
+    difference over F0 (confirmed: this sign fits the photon curve to
+    12 us where the opposite leaves 55)."""
+    from pint_tpu import qs
+    from pint_tpu.event_toas import get_event_TOAs
+    from pint_tpu.residuals import Residuals
+
+    # gaps must be measured against the RAW base — the other
+    # collectors get this from _force_cpu_base, but this one is
+    # documented for standalone use too
+    os.environ["PINT_TPU_NO_EPH_CORR"] = "1"
+    name, par, ft1 = FERMI_GAP_SET
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        from pint_tpu.models import get_model
+
+        m = get_model(os.path.join(REFDATA, par))
+        toas = get_event_TOAs(os.path.join(REFDATA, ft1),
+                              ephem="DE421", planets=False,
+                              extra_columns=("PULSE_PHASE",))
+        pp = toas.extra["PULSE_PHASE"]
+        r = Residuals(toas, m, subtract_mean=False)
+        ph = m.calc.phase(r.pdict, r.batch)
+    _, frac = qs.round_nearest(ph)
+    ours = np.asarray(qs.to_f64(frac)) % 1.0
+    P = 1.0 / float(m.F0.value)
+    d = ((ours - pp + 0.5) % 1.0 - 0.5) * P
+    n, _ = _psr_dirs(m, r.batch, r.pdict)
+    return {"mjd": np.asarray(r.batch.tdbld), "y": -d, "n": n}
 
 
 def anchor_rows():
@@ -374,7 +438,7 @@ def fit_correction(obs, exclude=(), knot_days=60.0, cm_knot_days=180.0,
     """
     from scipy.interpolate import BSpline
 
-    los_names = [nm for nm in list(GAP_SETS) + ["j1744"]
+    los_names = [nm for nm in _los_names()
                  if nm in obs and nm not in exclude]
     t_all = [obs[nm]["mjd"] for nm in ("anchor", "testtimes")
              if nm in obs and nm not in exclude]
@@ -605,7 +669,7 @@ def main(argv=None):
 
     fit = fit_correction(obs, knot_days=args.knot_days,
                          lam_smooth=args.lam_smooth)
-    for nm in list(GAP_SETS) + ["j1744"]:
+    for nm in _los_names():
         if nm in obs:
             ev = eval_dataset(obs, nm, fit)
             print(f"  {nm}: {ev['before_us']:.1f} -> "

@@ -346,10 +346,15 @@ def shared_ephem_cache(tmp_path_factory):
 
 
 class TestIntegratedEphemeris:
-    def test_matches_analytic_and_is_smooth(self, shared_ephem_cache):
-        """The IC-fitted N-body trajectory stays within the analytic
-        theory's own error band (~300 km) and its spline velocity is
-        consistent with finite differences of position."""
+    def test_matches_analytic_and_is_smooth(self, shared_ephem_cache,
+                                            monkeypatch):
+        """The RAW IC-fitted N-body trajectory stays within the
+        analytic theory's own error band (~300 km), the default
+        CORRECTED path sits within the known true offset of the
+        analytic theory from DE (~2000 km — the correction moves Earth
+        TOWARD truth, away from the analytic series), and the spline
+        velocity is consistent with finite differences of position."""
+        monkeypatch.setenv("PINT_TPU_NO_EPH_CORR", "1")
         ieph = ephemeris.IntegratedEphemeris(warn=False)
         aeph = ephemeris.BuiltinEphemeris(warn=False)
         mjd = np.linspace(54800.0, 55200.0, 50)
@@ -364,6 +369,21 @@ class TestIntegratedEphemeris:
         pm = ieph.posvel("earth", mjd - h).pos
         v_fd = (pp - pm) / (2 * h * 86400.0)
         assert np.max(np.abs(v_fd - pi.vel)) < 1.0  # m/s
+        # corrected default: offset from analytic = the real DE-vs-
+        # analytic discrepancy (measured ~1900 km peak in this era).
+        # The LOWER bound is the live check that the correction is
+        # actually being served — the raw trajectory sits ~200 km from
+        # analytic, so a silently-disabled correction would fail it.
+        monkeypatch.delenv("PINT_TPU_NO_EPH_CORR")
+        ceph = ephemeris.IntegratedEphemeris(warn=False)
+        dc = np.linalg.norm(ceph.posvel("earth", mjd).pos - pa.pos,
+                            axis=1)
+        assert np.max(dc) < 4e6
+        assert np.max(dc) > 1e6
+        v_fd_c = (ceph.posvel("earth", mjd + h).pos
+                  - ceph.posvel("earth", mjd - h).pos) / (2 * h * 86400.0)
+        assert np.max(np.abs(v_fd_c - ceph.posvel("earth", mjd).vel)) \
+            < 1.0
 
     def test_sun_from_integration(self, shared_ephem_cache):
         ieph = ephemeris.IntegratedEphemeris(warn=False)
