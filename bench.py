@@ -392,6 +392,29 @@ def _run_in_subprocess(func_name: str, timeout_s: float = 900):
         f"tail: {out.stderr[-300:]}")
 
 
+def _probe_accelerator(timeout_s: float = 300.0):
+    """None if the accelerator backend responds, else a string saying
+    HOW it failed (hang vs crash — they need different debugging).
+    Checked in a subprocess: a wedged tunnel hangs jax.devices()
+    itself (observed 2026-08), which would otherwise hang the whole
+    bench with no output for the driver to record."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return (f"jax.devices() did not return within {timeout_s:.0f} s "
+                "in a probe subprocess (wedged tunnel)")
+    if out.returncode != 0:
+        return ("backend probe subprocess failed "
+                f"(rc {out.returncode}); stderr tail: "
+                + out.stderr[-400:])
+    return None
+
+
 def main():
     # persistent XLA cache: repeat runs load executables instead of
     # recompiling (measured ~10 s load vs 120-160 s compile per big
@@ -401,6 +424,15 @@ def main():
     os.environ.setdefault("PINT_TPU_XLA_CACHE",
                           os.path.join(CACHE, "xla_cache"))
     os.environ.setdefault("PINT_TPU_CACHE", os.path.join(CACHE, "ephem"))
+    fail = _probe_accelerator()
+    if fail is not None:
+        log("accelerator backend unavailable:", fail)
+        print(json.dumps({
+            "metric": "wls_chisq_grid_3x3_J0740class_12500toas_86params",
+            "value": None, "unit": "s", "vs_baseline": None,
+            "error": f"accelerator backend unavailable: {fail}",
+        }))
+        return
     import jax
 
     import pint_tpu  # noqa: F401  (wires the compilation cache)
