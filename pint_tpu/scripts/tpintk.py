@@ -6,6 +6,7 @@ REPL with matplotlib (Agg) plot output:
 
     fit [maxiter]        run the auto-selected fitter
     plot [file.png]      pre/post-fit residual plot
+    setpar PAR VALUE     edit one parameter value (par-file syntax)
     freeze PAR / thaw PAR
     select MJD1 MJD2     keep only TOAs in the range
     reset                restore the full TOA set
@@ -82,6 +83,36 @@ class PintkSession:
         plt.close(fig)
         return f"wrote {outfile}"
 
+    #: parameters baked into the TOAs at load time (get_TOAs(model=),
+    #: toa.py) — changing them here would silently leave stale TOA
+    #: preparation; they need a session reload
+    _LOAD_TIME_PARAMS = ("EPHEM", "CLOCK", "PLANET_SHAPIRO")
+
+    def cmd_setpar(self, name: str, value: str) -> str:
+        """Edit one parameter value (the REPL's slice of the pintk
+        paredit workflow; full text-level editing is
+        `pint_tpu.plk.ParEditor` on the GUI panel)."""
+        from pint_tpu.residuals import Residuals
+
+        uname = name.upper()
+        if uname in self._LOAD_TIME_PARAMS:
+            return (f"{uname} is baked into the loaded TOAs (clock/"
+                    "ephemeris preparation); edit the par file and "
+                    "restart the session instead")
+        par = self.model[uname]
+        old = par.value
+        par.set_from_string(value)   # the par-file value parser
+        try:
+            self.prefit = Residuals(self.toas, self.model)
+        except Exception:
+            # a value the pipeline cannot evaluate must not leave the
+            # session half-updated (new value, old residuals)
+            par.value = old
+            raise
+        self.postfit = None
+        self.fitter = None
+        return f"{uname} = {par.value} (was {old})"
+
     def cmd_freeze(self, name: str) -> str:
         self.model[name.upper()].frozen = True
         return f"{name.upper()} frozen"
@@ -131,8 +162,8 @@ class PintkSession:
             raise EOFError
         handler = getattr(self, f"cmd_{cmd}", None)
         if handler is None:
-            return (f"unknown command {cmd!r} (fit/plot/freeze/thaw/"
-                    "select/reset/summary/write/quit)")
+            return (f"unknown command {cmd!r} (fit/plot/setpar/freeze/"
+                    "thaw/select/reset/summary/write/quit)")
         return handler(*args)
 
 

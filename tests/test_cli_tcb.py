@@ -215,6 +215,24 @@ class TestTpintk:
         assert m.F1.frozen            # freeze honored through the fit
         assert m.CHI2.value is not None
 
+    def test_setpar(self, tmp_path, capsys):
+        from pint_tpu.scripts import tpintk, tzima
+
+        par = tmp_path / "k.par"
+        par.write_text(PAR_TDB.strip() + "\n")
+        tim = str(tmp_path / "k.tim")
+        tzima.main([str(par), tim, "--ntoa", "15", "--startMJD", "54800",
+                    "--duration", "200", "--quiet"])
+        out = str(tmp_path / "edited.par")
+        rc = tpintk.main([str(par), tim, "--quiet",
+                          "-c", "setpar F1 -1.5e-14",
+                          "-c", f"write {out}",
+                          "-c", "quit"])
+        assert rc == 0
+        assert "was" in capsys.readouterr().out
+        m = load(open(out).read())
+        assert float(m.F1.value) == pytest.approx(-1.5e-14)
+
     def test_bad_command_keeps_session(self, tmp_path, capsys):
         from pint_tpu.scripts import tpintk, tzima
 
