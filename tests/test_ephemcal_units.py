@@ -110,3 +110,34 @@ class TestFitCorrection:
         fit = ec.fit_correction(obs, cm_amp_m=None, verbose=False)
         ev = ec.eval_dataset(obs, "set0", fit)
         assert ev["after_us"] < 0.5 * ev["before_us"]
+
+
+class TestHoldoutRegression:
+    def test_b1855_holdout_prediction(self, monkeypatch):
+        """The calibration's pure-holdout prediction on B1855 (fit
+        WITHOUT it, predict its gap curve): measured 13.7 us median
+        (2026-08).  Locks the generalization quality of the method —
+        a structural regression (bad knots, sign flip, common-mode
+        reintroduction) shows up here before it reaches the baked
+        table.  Requires EVERY collection cache (a fresh collection
+        costs ~10 min of TOA pipelines — and re-collecting here
+        without the raw-base env guard would poison the caches with
+        corrected-base gaps, hence the monkeypatched env)."""
+        import os
+
+        import pytest
+
+        # any re-collection must measure the RAW base (scoped, unlike
+        # ephemcal._force_cpu_base which mutates global env)
+        monkeypatch.setenv("PINT_TPU_NO_EPH_CORR", "1")
+        cache = ec._cache_dir()
+        needed = ["anchor", "testtimes", "j1744"] + list(ec.GAP_SETS)
+        if not all(os.path.isfile(os.path.join(cache, f"{n}.npz"))
+                   for n in needed):
+            pytest.skip("calibration observable caches not present")
+        obs = ec.collect_all(verbose=False)
+        fit = ec.fit_correction(obs, exclude=("b1855_9y",),
+                                verbose=False)
+        ev = ec.eval_dataset(obs, "b1855_9y", fit)
+        assert ev["after_us"] < 30.0, ev
+        assert ev["after_us"] < 0.3 * ev["before_us"], ev
