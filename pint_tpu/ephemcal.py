@@ -354,7 +354,20 @@ def _base_stamp():
 def collect_all(refresh=False, verbose=True):
     """Collect every observable into per-dataset npz caches (stamped
     with the base-ephemeris version — a stale cache re-collects
-    automatically); returns the dict of loaded arrays."""
+    automatically); returns the dict of loaded arrays.
+
+    REFUSES to run with the baked correction live: gaps measured
+    against the corrected base are near zero, and a later refit from
+    such caches would bake a corrupted (near-zero) table.  Call
+    :func:`_force_cpu_base` first, or set ``PINT_TPU_NO_EPH_CORR=1``
+    (scoped, e.g. monkeypatch) yourself."""
+    if os.environ.get("PINT_TPU_NO_EPH_CORR") != "1":
+        raise RuntimeError(
+            "collect_all measures gaps against the RAW base; set "
+            "PINT_TPU_NO_EPH_CORR=1 (or call "
+            "ephemcal._force_cpu_base()) before collecting — with the "
+            "baked correction live the caches would be poisoned with "
+            "near-zero gaps")
     cache = _cache_dir()
     stamp = _base_stamp()
     out = {}
@@ -507,9 +520,13 @@ def fit_correction(obs, exclude=(), knot_days=60.0, cm_knot_days=180.0,
         rows_b.append(y * C)
         rows_w.append(np.full(len(t), 1.0 / sig))
 
-    # regularization: second differences scaled to constant-CURVATURE
-    # units ((60 d / local spacing)^2 — so the dense anchor-window
-    # knots are not over-penalized relative to the 60-day-tuned lam)
+    # regularization: plain (1,-2,1) coefficient second differences
+    # with one lam for all knots.  On the non-uniform grid this gives
+    # the 15-day dense anchor-window knots a ~16x WEAKER curvature
+    # penalty than the 60-day region — deliberately: the daily 3-D
+    # truth there supports sub-monthly structure, and rescaling the
+    # rows to constant-curvature units was MEASURED to erase exactly
+    # that benefit (anchor residual 72 m -> 3.4 km).
     D = _second_diff(nk)
     for ax in range(3):
         blk = blank(D.shape[0])
