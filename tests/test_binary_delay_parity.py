@@ -1,0 +1,91 @@
+"""Absolute BINARY-DELAY parity against the tempo/tempo2 golden columns
+the reference ships — near-ephemeris-free evidence (a us-scale Earth
+error enters the binary delay only through the orbital-phase drift,
+~5e-5 s of delay per second of epoch error).
+
+Golden sources and measured agreement (2026-08):
+
+* ``*.tempo_test`` files (libstempo): B1855 DD 1.3 ns median /
+  3.6 ns max.
+* ``*.tempo2_test`` BinaryDelay columns: B1953+29 BT 3.3/5.9 ns,
+  J0613 ELL1 0.8/2.7 ns, J0023 ELL1 8.4/13.3 ns, J1853 ELL1H
+  2.6/8.0 ns.
+
+Every golden column is MINUS our binary delay (the reference's own
+assertion is ``pint + ltbindelay < 1e-11``,
+`/root/reference/tests/test_dd.py:33-38`; tempo2's BinaryDelay column
+shares the convention).
+
+Asserted at ~3x the measured values.  This covers every binary family
+the goldens exercise (DD, BT, ELL1, ELL1H) end-to-end: tim parsing,
+clock chain, TDB, barycentric delays feeding the orbital phase, and
+the binary model itself.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.ephemcal import REFDATA as DATA
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.path.isdir(DATA), reason="reference datafiles absent"),
+]
+
+
+def _binary_delay(par, tim):
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import get_TOAs
+    from pint_tpu.utils import host_eager
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(os.path.join(DATA, par))
+        t = get_TOAs(os.path.join(DATA, tim), model=m)
+        p = m.build_pdict(t)
+        batch = t.to_batch()
+        binary = [c for c in m.calc.delay_components
+                  if getattr(c, "category", "") == "pulsar_system"][0]
+        with host_eager():
+            d_before = m.calc.delay(p, batch, upto="pulsar_system")
+            return np.asarray(binary.delay(p, batch, d_before))
+
+
+@pytest.mark.parametrize("par,tim,golden,med_ns,max_ns", [
+    # libstempo goldens: column is MINUS the binary delay
+    ("B1855+09_NANOGrav_dfg+12_modified_DD.par",
+     "B1855+09_NANOGrav_dfg+12.tim",
+     "B1855+09_NANOGrav_dfg+12_modified_DD.par.tempo_test",
+     5.0, 12.0),
+    # tempo2 goldens: BinaryDelay column (also negated)
+    ("B1953+29_NANOGrav_dfg+12_TAI_FB90.par",
+     "B1953+29_NANOGrav_dfg+12.tim",
+     "B1953+29_NANOGrav_dfg+12_TAI_FB90.par.tempo2_test",
+     10.0, 20.0),
+    ("J0613-0200_NANOGrav_dfg+12_TAI_FB90.par",
+     "J0613-0200_NANOGrav_dfg+12.tim",
+     "J0613-0200_NANOGrav_dfg+12_TAI_FB90.par.tempo2_test",
+     3.0, 9.0),
+    ("J0023+0923_NANOGrav_11yv0.gls.par",
+     "J0023+0923_NANOGrav_11yv0.tim",
+     "J0023+0923_NANOGrav_11yv0.gls.par.tempo2_test",
+     25.0, 40.0),
+    ("J1853+1303_NANOGrav_11yv0.gls.par",
+     "J1853+1303_NANOGrav_11yv0.tim",
+     "J1853+1303_NANOGrav_11yv0.gls.par.tempo2_test",
+     8.0, 25.0),
+])
+def test_binary_delay_vs_golden(par, tim, golden, med_ns, max_ns):
+    from pint_tpu.ephemcal import _read_golden
+
+    bd = _binary_delay(par, tim)
+    gold = _read_golden(golden)[:, 1]
+    assert gold.shape[0] == len(bd), (par, gold.shape, len(bd))
+    # every golden column is MINUS our delay (module docstring)
+    d = (bd + gold) * 1e9
+    assert np.median(np.abs(d)) < med_ns, np.median(np.abs(d))
+    assert np.abs(d).max() < max_ns, np.abs(d).max()
