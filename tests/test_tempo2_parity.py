@@ -194,3 +194,30 @@ class TestPostfitValueParity:
         # measured max 1.5 / median 0.5 sigma
         assert max(pulls) < 5.0
         assert np.median(pulls) < 2.0
+
+
+@needs_data
+class TestWhitenedParity:
+    def test_whitened_residuals_vs_tempo(self):
+        """Post-GLS-fit residuals minus the PL-red-noise realization,
+        against TEMPO's whitened residuals
+        (`B1855+09_NANOGrav_9yv1_whitened.tempo_test`; the reference's
+        `test_gls_fitter.py::test_whitening` asserts 10/50 ns with a
+        real JPL kernel).  The red-noise realization absorbs the SMOOTH
+        part of the residual ephemeris error; what remains here is the
+        mid-timescale part — measured 4.6 us std / 25 us max (2026-08),
+        tracked at ~2x as the whitening-quality gauge."""
+        m, t = _load()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f = GLSFitter(t, m)
+            f.fit_toas(maxiter=3)
+        red = np.asarray(f.noise_resids["PLRedNoise"])
+        _, twres = np.genfromtxt(
+            os.path.join(DATA,
+                         "B1855+09_NANOGrav_9yv1_whitened.tempo_test"),
+            unpack=True)
+        d = np.asarray(f.resids.time_resids) - red - twres * 1e-6
+        d -= d.mean()
+        assert d.std() < 10e-6, d.std()
+        assert np.abs(d).max() < 50e-6, np.abs(d).max()
