@@ -33,7 +33,10 @@ would then lack (holdout: prediction unchanged, served accuracy up to
 10x worse).  The sub-us physical clock/TDB differences leak into the
 per-dataset constants instead, which is harmless at this grade.
 Per-dataset constants absorb the arbitrary phase reference of each
-golden.
+golden; anything MORE per dataset eats real geometry — measured:
+per-dataset LINEAR nuisances (pre-detrending every gap curve)
+degrade the B1855 holdout 13.7 -> 113 us, because each curve's
+secular trend IS line-of-sight Sun-SSB drift.
 
 The correction is fit against the CANONICAL window build
 (`IntegratedEphemeris._CANONICAL`) — one fixed integration every
