@@ -26,16 +26,19 @@ try:  # hide the axon/TPU backend from the test session entirely
 except Exception:
     pass
 
-from hypothesis import HealthCheck, settings  # noqa: E402
-
-# jax op dispatch is slow per-call; deadlines are meaningless here (the
-# reference tunes hypothesis similarly in its conftest profiles).
-settings.register_profile(
-    "pint_tpu",
-    deadline=None,
-    suppress_health_check=[HealthCheck.too_slow],
-)
-settings.load_profile("pint_tpu")
+try:  # hypothesis is optional: fuzz tests importorskip it themselves
+    from hypothesis import HealthCheck, settings  # noqa: E402
+except ImportError:
+    pass
+else:
+    # jax op dispatch is slow per-call; deadlines are meaningless here (the
+    # reference tunes hypothesis similarly in its conftest profiles).
+    settings.register_profile(
+        "pint_tpu",
+        deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    settings.load_profile("pint_tpu")
 
 
 def pytest_report_header(config):
@@ -77,6 +80,7 @@ _SLOW_TESTS = {
     ("test_binary_dd.py", "TestOutOfRangeRobustness"),
     ("test_binary_ell1.py", "TestFitRoundtrip"),
     ("test_aux_components.py", "TestPLFlavors"),
+    ("test_design_split.py", "TestSpeed"),
 }
 
 

@@ -53,6 +53,56 @@ def _split_const(a):
     return _SPLIT_F64
 
 
+_guard_p = None
+
+
+def _make_guard_primitive():
+    """The EFT guard as a first-class primitive with its own autodiff and
+    batching rules.  ``jax.lax.optimization_barrier`` alone is the right
+    LOWERING, but on the pinned jax (0.4.x) its primitive has neither a
+    JVP nor a batching rule — so every ``jacfwd`` of the phase pipeline
+    (the entire design-matrix path) died with ``NotImplementedError``.
+    The guard is semantically the identity, so the rules are trivial:
+    tangents/cotangents pass through a guard of their own (the tangent
+    EFT chains are built from the same cancellation-sensitive arithmetic
+    and need the same simplifier protection), and batching maps
+    elementwise.  Only the lowering inserts the real barrier."""
+    import jax
+    from jax.interpreters import ad, batching, mlir
+
+    try:
+        from jax.extend import core as jcore
+    except ImportError:  # older layouts
+        from jax import core as jcore
+
+    p = jcore.Primitive("pint_tpu_eft_guard")
+    p.multiple_results = True
+    p.def_impl(lambda *ws: list(ws))
+    p.def_abstract_eval(lambda *avals: list(avals))
+
+    def jvp(primals, tangents):
+        out = p.bind(*primals)
+        nz = [(i, t) for i, t in enumerate(tangents)
+              if type(t) is not ad.Zero]
+        if nz:
+            guarded = iter(p.bind(*[t for _, t in nz]))
+            tangents = [t if type(t) is ad.Zero else next(guarded)
+                        for t in tangents]
+        else:
+            tangents = list(tangents)
+        return out, tangents
+
+    ad.primitive_jvps[p] = jvp
+    # linear (identity): cotangents pass straight through
+    ad.primitive_transposes[p] = lambda cts, *_: list(cts)
+    batching.primitive_batchers[p] = \
+        lambda args, dims, **kw: (p.bind(*args), list(dims))
+    mlir.register_lowering(p, mlir.lower_fun(
+        lambda *ws: jax.lax.optimization_barrier(tuple(ws)),
+        multiple_results=True))
+    return p
+
+
 def _guard(*words):
     """Pin EFT result words against value-changing compiler rewrites.
 
@@ -65,13 +115,15 @@ def _guard(*words):
     observed as ~1e-7-relative phase errors on the CPU backend (jit vs
     eager).  An ``optimization_barrier`` on every EFT output pair makes the
     transform opaque to the simplifier while remaining transparent to
-    autodiff and batching.  Host numpy paths need no guard.
+    autodiff and batching (via the guard primitive above).  Host numpy
+    paths need no guard.
     """
     if isinstance(words[0], np.ndarray) or np.isscalar(words[0]):
         return words if len(words) > 1 else words[0]
-    import jax
-
-    out = jax.lax.optimization_barrier(words)
+    global _guard_p
+    if _guard_p is None:
+        _guard_p = _make_guard_primitive()
+    out = _guard_p.bind(*words)
     return out if len(words) > 1 else out[0]
 
 

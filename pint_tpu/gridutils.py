@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.fitter import Fitter, build_wls_step
+from pint_tpu.fitter import (Fitter, _default_wls_kernel,
+                             build_whitened_assembly, wls_solve)
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.residuals import Residuals
 
@@ -70,23 +71,53 @@ def grid_in_axes(p: dict, grid_names: Sequence[str]) -> dict:
 
 def build_grid_fit_fn(model: TimingModel, batch, fit_params: Sequence[str],
                       track_mode: str, maxiter: int = 2,
-                      threshold: Optional[float] = None, kernel=None):
-    """``fit_one(p) -> (chi2, x)``: a full (fixed-iteration) WLS fit of one
-    pytree — vmap/shard_map this over stacked grid pytrees.  ``kernel``
-    forces a specific WLS solve kernel (default: backend-matched)."""
-    # host_finish=False: the grid is one vmapped XLA program; the
-    # all-device eigh kernel is right for chi2 maps (see build_wls_step)
-    step = build_wls_step(model, batch, fit_params, track_mode,
-                          threshold=threshold, kernel=kernel,
-                          host_finish=False)
+                      threshold: Optional[float] = None, kernel=None,
+                      design_matrix: Optional[str] = None):
+    """``fit_one(p, cols=None) -> (chi2, x)``: a full (fixed-iteration)
+    WLS fit of one pytree — vmap/shard_map this over stacked grid
+    pytrees.  ``kernel`` forces a specific WLS solve kernel (default:
+    backend-matched).
 
-    def fit_one(p):
-        x = jnp.zeros(len(fit_params))
+    With the split design-matrix path (the default), the linear-block
+    columns are computed ONCE per fit point — hoisted out of the
+    Gauss-Newton iteration loop in-graph — cutting the per-point JVP
+    fan-out from maxiter*P to P_lin + maxiter*P_nl tangents.  Columns
+    are deliberately NOT shared across grid points: the sharded path
+    (`pint_tpu.parallel`) computes them per point, and the two paths
+    must track each other to rounding even on ill-conditioned systems
+    where the Gauss-Newton iteration has not fully settled (the bench
+    asserts 1e-6 agreement).  ``cols`` lets a caller override the
+    columns explicitly; ``fit_one.assemble`` exposes the underlying
+    assembly (``.split``/``.lin_cols``)."""
+    names = list(fit_params)
+    # all-device solve: the grid is one vmapped XLA program; the
+    # eigh kernel is right for chi2 maps (see build_wls_step)
+    assemble = build_whitened_assembly(model, batch, names, track_mode,
+                                       include_offset=True,
+                                       design_matrix=design_matrix)
+    kern = _default_wls_kernel() if kernel is None else kernel
+
+    def step(x, p, cols):
+        if assemble.split:
+            c = assemble.lin_cols(x, p) if cols is None else cols
+            r, M, sigma, offc = assemble.inline_with_cols(x, p, c)
+        else:
+            r, M, sigma, offc = assemble.inline(x, p)
+        return wls_solve(jnp, r, M, sigma, offc, kern, len(names),
+                         threshold)
+
+    def fit_one(p, cols=None):
+        if assemble.split and cols is None:
+            # per-point hoist: one column computation shared by every
+            # iteration of this fit
+            cols = assemble.lin_cols(jnp.zeros(len(names)), p)
+        x = jnp.zeros(len(names))
         for _ in range(maxiter):
-            x = x + step(x, p)["dx"]
-        out = step(x, p)
+            x = x + step(x, p, cols)["dx"]
+        out = step(x, p, cols)
         return out["chi2"], x
 
+    fit_one.assemble = assemble
     return fit_one
 
 
@@ -104,17 +135,23 @@ def grid_chisq_flat(fitter: Fitter, grid_values: Dict[str, np.ndarray],
     p = r.pdict
     # cache the compiled vmapped fit on the fitter: a fresh jit wrapper
     # per call would retrace the whole grid program every time
-    key = (tuple(sorted(grid_values)), tuple(names), maxiter, kernel)
+    key = (tuple(sorted(grid_values)), tuple(names), maxiter, kernel,
+           getattr(fitter, "design_matrix", None))
     cache = getattr(fitter, "_grid_fit_cache", None)
     if cache is None:
         cache = fitter._grid_fit_cache = {}
     vfit = cache.get(key)
     if vfit is None:
-        fit_one = build_grid_fit_fn(model, r.batch, names,
-                                    fitter.track_mode, maxiter=maxiter,
-                                    kernel=kernel)
+        fit_one = build_grid_fit_fn(
+            model, r.batch, names, fitter.track_mode, maxiter=maxiter,
+            kernel=kernel,
+            design_matrix=getattr(fitter, "design_matrix", None))
         axes = grid_in_axes(p, list(grid_values))
-        vfit = cache[key] = jax.jit(jax.vmap(fit_one, in_axes=(axes,)))
+        # per-point cached columns (computed inside fit_one, hoisted out
+        # of its iteration loop) — see build_grid_fit_fn for why they
+        # are not shared across points
+        vfit = cache[key] = jax.jit(
+            jax.vmap(lambda pp: fit_one(pp), in_axes=(axes,)))
     stacked = stack_grid_pdict(model, p, grid_values)
     chi2, _ = vfit(stacked)
     return np.asarray(chi2)

@@ -102,7 +102,13 @@ def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
         nacc = jnp.sum(acc1) + jnp.sum(acc2)
         return (x, lnp), (x, lnp, nacc)
 
-    keys = jax.random.split(jax.random.PRNGKey(seed), nsteps)
+    # per-step keys indexed by ABSOLUTE step number (fold_in, not
+    # split(key, nsteps): split hashes the total count into every key on
+    # this jax version, so a 40-step and a 60-step run would draw
+    # unrelated sequences and resume could not be bitwise)
+    _base_key = jax.random.PRNGKey(seed)
+    keys = jax.vmap(lambda i: jax.random.fold_in(_base_key, i))(
+        jnp.arange(nsteps))
 
     @jax.jit
     def run(x0, lnp0, keys):

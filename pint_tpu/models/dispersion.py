@@ -154,6 +154,10 @@ class DispersionDMX(DelayComponent):
             out[f"{n}__rangemask"] = ((m >= r1) & (m <= r2)).astype(np.float64)
         return out
 
+    def linear_params(self):
+        # delay = K * DMX_i * rangemask_i / f^2: exactly linear per bin
+        return self.dmx_names()
+
     def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
         names = self.dmx_names()
         if not names:
@@ -211,6 +215,10 @@ class DispersionJump(DelayComponent):
             return MaskParam("DMJUMP", index=index, units="pc cm^-3")
         return None
 
+    def linear_params(self):
+        # dm_value = -sum DMJUMP_i * mask_i: exactly linear (zero delay)
+        return [par.name for par in self.dm_jumps]
+
     def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
         total = jnp.zeros(batch.ntoas)
         for par in self.dm_jumps:
@@ -264,6 +272,10 @@ class FDJumpDM(DelayComponent):
         if prefix == "FDJUMPDM":
             return MaskParam("FDJUMPDM", index=index, units="pc cm^-3")
         return None
+
+    def linear_params(self):
+        # delay = K * (-FDJUMPDM_i * mask_i) / f^2: exactly linear
+        return [par.name for par in self.fdjumps]
 
     def dm_value(self, p: dict, batch: TOABatch) -> jnp.ndarray:
         total = jnp.zeros(batch.ntoas)

@@ -59,6 +59,10 @@ class FD(DelayComponent):
             if n != f"FD{i + 1}":
                 raise ValueError(f"non-contiguous FD sequence at {n}")
 
+    def linear_params(self):
+        # delay = sum FDk * ln(f/1GHz)^k: exactly linear per coefficient
+        return self.fd_names()
+
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         names = self.fd_names()
         if not names:
@@ -127,6 +131,9 @@ class FDJump(DelayComponent):
             [par.index or 0 for par in self.fdjumps
              if self.fd_order(par.prefix or par.name) == order], default=0)
         return MaskParam(f"FD{order}JUMP", index=idx, units="s")
+
+    def linear_params(self):
+        return [par.name for par in self.fdjumps]
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         lf, finite = _log_freq_ghz(batch)

@@ -65,6 +65,13 @@ class IFunc(PhaseComponent):
             if sorted(mjds) != mjds:
                 raise ValueError("IFUNC control points must be MJD-sorted")
 
+    def linear_params(self):
+        # phase = interp(y; t) * F0 is linear in the (pair-valued)
+        # control points' ordinates; filtered out of the flat fit
+        # vector by TimingModel.linear_param_names until pairs become
+        # fittable (the abscissae would NOT be linear).
+        return self.ifunc_names()
+
     def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
         names = self.ifunc_names()
         if not names:

@@ -58,6 +58,11 @@ class PhaseJump(PhaseComponent):
             return MaskParam("JUMP", index=index, units="s")
         return None
 
+    def linear_params(self):
+        # phase = JUMP_i * F0 * mask_i, residual [s] = phase/F0: the
+        # column is exactly the mask, independent of every other param
+        return [jp.name for jp in self.jumps]
+
     def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
         total = jnp.zeros(batch.ntoas)
         f0 = pv(p, "F0")
@@ -90,6 +95,9 @@ class DelayJump(DelayComponent):
     @property
     def jumps(self):
         return [p for p in self.params.values() if isinstance(p, MaskParam)]
+
+    def linear_params(self):
+        return [jp.name for jp in self.jumps]
 
     def delay(self, p: dict, batch: TOABatch, delay) -> jnp.ndarray:
         total = jnp.zeros(batch.ntoas)

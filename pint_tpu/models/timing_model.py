@@ -215,6 +215,20 @@ class Component:
         quad-single words (phase-level precision).  Default: none."""
         return []
 
+    def linear_params(self) -> List[str]:
+        """Parameters whose delay/phase/dm contribution is EXACTLY linear
+        in the parameter value (amplitude-type: DMX bins, JUMPs, FD
+        terms, WAVE/WaveX amplitudes, IFUNC ordinates...).  Their
+        design-matrix columns are constant across Gauss-Newton
+        iterations up to second-order cross terms through the other
+        parameters, so the split-assembly path
+        (:func:`pint_tpu.fitter.build_whitened_assembly`) computes them
+        once and caches them — the TPU analogue of the reference's
+        ``d_phase_d_delay * d_delay_d_param`` registry
+        (`/root/reference/src/pint/models/timing_model.py:2157`).
+        Default: none (everything is treated as nonlinear)."""
+        return []
+
 
 class DelayComponent(Component):
     """A time-delay contribution [seconds]."""
@@ -431,6 +445,35 @@ class TimingModel:
     def get_params_dict(self, which="free") -> Dict[str, Param]:
         names = self.free_params if which == "free" else self.params
         return {n: self[n] for n in names}
+
+    @property
+    def linear_param_names(self) -> List[str]:
+        """Every parameter some component declares delay/phase/dm-LINEAR
+        (see :meth:`Component.linear_params`), restricted to scalar
+        on-device parameters — pair-valued parameters (WAVE/IFUNC control
+        points) cannot ride the flat fit vector anyway."""
+        out = []
+        for c in self.components.values():
+            for n in c.linear_params():
+                par = c.params.get(n)
+                if par is None or not par.on_device or par.value is None:
+                    continue
+                if np.ndim(par.device_value) != 0:
+                    continue
+                out.append(n)
+        return out
+
+    def partition_linear_params(
+            self, names: Sequence[str]) -> Tuple[List[str], List[str]]:
+        """Split ``names`` into ``(linear, nonlinear)`` — order preserved
+        within each block — using the components' linearity declarations.
+        The linear block's design-matrix columns are cacheable across
+        Gauss-Newton iterations; the nonlinear block (spin, astrometry,
+        DM polynomial, binary...) must be re-differentiated each step."""
+        linear = set(self.linear_param_names)
+        lin = [n for n in names if n in linear]
+        nl = [n for n in names if n not in linear]
+        return lin, nl
 
     # -- device pytree ----------------------------------------------------
     #

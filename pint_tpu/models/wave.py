@@ -87,6 +87,13 @@ class Wave(PhaseComponent):
             if self._parent is None or self._parent.PEPOCH.value is None:
                 raise ValueError("WAVEEPOCH or PEPOCH required with WAVE_OM")
 
+    def linear_params(self):
+        # phase = F0 * sum_k [A_k sin + B_k cos]: exactly linear in the
+        # (pair-valued) amplitudes.  NOTE pair params cannot ride the
+        # flat fit vector, so TimingModel.linear_param_names filters
+        # these out until pairs become fittable.
+        return self.wave_names()
+
     def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
         names = self.wave_names()
         if not names:
@@ -158,6 +165,13 @@ class _WaveXBasis:
     def _epoch_name(self) -> str:
         return self.epoch if self.params[self.epoch].value is not None \
             else "PEPOCH"
+
+    def linear_params(self):
+        # the SIN/COS amplitudes are exactly linear (the frequencies and
+        # epoch are not, and stay in the nonlinear block)
+        _, ss, cs = self.stems
+        return [f"{ss}{i:04d}" for i in self.wavex_indices()] + \
+            [f"{cs}{i:04d}" for i in self.wavex_indices()]
 
     def basis_sum(self, p: dict, batch: TOABatch, dt_shift_day) -> jnp.ndarray:
         """sum_i [ SIN_i sin(2 pi f_i dt) + COS_i cos(2 pi f_i dt) ].

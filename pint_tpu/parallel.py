@@ -75,7 +75,8 @@ def pad_batch(batch: TOABatch, multiple: int) -> TOABatch:
 
 def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
                            track_mode: str, mesh: Mesh,
-                           maxiter: int = 2, include_offset: bool = True):
+                           maxiter: int = 2, include_offset: bool = True,
+                           design_matrix: Optional[str] = None):
     """``fit(stacked_p, batch) -> (chi2[G], x[G,P])`` with grid points
     sharded over the mesh's "batch" axis and TOAs over its "toa" axis.
 
@@ -83,10 +84,29 @@ def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
     preconditioning, assembled from per-shard partial sums (`psum` over
     "toa") — the distributed-WLS formulation that rides ICI collectives
     instead of gathering rows.
+
+    Split design matrix (the default): the linear-block columns are
+    differentiated ONCE per fit — outside the Gauss-Newton loop — on
+    each shard's local TOA rows (columns shard row-wise, so the cached
+    block partitions over the "toa" mesh axis with no extra
+    collectives); each iteration re-differentiates only the nonlinear
+    core.  Same structure as :func:`pint_tpu.fitter._make_assembly`.
     """
+    from pint_tpu.fitter import _resolve_design_matrix
+
     calc = model.calc
     names = list(fit_params)
     npar = len(names)
+    design_matrix = _resolve_design_matrix(design_matrix)
+    lin_names, _nl = model.partition_linear_params(names)
+    split = design_matrix == "split" and bool(lin_names)
+    if split:
+        lin_set = set(lin_names)
+        lin_idx = np.asarray([i for i, n in enumerate(names)
+                              if n in lin_set], np.int64)
+        nl_idx = np.asarray([i for i, n in enumerate(names)
+                             if n not in lin_set], np.int64)
+        n_nl = len(nl_idx)
 
     def resid_sec(x, p, b):
         p2 = model.with_x(p, x, names)
@@ -94,11 +114,31 @@ def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
                              subtract_mean=False, use_weights=False)
         return r / pv(p2, "F0")
 
-    def ne_step(x, p, b):
+    def resid_parts(x_nl, x_lin, p, b):
+        x = jnp.zeros(npar).at[nl_idx].set(x_nl).at[lin_idx].set(x_lin)
+        return resid_sec(x, p, b)
+
+    def lin_cols(x, p, b):
+        """(local rows, n_lin) cached-block jacobian on this shard."""
+        return jax.jacfwd(resid_parts, argnums=1)(
+            x[nl_idx], x[lin_idx], p, b)
+
+    def jac(x, p, b, Mlin):
+        """The full local design-matrix jacobian; nonlinear block fresh,
+        linear block from the per-fit cache when split."""
+        if not split:
+            return jax.jacfwd(resid_sec)(x, p, b)
+        Jnl = jax.jacfwd(resid_parts, argnums=0)(
+            x[nl_idx], x[lin_idx], p, b) if n_nl else \
+            jnp.zeros((b.ntoas, 0))
+        return jnp.zeros((Jnl.shape[0], npar)) \
+            .at[:, nl_idx].set(Jnl).at[:, lin_idx].set(Mlin)
+
+    def ne_step(x, p, b, Mlin=None):
         """One Gauss-Newton step from psum'd normal equations; returns
         (dx, chi2_at_x)."""
         r = resid_sec(x, p, b)
-        J = jax.jacfwd(resid_sec)(x, p, b)
+        J = jac(x, p, b, Mlin)
         M = -J
         if include_offset:
             M = jnp.concatenate([M, -jnp.ones((M.shape[0], 1))], axis=1)
@@ -134,10 +174,13 @@ def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
 
     def fit_one(p, b):
         x = jnp.zeros(npar)
+        # split: the linear block differentiated once, reused by every
+        # iteration (in-graph hoist; shards row-wise with the batch)
+        Mlin = lin_cols(x, p, b) if split else None
         for _ in range(maxiter):
-            dx, _ = ne_step(x, p, b)
+            dx, _ = ne_step(x, p, b, Mlin)
             x = x + dx
-        _, chi2 = ne_step(x, p, b)
+        _, chi2 = ne_step(x, p, b, Mlin)
         return chi2, x
 
     grid_names: list = []

@@ -17,6 +17,14 @@ and one-time compilation.  This module is that harness:
   counts every eager jitted call and every device->host fetch, so a
   test can assert "one fused fit = N dispatches" and catch a stray
   ``np.asarray`` (one hidden transfer = +0.1 s over the tunnel).
+
+Split design-matrix names (see ``fitter._make_assembly``): stage/counter
+``assemble.linear_refresh`` marks a recomputation of the cached
+linear-block columns, counter ``assemble.linear_cached`` a cache hit,
+and stage ``assemble.jacfwd_nonlinear`` the per-step nonlinear-core
+block (primal + JVPs).  A split-path step is 1 ``jit_call`` (plus 1 per
+refresh) where the full-jacfwd path is 2 — asserted by
+``tests/test_design_split.py``.
 * ``enable()/disable()/report()/reset()`` — session control.  When
   enabled, stage exits ``block_until_ready`` on nothing — timing is
   attributed where the *wait* happens, which over an async runtime

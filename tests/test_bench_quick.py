@@ -1,0 +1,51 @@
+"""``python bench.py --quick`` — the CPU-only bench smoke (ISSUE 1
+satellite): one small WLS fit, no grid, no accelerator; the emitted
+JSON line must parse and carry the schema the bench driver consumes,
+so bench regressions are caught without hardware."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+@pytest.fixture(scope="module")
+def quick_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # quick mode must not touch the (possibly wedged) accelerator or
+    # depend on a warm XLA cache
+    out = subprocess.run([sys.executable, BENCH, "--quick"], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-800:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert lines, f"no stdout from --quick; stderr: {out.stderr[-400:]}"
+    return json.loads(lines[-1])
+
+
+def test_schema(quick_line):
+    d = quick_line
+    # required keys shared with the headline bench line
+    for key, typ in (("metric", str), ("unit", str), ("backend", str),
+                     ("mode", str), ("design_matrix", str),
+                     ("dataset", str), ("submetrics", dict)):
+        assert isinstance(d.get(key), typ), (key, d.get(key))
+    assert d["unit"] == "s"
+    assert d["mode"] == "quick"
+    assert d["backend"] == "cpu"
+    assert d["design_matrix"] in ("split", "full")
+
+
+def test_value_is_a_real_number(quick_line):
+    d = quick_line
+    # the satellite's point: a REAL number, never an error-only line
+    assert isinstance(d["value"], (int, float)) and d["value"] > 0
+    assert "error" not in d
+    assert isinstance(d["chi2"], (int, float))
+    assert int(d["ntoas"]) > 0 and int(d["nfit"]) > 0
+    assert isinstance(d["compile_s"], (int, float))

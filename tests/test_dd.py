@@ -7,6 +7,9 @@ Mirrors the precision-test role of the reference's `tests/test_precision.py`
 import mpmath
 import numpy as np
 import pytest
+import pytest as _pytest_hyp
+_pytest_hyp.importorskip("hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

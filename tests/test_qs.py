@@ -6,6 +6,9 @@ hold ~90 bits through spindown-scale computations.
 
 import mpmath
 import numpy as np
+import pytest as _pytest_hyp
+_pytest_hyp.importorskip("hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

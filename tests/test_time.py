@@ -3,6 +3,9 @@
 import mpmath
 import numpy as np
 import pytest
+import pytest as _pytest_hyp
+_pytest_hyp.importorskip("hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

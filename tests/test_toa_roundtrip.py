@@ -21,6 +21,9 @@ import warnings
 
 import numpy as np
 import pytest
+import pytest as _pytest_hyp
+_pytest_hyp.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from pint_tpu import mjd as mjdmod
