@@ -101,6 +101,11 @@ def pytest_configure(config):
         "markers",
         "parity: the headline tempo2/GLS/cross-backend correctness "
         "evidence (run with -m parity, ~2 min)")
+    config.addinivalue_line(
+        "markers",
+        "lint: the pint_tpu.lint precision/trace-safety gate "
+        "(tests/test_lint.py; part of tier-1 by default, skip WIP "
+        "branches with PINT_TPU_SKIP_LINT=1)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -108,8 +113,17 @@ def pytest_collection_modifyitems(config, items):
 
     import pytest as _pytest
 
+    skip_lint = os.environ.get("PINT_TPU_SKIP_LINT") == "1"
     for item in items:
         fname = os.path.basename(str(item.fspath))
+        if fname == "test_lint.py":
+            # the static-analysis gate rides in the smoke tier so every
+            # tier-1 run enforces the precision/trace-safety invariants;
+            # WIP branches opt out with PINT_TPU_SKIP_LINT=1
+            item.add_marker(_pytest.mark.lint)
+            if skip_lint:
+                item.add_marker(_pytest.mark.skip(
+                    reason="PINT_TPU_SKIP_LINT=1"))
         if fname in _SLOW_FILES or any(
                 fname == f and item.name.startswith(p) or
                 fname == f and getattr(item, "cls", None) is not None

@@ -49,8 +49,12 @@ _SPLIT_F32 = 4097.0  # 2^12 + 1
 def _split_const(a):
     dt = getattr(a, "dtype", None)
     if dt is not None and dt == np.float32:
-        return np.float32(_SPLIT_F32)
-    return _SPLIT_F64
+        # dtype-matched to the f32 input word — not a demotion
+        return np.float32(_SPLIT_F32)  # ddlint: disable=PREC001
+    # np.float64, not a bare Python float: a weak-typed scalar would let
+    # JAX demote the split to the other operand's (possibly narrower)
+    # dtype instead of anchoring it at f64
+    return np.float64(_SPLIT_F64)
 
 
 _guard_p = None

@@ -1,0 +1,52 @@
+"""pint_tpu.lint — precision & trace-safety static analyzer.
+
+The paper's ~10 ns Tempo2-agreement claim rests on invariants the code
+cannot express in types: error-free transforms survive only if their
+word pairs are never recombined with raw ``+`` and never demoted below
+the working dtype, and jit-compiled hot paths must never host-sync
+(``pint_tpu/dd.py`` documents the measured hardware reality behind
+both).  This package makes those conventions *checked properties*:
+
+* AST rules (:mod:`pint_tpu.lint.astrules`):
+  **DD001** raw ``+/-`` on DD/QS words outside ``dd.py``/``qs.py``;
+  **PREC001** dtype demotion in precision-critical modules;
+  **TRACE001** host syncs inside jit-reachable code;
+  **JIT001** retrace hazards on jit-wrapped functions.
+* Runtime jaxpr audit (:mod:`pint_tpu.lint.jaxpr_audit`): **JAXPR001**
+  — traces the residual/fitter entry points and rejects narrowing
+  ``convert_element_type`` equations that are not exact error-free
+  splits.
+
+Run it::
+
+    python -m pint_tpu.lint                 # whole package, text output
+    pint-tpu-lint --format=json pint_tpu/   # console entry point, CI form
+    python -m pint_tpu.lint --list-rules
+
+Suppression: ``# ddlint: disable=CODE <justification>`` on (or directly
+above) the offending line; grandfathered findings live in the checked-in
+``pint_tpu/lint/baseline.txt`` (see its header for the burn-down count).
+The pytest gate is ``tests/test_lint.py`` (skippable for WIP branches
+via ``PINT_TPU_SKIP_LINT=1``).
+"""
+
+from pint_tpu.lint.astrules import (  # noqa: F401
+    PRECISION_MODULES,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from pint_tpu.lint.baseline import (  # noqa: F401
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+from pint_tpu.lint.findings import Finding, scan_suppressions  # noqa: F401
+
+__all__ = [
+    "Finding", "RULES", "PRECISION_MODULES", "lint_source", "lint_file",
+    "lint_paths", "scan_suppressions", "load_baseline", "write_baseline",
+    "apply_baseline", "default_baseline_path",
+]

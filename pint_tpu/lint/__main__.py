@@ -1,0 +1,7 @@
+"""``python -m pint_tpu.lint`` entry point."""
+
+import sys
+
+from pint_tpu.lint.cli import main
+
+sys.exit(main())

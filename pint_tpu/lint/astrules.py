@@ -1,0 +1,636 @@
+"""AST rules: precision & trace-safety static analysis.
+
+Four rules, each motivated by a measured hardware reality documented in
+:mod:`pint_tpu.dd` (TPU f64 is non-IEEE emulation; f32 is correctly
+rounded; error-free transforms are destroyed by dtype demotion or by raw
+recombination of the result words):
+
+* **DD001** — raw ``+``/``-`` arithmetic on extended-precision word
+  attributes (``.hi``/``.lo`` of a :class:`pint_tpu.dd.DD`,
+  ``.w0``..``.w3`` of a :class:`pint_tpu.qs.QS`) outside ``dd.py``/
+  ``qs.py``.  Recombining words with a raw ``+`` rounds away the
+  compensation word; use ``dd.to_float`` / ``qs.to_f64`` / the module's
+  own operators, which keep the arithmetic inside the audited EFT code.
+
+* **PREC001** — dtype demotion inside the precision-critical modules
+  (``dd.py``, ``qs.py``, ``mjd.py``, ``phase.py``, ``tdbseries.py``,
+  ``residuals.py``): ``.astype(float32/float16/bfloat16)``, narrow
+  ``dtype=`` kwargs, ``np.float32(...)``-style constructor casts, and
+  weak-typed bare Python-float returns (which silently demote under JAX
+  weak-type promotion, e.g. a float32 array times a Python float stays
+  float32).  Deliberate exact word splits carry an inline
+  ``# ddlint: disable=PREC001`` with a justification.
+
+* **TRACE001** — host synchronization inside jit-reachable code:
+  ``float()``/``int()``/``bool()`` on runtime values, ``.item()``/
+  ``.tolist()``, and ``np.*`` numeric calls applied to traced values
+  (numpy cannot see tracers: it either raises ``TracerArrayConversionError``
+  or silently executes at trace time on abstract values).  Jit
+  reachability is computed per module: functions decorated/wrapped with
+  ``jax.jit`` (including ``partial(jax.jit, ...)``), functions passed to
+  JAX transforms (``vmap``/``grad``/``jacfwd``/``lax.scan``/...), and
+  everything transitively called from those through the module-local call
+  graph.  Bodies guarded by the package's numpy-dispatch idiom
+  (``if isinstance(x, np.ndarray) or np.isscalar(x): ...``) are host-only
+  at trace time and exempt.
+
+* **JIT001** — retrace/staleness hazards on directly jit-wrapped
+  functions: closing over module-level *mutable* globals (dicts/lists/
+  sets, or names rebound via ``global``) whose mutation will NOT
+  retrigger a trace; ``static_argnums``/``static_argnames`` given
+  unhashable literals; and Python-float defaults in the jit signature
+  (weak-type promotion + an extra trace per call-site spelling).
+
+The rules are deliberately heuristic (no type inference): they encode
+this package's idioms, and the combination of inline suppressions plus
+the checked-in baseline (``pint_tpu/lint/baseline.txt``) keeps the
+signal actionable.  What the AST cannot see — a demotion introduced by
+tracing through data-dependent code — is caught by the runtime jaxpr
+audit in :mod:`pint_tpu.lint.jaxpr_audit`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from pint_tpu.lint.findings import Finding, scan_suppressions
+
+__all__ = ["RULES", "lint_source", "lint_file", "lint_paths",
+           "PRECISION_MODULES"]
+
+#: rule code -> one-line description (surfaced by ``--list-rules``)
+RULES = {
+    "DD001": "raw +/- on DD/QS extended-precision words outside dd.py/qs.py",
+    "PREC001": "dtype demotion / weak-type hazard in a precision-critical "
+               "module (dd, qs, mjd, phase, tdbseries, residuals)",
+    "TRACE001": "host sync (float()/int()/bool()/.item()/np.*) inside "
+                "jit-reachable code",
+    "JIT001": "retrace hazard: mutable-global closure, unhashable "
+              "static_argnums, or Python-scalar default in a jit signature",
+    "JAXPR001": "runtime jaxpr audit: narrowing convert_element_type in a "
+                "traced precision-critical entry point",
+}
+
+PRECISION_MODULES = {
+    "dd.py", "qs.py", "mjd.py", "phase.py", "tdbseries.py", "residuals.py",
+}
+_DD_EXEMPT = {"dd.py", "qs.py"}
+_WORD_ATTRS = {"hi", "lo", "w0", "w1", "w2", "w3"}
+_NARROW_FLOATS = {"float32", "float16", "bfloat16", "half"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+#: np.* attributes that only touch metadata / dtypes — safe on tracers
+_NP_SAFE = {
+    "shape", "ndim", "size", "dtype", "result_type", "promote_types",
+    "can_cast", "isscalar", "issubdtype", "finfo", "iinfo",
+    "broadcast_shapes", "index_exp", "s_", "errstate", "dtype", "newaxis",
+}
+#: JAX transform entry points whose function arguments run under trace
+_TRANSFORMS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "jacfwd", "jacrev",
+    "hessian", "linearize", "jvp", "vjp", "checkpoint", "remat", "scan",
+    "while_loop", "cond", "switch", "fori_loop", "map", "associative_scan",
+    "shard_map", "pjit", "custom_jvp", "custom_vjp",
+}
+
+
+def _attr_name(func) -> Optional[str]:
+    """Trailing name of a Name/Attribute callee: jax.lax.scan -> 'scan'."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_jit_expr(node) -> bool:
+    """True for expressions spelling the jit wrapper itself: ``jit``,
+    ``jax.jit``, ``partial(jax.jit, ...)``, ``jit(...)`` as a factory."""
+    if _attr_name(node) == "jit":
+        return True
+    if isinstance(node, ast.Call):
+        fn = _attr_name(node.func)
+        if fn == "jit":
+            return True
+        if fn == "partial" and node.args and _is_jit_expr(node.args[0]):
+            return True
+    return False
+
+
+def _narrow_dtype_expr(node) -> bool:
+    """Does this expression denote a sub-f64 float dtype?"""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _NARROW_FLOATS
+    name = _attr_name(node) if isinstance(
+        node, (ast.Name, ast.Attribute)) else None
+    return name in _NARROW_FLOATS
+
+
+def _is_constlike(node) -> bool:
+    """Literal-ish expressions that involve no runtime array values."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        # np.pi, np.inf, math.tau, ...
+        return isinstance(node.value, ast.Name)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_constlike(e) for e in node.elts)
+    if isinstance(node, ast.BinOp):
+        return _is_constlike(node.left) and _is_constlike(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_constlike(node.operand)
+    return False
+
+
+def _is_metadata_expr(node) -> bool:
+    """Shape/dtype bookkeeping (``x.shape[0]``, ``len(...)``) — host ints."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and _attr_name(sub.func) == "len":
+            return True
+    return False
+
+
+def _is_host_guard_test(test, np_aliases=frozenset(("np", "numpy"))) -> bool:
+    """The package's numpy-dispatch guards whose TRUE branch is host-only
+    code: ``isinstance(x, np.ndarray)``, ``np.isscalar(x)``, and
+    ``xp is np``."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "ndarray", "isscalar"):
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "isscalar":
+            return True
+        if isinstance(sub, ast.Compare) and len(sub.ops) == 1 and \
+                isinstance(sub.ops[0], ast.Is) and \
+                isinstance(sub.comparators[0], ast.Name) and \
+                sub.comparators[0].id in np_aliases:
+            return True
+    return False
+
+
+def _is_device_guard_test(test, np_aliases=frozenset(("np", "numpy"))) -> bool:
+    """Guards whose TRUE branch is device code (so an early ``return``
+    there leaves the REST of the block host-only): ``xp is not np`` and
+    ``hasattr(x, 'aval')``."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare) and len(sub.ops) == 1 and \
+                isinstance(sub.ops[0], ast.IsNot) and \
+                isinstance(sub.comparators[0], ast.Name) and \
+                sub.comparators[0].id in np_aliases:
+            return True
+        if isinstance(sub, ast.Call) and _attr_name(sub.func) == "hasattr":
+            return True
+    return False
+
+
+def _block_terminates(body) -> bool:
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise,
+                                                ast.Continue, ast.Break))
+
+
+class _FuncInfo:
+    __slots__ = ("node", "name", "parent", "jit_root", "jit_reachable",
+                 "calls", "local_names")
+
+    def __init__(self, node, name: str, parent: Optional["_FuncInfo"]):
+        self.node = node
+        self.name = name
+        self.parent = parent
+        self.jit_root = False
+        self.jit_reachable = False
+        self.calls: set = set()
+        self.local_names: set = set()
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Pass 1: function table, jit roots, module-level constants."""
+
+    def __init__(self):
+        self.functions: List[_FuncInfo] = []
+        self.by_scope = {}           # (id(parent-or-None), name) -> info
+        self.mutable_globals: set = set()
+        self.float_consts: set = set()
+        self.np_aliases: set = set()
+        self.jit_call_sites: List[ast.Call] = []
+        self._jit_sites_seen: set = set()
+        self._stack: List[_FuncInfo] = []
+        self._class_depth = 0
+
+    def _add_jit_site(self, call: ast.Call):
+        if id(call) not in self._jit_sites_seen:
+            self._jit_sites_seen.add(id(call))
+            self.jit_call_sites.append(call)
+
+    # -- imports / module constants --------------------------------------
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.name == "numpy":
+                self.np_aliases.add(alias.asname or "numpy")
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        if not self._stack and self._class_depth == 0:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if isinstance(node.value, (ast.Dict, ast.List, ast.Set,
+                                               ast.DictComp, ast.ListComp,
+                                               ast.SetComp)):
+                        self.mutable_globals.add(tgt.id)
+                    elif isinstance(node.value, ast.Constant) and \
+                            isinstance(node.value.value, float):
+                        self.float_consts.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_Global(self, node):
+        # a name rebound via `global` is stale-closure bait for jit roots
+        self.mutable_globals.update(node.names)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self._class_depth += 1
+        self.generic_visit(node)
+        self._class_depth -= 1
+
+    # -- functions ---------------------------------------------------------
+    def _enter_function(self, node, name):
+        parent = self._stack[-1] if self._stack else None
+        info = _FuncInfo(node, name, parent)
+        self.functions.append(info)
+        self.by_scope[(id(parent), name)] = info
+        for deco in getattr(node, "decorator_list", ()):
+            if _is_jit_expr(deco):
+                info.jit_root = True
+            if isinstance(deco, ast.Call) and _is_jit_expr(deco):
+                self._add_jit_site(deco)
+        self._stack.append(info)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter_function(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- jit/transform call sites -----------------------------------------
+    def _resolve(self, name: str) -> Optional[_FuncInfo]:
+        scope = self._stack[-1] if self._stack else None
+        while True:
+            info = self.by_scope.get((id(scope), name))
+            if info is not None:
+                return info
+            if scope is None:
+                return None
+            scope = scope.parent
+
+    def _mark_fn_arg(self, arg):
+        if isinstance(arg, ast.Name):
+            info = self._resolve(arg.id)
+            if info is not None:
+                info.jit_root = True
+        elif isinstance(arg, ast.Call) and \
+                _attr_name(arg.func) == "partial" and arg.args:
+            self._mark_fn_arg(arg.args[0])
+
+    def _check_wrap_call(self, value):
+        """``f_j = jax.jit(f)`` / ``jax.vmap(f)`` style wrapping."""
+        if not isinstance(value, ast.Call):
+            return
+        name = _attr_name(value.func)
+        if name == "jit" or (isinstance(value.func, ast.Call)
+                             and _is_jit_expr(value.func)):
+            self._add_jit_site(value)
+            for arg in value.args:
+                self._mark_fn_arg(arg)
+        elif name in _TRANSFORMS:
+            # bare `map(...)` is the builtin, not jax.lax.map
+            if name == "map" and isinstance(value.func, ast.Name):
+                return
+            for arg in value.args:
+                self._mark_fn_arg(arg)
+
+    def visit_Call(self, node):
+        self._check_wrap_call(node)
+        self.generic_visit(node)
+
+
+class _BodyScanner:
+    """Pass 2: per-function (and module-level) finding emission."""
+
+    def __init__(self, index: _ModuleIndex, filename: str, report):
+        self.index = index
+        self.basename = os.path.basename(filename)
+        self.report = report
+        self.precision = self.basename in PRECISION_MODULES
+
+    # -- shared node checks ------------------------------------------------
+    def _check_dd001(self, node):
+        if self.basename in _DD_EXEMPT:
+            return
+        ops = (ast.Add, ast.Sub)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ops):
+            for side in (node.left, node.right):
+                if isinstance(side, ast.Attribute) and \
+                        side.attr in _WORD_ATTRS:
+                    self.report(
+                        "DD001", node,
+                        f"raw {'+' if isinstance(node.op, ast.Add) else '-'}"
+                        f" on extended-precision word '.{side.attr}' — "
+                        "rounds away the compensation word; use "
+                        "dd.to_float/qs.to_f64 or DD/QS operators")
+                    return
+
+    def _check_prec001(self, node):
+        if not self.precision:
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            # x.astype(float32-ish)
+            if isinstance(fn, ast.Attribute) and fn.attr == "astype" and \
+                    node.args and _narrow_dtype_expr(node.args[0]):
+                self.report("PREC001", node,
+                            "dtype demotion via .astype to a sub-f64 float "
+                            "in a precision-critical module")
+            # np.float32(...) / jnp.float32(...) constructor casts
+            elif _attr_name(fn) in _NARROW_FLOATS:
+                self.report("PREC001", node,
+                            f"narrow float constructor {_attr_name(fn)}() "
+                            "in a precision-critical module")
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _narrow_dtype_expr(kw.value):
+                    self.report("PREC001", kw.value,
+                                "narrow dtype= kwarg in a precision-critical "
+                                "module")
+
+    def _check_prec001_return(self, node: ast.Return):
+        if not self.precision or node.value is None:
+            return
+        v = node.value
+        weak = (isinstance(v, ast.Constant) and isinstance(v.value, float)) \
+            or (isinstance(v, ast.Name) and v.id in self.index.float_consts)
+        if weak:
+            self.report("PREC001", node,
+                        "weak-typed Python float returned from a "
+                        "precision-critical module — wrap in a dtype-matched "
+                        "scalar (np.float64(...)) to avoid silent promotion "
+                        "demotion")
+
+    def _check_jit_params(self, call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            v = kw.value
+            bad = isinstance(v, (ast.Dict, ast.Set)) or (
+                isinstance(v, (ast.List, ast.Tuple)) and any(
+                    isinstance(e, (ast.Dict, ast.Set, ast.List))
+                    for e in v.elts))
+            if bad:
+                self.report("JIT001", v,
+                            f"unhashable {kw.arg} literal — jit cache keys "
+                            "must be hashable")
+
+    # -- TRACE001 walker ---------------------------------------------------
+    def _scan_trace_block(self, stmts, host_guarded: bool):
+        """Scan a statement list, modeling the package's dispatch idioms:
+        a host-guard If body is host-only; a device-guard If whose body
+        terminates (early return) leaves the REST of the block host-only."""
+        aliases = self.index.np_aliases or {"np", "numpy"}
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, ast.If):
+                host_body = host_guarded or _is_host_guard_test(
+                    stmt.test, aliases)
+                self._scan_trace(stmt.test, host_guarded)
+                self._scan_trace_block(stmt.body, host_body)
+                self._scan_trace_block(stmt.orelse, host_guarded)
+                if not host_guarded:
+                    if _is_device_guard_test(stmt.test, aliases) and \
+                            _block_terminates(stmt.body):
+                        self._scan_trace_block(stmts[i + 1:], True)
+                        return
+                    if _is_host_guard_test(stmt.test, aliases) and \
+                            _block_terminates(stmt.body):
+                        # rest of block is the device branch: keep scanning
+                        continue
+                continue
+            self._scan_trace(stmt, host_guarded)
+
+    def _scan_trace(self, node, host_guarded: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested functions are scanned as their own scope
+        aliases = self.index.np_aliases or {"np", "numpy"}
+        if isinstance(node, ast.If):
+            self._scan_trace_block([node], host_guarded)
+            return
+        if isinstance(node, ast.IfExp):
+            self._scan_trace(node.test, host_guarded)
+            guard = host_guarded or _is_host_guard_test(node.test, aliases)
+            self._scan_trace(node.body, guard)
+            self._scan_trace(node.orelse, host_guarded)
+            return
+        if isinstance(node, ast.Call) and not host_guarded:
+            self._check_trace_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._scan_trace(child, host_guarded)
+
+    def _check_trace_call(self, node: ast.Call):
+        fn = node.func
+        name = _attr_name(fn)
+        # float(x) / int(x) / bool(x) on runtime values
+        if isinstance(fn, ast.Name) and fn.id in _HOST_CASTS and \
+                len(node.args) == 1:
+            arg = node.args[0]
+            if not _is_constlike(arg) and not _is_metadata_expr(arg) \
+                    and not isinstance(arg, ast.Attribute):
+                self.report("TRACE001", node,
+                            f"{fn.id}() on a runtime value inside "
+                            "jit-reachable code forces a host sync (raises "
+                            "on tracers)")
+            return
+        # .item() / .tolist()
+        if isinstance(fn, ast.Attribute) and name in ("item", "tolist"):
+            self.report("TRACE001", node,
+                        f".{name}() inside jit-reachable code forces a "
+                        "host sync (raises on tracers)")
+            return
+        # np.<fn>(...) on runtime values
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in self.index.np_aliases:
+            if name in _NP_SAFE:
+                return
+            # host math on literals (np.log(2 * np.pi), np.float64(0.5))
+            # is a trace-time constant, not a sync
+            if node.args and all(_is_constlike(a) for a in node.args):
+                return
+            self.report("TRACE001", node,
+                        f"np.{name}() applied inside jit-reachable code — "
+                        "numpy cannot trace jax values; use jnp or the "
+                        "get_xp dispatch")
+
+    # -- JIT001 body checks ------------------------------------------------
+    def _scan_jit001(self, info: _FuncInfo):
+        node = info.node
+        # Python-scalar defaults in the jit signature
+        args = node.args
+        for default in list(args.defaults) + [
+                d for d in args.kw_defaults if d is not None]:
+            if isinstance(default, ast.Constant) and \
+                    isinstance(default.value, float):
+                self.report("JIT001", default,
+                            "Python float default in a jit signature — "
+                            "weak-type promotion / per-spelling retrace "
+                            "hazard; hoist to a closure constant or pass "
+                            "an array")
+        # mutable-global closure
+        local = set(info.local_names)
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not node:
+                continue
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in self.index.mutable_globals \
+                    and sub.id not in local:
+                self.report("JIT001", sub,
+                            f"jit function closes over mutable global "
+                            f"'{sub.id}' — captured at trace time, later "
+                            "mutation will NOT retrigger a trace")
+
+
+def _collect_locals(info: _FuncInfo):
+    node = info.node
+    names = set()
+    a = node.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        names.add(arg.arg)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            names.add(sub.id)
+    info.local_names = names
+
+
+def _collect_calls(info: _FuncInfo):
+    """Direct body of `info` only (nested defs have their own info)."""
+    own_nested = {f for f in ast.walk(info.node)
+                  if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and f is not info.node}
+
+    def walk(node):
+        if node in own_nested:
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                info.calls.add(fn.id)
+            elif isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                info.calls.add(fn.attr)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(info.node)
+
+
+def _propagate_jit(index: _ModuleIndex):
+    """jit-reachable = jit roots + transitive module-local callees."""
+    for info in index.functions:
+        _collect_calls(info)
+        _collect_locals(info)
+        if info.jit_root:
+            info.jit_reachable = True
+
+    def resolve_from(info: _FuncInfo, name: str) -> Optional[_FuncInfo]:
+        scope = info
+        while True:
+            hit = index.by_scope.get((id(scope), name))
+            if hit is not None:
+                return hit
+            if scope is None:
+                return None
+            scope = scope.parent
+
+    changed = True
+    while changed:
+        changed = False
+        for info in index.functions:
+            if not info.jit_reachable:
+                continue
+            for name in info.calls:
+                callee = resolve_from(info, name)
+                if callee is not None and not callee.jit_reachable:
+                    callee.jit_reachable = True
+                    changed = True
+
+
+def lint_source(source: str, filename: str) -> List[Finding]:
+    """Run all AST rules over one file's source; suppressions applied."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as exc:
+        return [Finding("SYNTAX", filename, exc.lineno or 0,
+                        exc.offset or 0, f"syntax error: {exc.msg}")]
+    sup = scan_suppressions(source)
+    src_lines = source.splitlines()
+    findings: List[Finding] = []
+
+    def report(code: str, node, message: str):
+        line = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", None)
+        if sup.is_suppressed(code, line, end):
+            return
+        text = src_lines[line - 1] if 0 < line <= len(src_lines) else ""
+        findings.append(Finding(code, filename, line,
+                                getattr(node, "col_offset", 0) + 1,
+                                message, source=text))
+
+    index = _ModuleIndex()
+    index.visit(tree)
+    _propagate_jit(index)
+
+    scanner = _BodyScanner(index, filename, report)
+
+    # module-wide structural rules (DD001 / PREC001 casts)
+    for node in ast.walk(tree):
+        scanner._check_dd001(node)
+        scanner._check_prec001(node)
+        if isinstance(node, ast.Return):
+            scanner._check_prec001_return(node)
+    # jit cache-key hazards at every jit(...) call site
+    for call in index.jit_call_sites:
+        scanner._check_jit_params(call)
+    # per-function trace-safety / retrace rules
+    for info in index.functions:
+        if info.jit_reachable:
+            scanner._scan_trace_block(info.node.body, False)
+        if info.jit_root:
+            scanner._scan_jit001(info)
+
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    return findings
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def lint_paths(paths) -> List[Finding]:
+    """Lint .py files under the given files/directories (sorted walk)."""
+    findings: List[Finding] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        findings.extend(lint_file(os.path.join(dirpath, fn)))
+        elif path.endswith(".py"):
+            findings.extend(lint_file(path))
+    return findings

@@ -1,0 +1,107 @@
+"""Checked-in baseline of grandfathered findings.
+
+The baseline lets the lint gate be strict for *new* code while the
+legacy findings are burned down over time.  Entries are keyed by
+``(code, repo-relative path, stripped source line)`` — not line numbers —
+so edits elsewhere in a file do not invalidate them.  Identical lines
+are matched with multiplicity.
+
+The header records the first-run finding count (the pre-cleanup state of
+the tree when the linter was introduced) next to the current count, so
+the burn-down is visible in the diff of every baseline regeneration.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import Iterable, List, Tuple
+
+from pint_tpu.lint.findings import Finding
+
+__all__ = ["default_baseline_path", "load_baseline", "write_baseline",
+           "apply_baseline", "parse_header"]
+
+_SEP = "\t"
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.txt")
+
+
+def parse_header(path: str) -> dict:
+    """{'first-run': int|None, 'current': int|None} from header comments."""
+    meta = {"first-run": None, "current": None}
+    if not os.path.isfile(path):
+        return meta
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            if not line.startswith("#"):
+                break
+            for key in meta:
+                tag = f"# {key}:"
+                if line.startswith(tag):
+                    try:
+                        meta[key] = int(line[len(tag):].split()[0])
+                    except (ValueError, IndexError):
+                        pass
+    return meta
+
+
+def load_baseline(path: str) -> Counter:
+    """Multiset of baseline keys (code, path, source)."""
+    entries: Counter = Counter()
+    if not os.path.isfile(path):
+        return entries
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.rstrip("\n")
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(_SEP, 2)
+            if len(parts) == 3:
+                entries[(parts[0], parts[1], parts[2])] += 1
+    return entries
+
+
+def apply_baseline(findings: Iterable[Finding], baseline: Counter
+                   ) -> Tuple[List[Finding], int, Counter]:
+    """Split findings into (new, n_baselined, stale_entries)."""
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    n_baselined = 0
+    for f in findings:
+        if budget[f.key] > 0:
+            budget[f.key] -= 1
+            n_baselined += 1
+        else:
+            new.append(f)
+    stale = Counter({k: v for k, v in budget.items() if v > 0})
+    return new, n_baselined, stale
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   date: str = "") -> int:
+    """Write the baseline for the given findings; preserves the first-run
+    count from an existing file (or seeds it from this run)."""
+    findings = sorted(findings, key=lambda f: f.key + (f.line,))
+    prev = parse_header(path)
+    n = len(findings)
+    first_run = prev["first-run"] if prev["first-run"] is not None else n
+    when = f" ({date})" if date else ""
+    lines = [
+        "# pint_tpu.lint baseline — grandfathered findings.",
+        "# Matched by (code, path, stripped source line); identical lines",
+        "# count with multiplicity.  Shrink me, don't grow me: fix the",
+        "# hazard or add an inline `# ddlint: disable=CODE <why>` instead.",
+        "# regenerate: python -m pint_tpu.lint --update-baseline",
+        f"# first-run: {first_run} findings (pre-cleanup tree)",
+        f"# current: {n} findings{when}",
+    ]
+    for f in findings:
+        code, relpath, src = f.key
+        lines.append(_SEP.join((code, relpath, src)))
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return n

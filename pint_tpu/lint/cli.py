@@ -1,0 +1,117 @@
+"""Command-line front end: ``python -m pint_tpu.lint`` / ``pint-tpu-lint``.
+
+Exit codes: 0 = clean (modulo baseline), 1 = new findings, 2 = usage
+error.  ``--format=json`` emits a machine-readable document for CI and
+editor integrations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from pint_tpu.lint import astrules, baseline as bl
+from pint_tpu.lint.findings import Finding, format_json, format_text
+
+__all__ = ["main"]
+
+
+def _package_dir() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="pint-tpu-lint",
+        description="Precision & trace-safety static analyzer for pint_tpu "
+                    "(AST rules DD001/PREC001/TRACE001/JIT001 plus the "
+                    "JAXPR001 runtime jaxpr audit).")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the installed "
+                         "pint_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    dest="fmt", help="output format (default: text)")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="baseline file (default: the checked-in "
+                         "pint_tpu/lint/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run's findings "
+                         "(preserves the recorded first-run count)")
+    ap.add_argument("--no-jaxpr-audit", action="store_true",
+                    help="skip the runtime jaxpr audit (AST rules only; "
+                         "no jax import, much faster)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for code, desc in astrules.RULES.items():
+            print(f"{code}  {desc}")
+        return 0
+
+    paths = args.paths or [_package_dir()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"pint-tpu-lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings: List[Finding] = astrules.lint_paths(paths)
+
+    if not args.no_jaxpr_audit:
+        # the audit traces the *installed* package's entry points; it is
+        # meaningful whenever the package itself is under lint
+        pkg = _package_dir()
+        in_scope = any(
+            os.path.commonpath([os.path.abspath(p), pkg]) == pkg or
+            os.path.abspath(p) == os.path.dirname(pkg)
+            for p in paths)
+        if in_scope:
+            from pint_tpu.lint.jaxpr_audit import audit_entry_points
+
+            findings = findings + audit_entry_points()
+
+    meta = {"total": len(findings), "baselined": 0, "stale_baseline": 0}
+
+    if args.update_baseline:
+        import datetime
+
+        path = args.baseline or bl.default_baseline_path()
+        n = bl.write_baseline(path, findings,
+                              date=datetime.date.today().isoformat())
+        print(f"pint-tpu-lint: wrote {n} baseline entries to {path}")
+        return 0
+
+    new = findings
+    if not args.no_baseline:
+        path = args.baseline or bl.default_baseline_path()
+        base = bl.load_baseline(path)
+        new, n_baselined, stale = bl.apply_baseline(findings, base)
+        meta["baselined"] = n_baselined
+        meta["stale_baseline"] = sum(stale.values())
+        if stale and args.fmt == "text":
+            print(f"pint-tpu-lint: note: {sum(stale.values())} stale "
+                  "baseline entr(y/ies) no longer match — consider "
+                  "--update-baseline to shrink the file", file=sys.stderr)
+
+    meta["new"] = len(new)
+    if args.fmt == "json":
+        print(format_json(new, meta))
+    else:
+        if new:
+            print(format_text(new))
+        print(f"pint-tpu-lint: {len(new)} new finding(s), "
+              f"{meta['baselined']} baselined, "
+              f"{meta['stale_baseline']} stale baseline entr(y/ies)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""Finding records and ``# ddlint:`` suppression parsing.
+
+A finding is one rule violation at one source location.  Findings are
+keyed for baseline matching by ``(code, normalized path, stripped source
+line)`` rather than line number, so unrelated edits above a grandfathered
+finding do not invalidate the baseline.
+
+Suppression syntax (one mechanism shared by the AST rules and the jaxpr
+audit):
+
+* ``# ddlint: disable=CODE`` (or ``=CODE1,CODE2``) on the offending line,
+  on the line directly above it, or on the last line of a multi-line
+  statement, silences those codes for that statement.
+* ``# ddlint: disable-file=CODE`` anywhere in a file silences the code
+  for the whole file (reserve this for modules whose entire job is the
+  flagged idiom).
+
+Every suppression should carry a short justification in the same comment,
+e.g. ``# ddlint: disable=PREC001 — exact EFT word split``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Finding", "Suppressions", "scan_suppressions", "normalize_path",
+    "format_text", "format_json",
+]
+
+_DDLINT_RE = re.compile(
+    r"#\s*ddlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+def normalize_path(path: str) -> str:
+    """Stable repo-relative path: everything from the first ``pint_tpu``
+    (or ``tests``) path component on; otherwise the basename."""
+    parts = os.path.normpath(str(path)).split(os.sep)
+    for anchor in ("pint_tpu", "tests"):
+        if anchor in parts:
+            return "/".join(parts[parts.index(anchor):])
+    return parts[-1]
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    code: str
+    path: str
+    line: int
+    col: int
+    message: str
+    source: str = ""          # stripped source line (baseline fingerprint)
+    origin: str = "ast"       # "ast" | "jaxpr"
+
+    @property
+    def key(self):
+        return (self.code, normalize_path(self.path), self.source.strip())
+
+    def format(self) -> str:
+        return (f"{normalize_path(self.path)}:{self.line}:{self.col}: "
+                f"{self.code} {self.message}")
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "path": normalize_path(self.path),
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "source": self.source.strip(),
+            "origin": self.origin,
+        }
+
+
+@dataclass
+class Suppressions:
+    """Parsed ``# ddlint:`` directives for one file."""
+
+    per_line: dict = field(default_factory=dict)   # lineno -> set of codes
+    file_level: set = field(default_factory=set)
+
+    def is_suppressed(self, code: str, lineno: int,
+                      end_lineno: int | None = None) -> bool:
+        if code in self.file_level or "ALL" in self.file_level:
+            return True
+        lines = {lineno, lineno - 1}
+        if end_lineno is not None:
+            lines.add(end_lineno)
+        for ln in lines:
+            codes = self.per_line.get(ln)
+            if codes and (code in codes or "ALL" in codes):
+                return True
+        return False
+
+
+def scan_suppressions(source: str) -> Suppressions:
+    sup = Suppressions()
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _DDLINT_RE.search(text)
+        if not m:
+            continue
+        kind, codes = m.group(1), {
+            c.strip().upper() for c in m.group(2).split(",")}
+        if kind == "disable-file":
+            sup.file_level |= codes
+        else:
+            sup.per_line.setdefault(i, set()).update(codes)
+    return sup
+
+
+def format_text(findings, stream_meta: dict | None = None) -> str:
+    out = [f.format() for f in findings]
+    if stream_meta:
+        for k, v in stream_meta.items():
+            out.append(f"# {k}: {v}")
+    return "\n".join(out)
+
+
+def format_json(findings, stream_meta: dict | None = None) -> str:
+    doc = {"version": 1, "findings": [f.to_dict() for f in findings]}
+    if stream_meta:
+        doc.update(stream_meta)
+    return json.dumps(doc, indent=2, sort_keys=True)

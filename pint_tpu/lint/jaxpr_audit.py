@@ -1,0 +1,252 @@
+"""Runtime jaxpr audit: narrowing dtype conversions the AST cannot see.
+
+The AST rules in :mod:`pint_tpu.lint.astrules` only see literal spellings
+(``.astype(jnp.float32)``).  A demotion can also arise structurally — a
+weak-typed Python scalar pulling an f64 chain down to f32, a library call
+converting internally, an implicit promotion rule change — and those only
+become visible in the traced program.  This module traces the public
+residual/fitter entry points and walks the resulting jaxpr (recursing
+through ``pjit``/``scan``/``cond`` sub-jaxprs) for ``convert_element_type``
+equations whose output float dtype is narrower than their input.
+
+Not every narrowing is a bug: the package's quad-single arithmetic
+(:mod:`pint_tpu.qs`) is *built* from exact f64→f32 word splits.  Three
+sanctioning mechanisms keep the audit quiet on legitimate code:
+
+1. **Exact-split detection** (structural): a conversion ``w = f32(x)``
+   is sanctioned when the same jaxpr also computes ``x - f64(w)`` — the
+   Dekker/Veltkamp split signature, which captures the rounding error
+   rather than discarding it.
+2. **Sanctioned modules**: equations whose source location lies in
+   ``dd.py``/``qs.py`` (the audited EFT kernels themselves).
+3. **Inline suppressions**: the shared ``# ddlint: disable=JAXPR001``
+   (or ``PREC001``) comment on the originating source line, for
+   intentional non-split demotions that are exact by a range argument
+   (e.g. casting a <2^24 day count to f32).
+
+Everything else is reported as **JAXPR001**.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from pint_tpu.lint.findings import Finding, scan_suppressions
+
+__all__ = ["audit_fn", "audit_closed_jaxpr", "audit_entry_points",
+           "narrowing_conversions"]
+
+_SANCTIONED_FILES = {"dd.py", "qs.py"}
+_FLOAT_BITS = {"float16": 16, "bfloat16": 16, "float32": 32, "float64": 64}
+
+
+def _float_bits(dtype) -> Optional[int]:
+    return _FLOAT_BITS.get(getattr(dtype, "name", str(dtype)))
+
+
+def _iter_jaxprs(jaxpr):
+    """Yield this jaxpr and every sub-jaxpr reachable through eqn params
+    (pjit/scan/while/cond/custom_* all stash jaxprs differently)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for val in eqn.params.values():
+            for sub in _as_jaxprs(val):
+                yield from _iter_jaxprs(sub)
+
+
+def _as_jaxprs(val):
+    if hasattr(val, "eqns"):                      # Jaxpr
+        return [val]
+    if hasattr(val, "jaxpr"):                     # ClosedJaxpr
+        return [val.jaxpr]
+    if isinstance(val, (tuple, list)):
+        out = []
+        for v in val:
+            out.extend(_as_jaxprs(v))
+        return out
+    return []
+
+
+def _source_location(eqn) -> Tuple[Optional[str], Optional[int]]:
+    """(file, line) of the user frame that emitted this equation."""
+    si = getattr(eqn, "source_info", None)
+    if si is None:
+        return None, None
+    frames = []
+    try:
+        from jax._src import source_info_util as siu
+
+        frames = list(siu.user_frames(si))
+    except Exception:
+        tb = getattr(si, "traceback", None)
+        if tb is not None and hasattr(tb, "frames"):
+            frames = list(tb.frames)
+    for fr in frames:
+        fname = getattr(fr, "file_name", None) or getattr(fr, "filename", None)
+        line = getattr(fr, "start_line", None) or \
+            getattr(fr, "line_num", None) or getattr(fr, "lineno", None)
+        if fname:
+            return fname, line
+    return None, None
+
+
+_SUPPRESS_CACHE: dict = {}
+
+
+def _line_suppressed(path: str, line: Optional[int]) -> bool:
+    if not path or not line or not os.path.isfile(path):
+        return False
+    sup = _SUPPRESS_CACHE.get(path)
+    if sup is None:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                sup = scan_suppressions(fh.read())
+        except OSError:
+            sup = scan_suppressions("")
+        _SUPPRESS_CACHE[path] = sup
+    return sup.is_suppressed("JAXPR001", line) or \
+        sup.is_suppressed("PREC001", line)
+
+
+def _is_exact_split(eqn, eqns) -> bool:
+    """Does this narrowing conversion participate in an error-free split?
+
+    Pattern: ``w = convert[f32](x)`` is exact-split when a sibling
+    equation upcasts ``w`` back to x's dtype and another subtracts that
+    from ``x`` (capturing, not discarding, the rounding error).
+    """
+    x = eqn.invars[0]
+    w = eqn.outvars[0]
+    wide = getattr(getattr(x, "aval", None), "dtype", None)
+    if wide is None:
+        return False
+    upcasts = []
+    for e2 in eqns:
+        if e2.primitive.name == "convert_element_type" and e2.invars and \
+                e2.invars[0] is w and \
+                _float_bits(e2.params.get("new_dtype")) == _float_bits(wide):
+            upcasts.append(e2.outvars[0])
+    if not upcasts:
+        return False
+    for e3 in eqns:
+        if e3.primitive.name == "sub" and len(e3.invars) == 2:
+            a, b = e3.invars
+            for wb in upcasts:
+                if (a is x and b is wb) or (a is wb and b is x):
+                    return True
+    return False
+
+
+def narrowing_conversions(jaxpr) -> List[tuple]:
+    """All float-narrowing convert_element_type eqns in a (closed) jaxpr,
+    as (eqn, sibling_eqns, in_dtype, out_dtype) tuples."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    out = []
+    for jx in _iter_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            new = eqn.params.get("new_dtype")
+            aval = getattr(eqn.invars[0], "aval", None)
+            old = getattr(aval, "dtype", None)
+            ob, nb = _float_bits(old), _float_bits(new)
+            if ob is not None and nb is not None and nb < ob:
+                out.append((eqn, jx.eqns, old, new))
+    return out
+
+
+def audit_closed_jaxpr(jaxpr, name: str = "<traced fn>") -> List[Finding]:
+    """Unsanctioned narrowing conversions in a traced program."""
+    findings: List[Finding] = []
+    for eqn, eqns, old, new in narrowing_conversions(jaxpr):
+        if _is_exact_split(eqn, eqns):
+            continue
+        path, line = _source_location(eqn)
+        if path and os.path.basename(path) in _SANCTIONED_FILES:
+            continue
+        if _line_suppressed(path, line):
+            continue
+        src = ""
+        if path and line and os.path.isfile(path):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    lines = fh.read().splitlines()
+                if 0 < line <= len(lines):
+                    src = lines[line - 1]
+            except OSError:
+                pass
+        findings.append(Finding(
+            "JAXPR001", path or name, line or 0, 0,
+            f"narrowing convert_element_type {old} -> "
+            f"{getattr(new, 'name', new)} in traced '{name}' is not an "
+            "exact split and not suppressed — precision silently destroyed "
+            "on the device path", source=src, origin="jaxpr"))
+    return findings
+
+
+def audit_fn(fn, *args, name: Optional[str] = None, **kwargs) -> List[Finding]:
+    """Trace ``fn(*args, **kwargs)`` and audit the resulting jaxpr."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return audit_closed_jaxpr(closed, name=name or getattr(
+        fn, "__name__", "<traced fn>"))
+
+
+# A minimal isolated-pulsar fixture: enough to trace the full
+# phase -> residual -> chi2 pipeline (spindown + astrometry + dispersion
+# + barycentering + TZR) without binary models.
+_AUDIT_PAR = """
+PSR LINTAUDIT
+RAJ 05:00:00.0 1
+DECJ 20:00:00.0 1
+F0 300.0 1
+F1 -1.0e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 15.0 1
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+
+def audit_entry_points(ntoas: int = 8) -> List[Finding]:
+    """Trace the public residual and fitter chi2 entry points over a small
+    synthetic dataset and audit their jaxprs.
+
+    This is the tier-1 gate's runtime leg: any PR that introduces an
+    unsanctioned demotion anywhere in the dd-critical call tree (model
+    phase, residuals, chi2 assembly) fires here even if the AST rules
+    cannot see it.
+    """
+    import warnings
+
+    import numpy as np
+
+    findings: List[Finding] = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.fitter import build_chi2_fn
+        from pint_tpu.models import get_model
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.toa import get_TOAs_array
+
+        model = get_model(_AUDIT_PAR.strip().splitlines())
+        t = 55000.0 + np.linspace(0.0, 10.0, ntoas)
+        toas = get_TOAs_array(
+            t, obs="gbt", errors_us=1.0,
+            freqs_mhz=np.full(ntoas, 1400.0), ephem="DE421")
+        resid = Residuals(toas, model)
+        findings += audit_fn(resid._fn, resid.pdict, name="residuals")
+
+        names = list(model.free_params)
+        chi2 = build_chi2_fn(model, resid.batch, names,
+                             track_mode=resid.track_mode,
+                             include_offset=True)
+        x0 = model.x0(resid.pdict, names)
+        findings += audit_fn(chi2, x0, resid.pdict, name="fitter.chi2")
+    return findings

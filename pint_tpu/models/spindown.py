@@ -36,7 +36,9 @@ def dt_seconds_qs(p: dict, batch: TOABatch, delay, epoch_name: str):
     view is the collapse for delay-level consumers.
     """
     day0, frac0_qs, ddays = mjd_parts(p, epoch_name)
-    dday = (batch.tdb_day.astype(jnp.float64) - day0).astype(jnp.float32)
+    # integer day count, |Δday| < 2^24: the f32 cast is exact
+    dday = (batch.tdb_day.astype(jnp.float64)
+            - day0).astype(jnp.float32)  # ddlint: disable=JAXPR001
     w = batch.tdb_frac_w
     dt_days = qs.QS(dday, w[:, 0], w[:, 1], jnp.zeros_like(dday))
     dt_days = qs.add(dt_days, qs.QS(w[:, 2], *[jnp.zeros_like(dday)] * 3))
@@ -111,7 +113,9 @@ class Spindown(PhaseComponent):
             # no epoch: time measured from MJD given by the data itself is
             # not meaningful for higher derivatives; validate() forbids it
             day0 = batch.tdb_day[0].astype(jnp.float64)
-            dday = (batch.tdb_day.astype(jnp.float64) - day0).astype(jnp.float32)
+            # exact: integer day count < 2^24
+            dday = (batch.tdb_day.astype(jnp.float64)
+                    - day0).astype(jnp.float32)  # ddlint: disable=JAXPR001
             w = batch.tdb_frac_w
             dt_days = qs.QS(dday, w[:, 0], w[:, 1], w[:, 2])
             dt_qs = qs.mul_w(dt_days, jnp.float32(SECS_PER_DAY))

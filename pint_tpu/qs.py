@@ -88,6 +88,7 @@ def _renorm(words: Sequence, passes: int = 3) -> QS:
 
 
 def zeros_like(x) -> QS:
+    # f32 zero matches the module's word dtype  # ddlint: disable=PREC001
     z = x * np.float32(0.0) if not hasattr(x, "aval") else x * 0
     return QS(z, z, z, z)
 
@@ -104,12 +105,14 @@ def from_f64_host(x) -> QS:
     A f64 significand (53 bits) fits in three f32 words exactly (provided no
     word underflows); the fourth word is zero.
     """
+    # exact Veltkamp-style word split: every rounded-away bit is
+    # recaptured by the following subtraction (no precision loss)
     x = np.asarray(x, np.float64)
-    w0 = x.astype(np.float32)
+    w0 = x.astype(np.float32)  # ddlint: disable=PREC001 — exact split
     r = x - w0.astype(np.float64)
-    w1 = r.astype(np.float32)
+    w1 = r.astype(np.float32)  # ddlint: disable=PREC001 — exact split
     r2 = r - w1.astype(np.float64)
-    w2 = r2.astype(np.float32)
+    w2 = r2.astype(np.float32)  # ddlint: disable=PREC001 — ~2^-72 tail
     w3 = np.zeros_like(w2)
     return QS(w0, w1, w2, w3)
 
@@ -136,11 +139,11 @@ def from_f64_device(x) -> QS:
 
     from pint_tpu.dd import _guard
 
-    w0 = x.astype(jnp.float32)
+    w0 = x.astype(jnp.float32)  # ddlint: disable=PREC001 — exact split
     r = x - w0.astype(x.dtype)
-    w1 = r.astype(jnp.float32)
+    w1 = r.astype(jnp.float32)  # ddlint: disable=PREC001 — exact split
     r2 = r - w1.astype(x.dtype)
-    w2 = r2.astype(jnp.float32)
+    w2 = r2.astype(jnp.float32)  # ddlint: disable=PREC001 — ~2^-72 tail
     # the f64→f32 down-split is itself an EFT-style sandwich; pin it
     w0, w1, w2 = _guard(w0, w1, w2)
     return _renorm([w0, w1, w2, jnp.zeros_like(w2)])
@@ -241,10 +244,11 @@ def horner_taylor(dt: QS, coeffs: Sequence[QS]) -> QS:
 
 def _f32_like(ref, v: float):
     if isinstance(ref, np.ndarray) or np.isscalar(ref):
+        # word-dtype scalar factory  # ddlint: disable=PREC001
         return np.float32(v)
     import jax.numpy as jnp
 
-    return jnp.float32(v)
+    return jnp.float32(v)  # ddlint: disable=PREC001 — word-dtype scalar
 
 
 def _round(x):
@@ -289,4 +293,5 @@ def _to32(x):
         return np.asarray(x, np.float32)
     import jax.numpy as jnp
 
-    return x.astype(jnp.float32)
+    # integer-valued adjustment < 2^24: cast is exact
+    return x.astype(jnp.float32)  # ddlint: disable=PREC001

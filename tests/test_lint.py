@@ -1,0 +1,443 @@
+"""The pint_tpu.lint gate and per-rule fixtures.
+
+Three cases per AST rule (triggering / clean / suppressed), a
+seeded-f32-demotion fixture proving the jaxpr audit fires, and the
+package-wide gate: ``pint_tpu`` must lint clean modulo the checked-in
+baseline (whose header records the burn-down).  Set
+``PINT_TPU_SKIP_LINT=1`` to skip the whole module on WIP branches
+(also honored by conftest.py).
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from pint_tpu.lint import (
+    apply_baseline,
+    default_baseline_path,
+    lint_source,
+    load_baseline,
+)
+from pint_tpu.lint.baseline import parse_header, write_baseline
+from pint_tpu.lint.findings import Finding
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PINT_TPU_SKIP_LINT") == "1",
+    reason="PINT_TPU_SKIP_LINT=1")
+
+
+def codes(src, filename="somemodule.py"):
+    return [f.code for f in lint_source(textwrap.dedent(src), filename)]
+
+
+# --- DD001: raw +/- on DD/QS words -------------------------------------------
+class TestDD001:
+    def test_fires_on_raw_recombination(self):
+        src = """
+        def collapse(x):
+            return x.hi + x.lo
+        """
+        assert codes(src, "fitter.py") == ["DD001"]
+
+    def test_fires_on_qs_words_and_sub(self):
+        src = """
+        def collapse(q, other):
+            return q.w0 - other
+        """
+        assert codes(src, "toa.py") == ["DD001"]
+
+    def test_clean_inside_dd_module(self):
+        src = """
+        def to_float(x):
+            return x.hi + x.lo
+        """
+        assert codes(src, "dd.py") == []
+
+    def test_clean_on_proper_collapse(self):
+        src = """
+        from pint_tpu import dd
+
+        def collapse(x):
+            return dd.to_float(x)
+        """
+        assert codes(src, "fitter.py") == []
+
+    def test_suppressed(self):
+        src = """
+        def collapse(x):
+            return x.hi + x.lo  # ddlint: disable=DD001 — plotting only
+        """
+        assert codes(src, "plk.py") == []
+
+
+# --- PREC001: dtype demotion in precision-critical modules --------------------
+class TestPREC001:
+    def test_fires_on_astype_f32(self):
+        src = """
+        import jax.numpy as jnp
+
+        def demote(x):
+            return x.astype(jnp.float32)
+        """
+        assert codes(src, "residuals.py") == ["PREC001"]
+
+    def test_fires_on_narrow_dtype_kwarg_and_constructor(self):
+        src = """
+        import numpy as np
+
+        def make(n):
+            return np.zeros(n, dtype=np.float16), np.float32(3.0)
+        """
+        got = codes(src, "mjd.py")
+        assert got.count("PREC001") == 2
+
+    def test_fires_on_weak_float_return(self):
+        # the dd._split_const hazard: a bare Python float return lets
+        # weak-type promotion demote the arithmetic it feeds
+        src = """
+        _CONST = 134217729.0
+
+        def split_const(a):
+            return _CONST
+        """
+        assert codes(src, "dd.py") == ["PREC001"]
+
+    def test_clean_outside_precision_modules(self):
+        src = """
+        import jax.numpy as jnp
+
+        def demote(x):
+            return x.astype(jnp.float32)
+        """
+        assert codes(src, "gridutils.py") == []
+
+    def test_clean_on_f64_cast(self):
+        src = """
+        import jax.numpy as jnp
+
+        def widen(x):
+            return x.astype(jnp.float64)
+        """
+        assert codes(src, "residuals.py") == []
+
+    def test_suppressed(self):
+        src = """
+        import jax.numpy as jnp
+
+        def split(x):
+            return x.astype(jnp.float32)  # ddlint: disable=PREC001 — exact
+        """
+        assert codes(src, "residuals.py") == []
+
+
+# --- TRACE001: host sync inside jit-reachable code ----------------------------
+class TestTRACE001:
+    def test_fires_on_float_in_jit(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        """
+        assert codes(src) == ["TRACE001"]
+
+    def test_fires_on_np_call_in_jit(self):
+        src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.sum(x)
+        """
+        assert codes(src) == ["TRACE001"]
+
+    def test_fires_on_item_through_call_graph(self):
+        # jit-reachability propagates through the module-local call graph
+        src = """
+        import jax
+
+        def helper(x):
+            return x.item()
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+        """
+        assert codes(src) == ["TRACE001"]
+
+    def test_fires_in_transform_arg(self):
+        src = """
+        import jax
+        import numpy as np
+
+        def outer(xs):
+            def body(c, x):
+                return c, np.log(x)
+            return jax.lax.scan(body, 0.0, xs)
+        """
+        assert codes(src) == ["TRACE001"]
+
+    def test_clean_outside_jit(self):
+        src = """
+        import numpy as np
+
+        def f(x):
+            return float(np.sum(x))
+        """
+        assert codes(src) == []
+
+    def test_clean_on_metadata_and_consts(self):
+        src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            n = int(x.shape[0])
+            return x * np.log(2.0 * np.pi) * n
+        """
+        assert codes(src) == []
+
+    def test_clean_in_host_guard_branch(self):
+        src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            if isinstance(x, np.ndarray) or np.isscalar(x):
+                return np.round(x)
+            return x
+        """
+        assert codes(src) == []
+
+    def test_clean_after_device_guard_early_return(self):
+        # the fitter's `if xp is not np: return ...` dispatch idiom
+        src = """
+        import jax
+        import numpy as np
+
+        def solve(xp, x):
+            if xp is not np:
+                return xp.sum(x)
+            return np.sum(x)
+
+        @jax.jit
+        def f(x):
+            return solve(__import__("jax.numpy"), x)
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)  # ddlint: disable=TRACE001 — trace const
+        """
+        assert codes(src) == []
+
+
+# --- JIT001: retrace hazards --------------------------------------------------
+class TestJIT001:
+    def test_fires_on_mutable_global_closure(self):
+        src = """
+        import jax
+
+        CACHE = {}
+
+        @jax.jit
+        def f(x):
+            return x * CACHE["scale"]
+        """
+        assert codes(src) == ["JIT001"]
+
+    def test_fires_on_float_default(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x, tol=1e-8):
+            return x * tol
+        """
+        assert codes(src) == ["JIT001"]
+
+    def test_fires_on_unhashable_static_argnums(self):
+        src = """
+        import jax
+
+        def g(x, opts):
+            return x
+
+        f = jax.jit(g, static_argnums={1: "opts"})
+        """
+        assert "JIT001" in codes(src)
+
+    def test_clean_function(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        SCALE = 2.0
+
+        @jax.jit
+        def f(x):
+            return jnp.sum(x) * SCALE
+        """
+        assert codes(src) == []
+
+    def test_clean_when_not_jitted(self):
+        src = """
+        CACHE = {}
+
+        def f(x):
+            return x * CACHE["scale"]
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        import jax
+
+        _REGISTRY = {}
+
+        @jax.jit
+        def f(x):
+            # populated once at import  # ddlint: disable=JIT001
+            return x * _REGISTRY["scale"]
+        """
+        assert codes(src) == []
+
+
+# --- the jaxpr audit ----------------------------------------------------------
+class TestJaxprAudit:
+    def test_fires_on_seeded_f32_demotion(self):
+        import jax.numpy as jnp
+
+        from pint_tpu.lint.jaxpr_audit import audit_fn
+
+        def bad(x):
+            # a demotion that discards bits: no compensating subtraction
+            return jnp.sin(x.astype(jnp.float32)).astype(jnp.float64) * 2.0
+
+        findings = audit_fn(bad, jnp.ones(4, jnp.float64), name="seeded")
+        assert [f.code for f in findings] == ["JAXPR001"]
+        assert findings[0].origin == "jaxpr"
+
+    def test_clean_on_exact_split(self):
+        import jax.numpy as jnp
+
+        from pint_tpu.lint.jaxpr_audit import audit_fn
+
+        def split(x):
+            w0 = x.astype(jnp.float32)
+            r = x - w0.astype(jnp.float64)
+            return w0, r
+
+        assert audit_fn(split, jnp.ones(4, jnp.float64)) == []
+
+    def test_clean_on_sanctioned_qs_kernel(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu import qs
+        from pint_tpu.lint.jaxpr_audit import audit_fn
+
+        x = jnp.asarray(np.linspace(0.0, 1e6, 8))
+        assert audit_fn(jax.jit(qs.from_f64_device), x) == []
+
+    def test_entry_points_clean(self):
+        from pint_tpu.lint.jaxpr_audit import audit_entry_points
+
+        assert [f.format() for f in audit_entry_points()] == []
+
+
+# --- baseline machinery -------------------------------------------------------
+class TestBaseline:
+    def _finding(self, code="TRACE001", path="pint_tpu/x.py", src="a = 1"):
+        return Finding(code, path, 3, 1, "msg", source=src)
+
+    def test_roundtrip_and_multiplicity(self, tmp_path):
+        path = str(tmp_path / "baseline.txt")
+        fs = [self._finding(), self._finding(), self._finding(src="b = 2")]
+        write_baseline(path, fs, date="2026-08-04")
+        base = load_baseline(path)
+        assert sum(base.values()) == 3
+        new, n_base, stale = apply_baseline(fs, base)
+        assert (new, n_base, sum(stale.values())) == ([], 3, 0)
+        # a fourth identical finding exceeds the multiplicity budget
+        new, _, _ = apply_baseline(fs + [self._finding()], base)
+        assert len(new) == 1
+
+    def test_header_preserves_first_run(self, tmp_path):
+        path = str(tmp_path / "baseline.txt")
+        write_baseline(path, [self._finding() for _ in range(5)])
+        write_baseline(path, [self._finding()])
+        meta = parse_header(path)
+        assert meta["first-run"] == 5 and meta["current"] == 1
+
+    def test_shipped_baseline_is_shrunk(self):
+        meta = parse_header(default_baseline_path())
+        assert meta["first-run"] is not None and meta["current"] is not None
+        assert meta["current"] < meta["first-run"]
+        n_entries = sum(load_baseline(default_baseline_path()).values())
+        assert n_entries == meta["current"]
+
+
+# --- the package gate ---------------------------------------------------------
+class TestGate:
+    def test_package_clean_modulo_baseline(self, capsys):
+        """THE tier-1 lint gate: AST rules + jaxpr audit over the whole
+        package must report zero new findings against the baseline."""
+        from pint_tpu.lint.cli import main
+
+        rc = main(["--format=json"])
+        out = json.loads(capsys.readouterr().out)
+        assert out["findings"] == [], out["findings"]
+        assert rc == 0
+        assert out["stale_baseline"] == 0
+
+    def test_cli_reports_seeded_violation(self, tmp_path, capsys):
+        from pint_tpu.lint.cli import main
+
+        bad = tmp_path / "residuals.py"
+        bad.write_text(
+            "import jax.numpy as jnp\n\n"
+            "def f(x):\n"
+            "    return x.astype(jnp.float32)\n")
+        rc = main(["--no-jaxpr-audit", "--no-baseline", "--format=json",
+                   str(bad)])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert [f["code"] for f in out["findings"]] == ["PREC001"]
+
+    def test_update_baseline_roundtrip(self, tmp_path, capsys):
+        from pint_tpu.lint.cli import main
+
+        bad = tmp_path / "residuals.py"
+        bad.write_text(
+            "import jax.numpy as jnp\n\n"
+            "def f(x):\n"
+            "    return x.astype(jnp.float32)\n")
+        bl = tmp_path / "bl.txt"
+        rc = main(["--no-jaxpr-audit", "--baseline", str(bl),
+                   "--update-baseline", str(bad)])
+        assert rc == 0
+        rc = main(["--no-jaxpr-audit", "--baseline", str(bl), str(bad)])
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_list_rules(self, capsys):
+        from pint_tpu.lint.cli import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for code in ("DD001", "PREC001", "TRACE001", "JIT001", "JAXPR001"):
+            assert code in out

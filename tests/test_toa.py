@@ -201,6 +201,43 @@ class TestTOAsObject:
         assert t.ssb_obs_pos is not None
 
 
+class TestPulseNumberTracking:
+    """-pn flags -> batch -> use_pulse_numbers residuals, end to end.
+
+    The pulse numbers (~1e11 cycles) are subtracted on device through the
+    exact f64->f32 word split (`qs.from_f64_device`); with the flags set
+    to the nearest-integer assignment the result must match "nearest"
+    tracking to well below a nanocycle."""
+
+    def test_matches_nearest_when_pn_is_nearest(self):
+        import warnings
+
+        from pint_tpu import qs
+        from pint_tpu.models import get_model
+        from pint_tpu.residuals import Residuals
+
+        par = ("PSR FAKEPN\nRAJ 05:00:00 1\nDECJ 20:00:00 1\n"
+               "F0 300.0 1\nF1 -1e-15 1\nPEPOCH 55000\nPOSEPOCH 55000\n"
+               "DM 15.0 1\nTZRMJD 55000.1\nTZRFRQ 1400\nTZRSITE gbt\n"
+               "EPHEM DE421\n")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.splitlines())
+            t = get_TOAs_array(55000.0 + np.linspace(0.0, 40.0, 12),
+                               obs="gbt", errors_us=1.0, freqs_mhz=1400.0,
+                               ephem="DE421")
+            r0 = Residuals(t, model, track_mode="nearest",
+                           subtract_mean=False)
+            ph = model.calc.phase(r0.pdict, r0.batch)
+            ip, _ = qs.round_nearest(ph)
+            for fl, n in zip(t.flags, np.asarray(ip)):
+                fl["pn"] = "%d" % int(n)
+            r1 = Residuals(t, model, track_mode="use_pulse_numbers",
+                           subtract_mean=False)
+        np.testing.assert_allclose(r1.phase_resids, r0.phase_resids,
+                                   rtol=0, atol=1e-9)
+
+
 @needs_refdata
 class TestReferenceData:
     def test_ngc6440e(self):

@@ -344,6 +344,30 @@ class TestSimulationNoise:
         assert 0.05 < spread_us < 10.0
 
 
+class TestLintGate:
+    """The pint_tpu.lint console/CLI leg of the lint gate (the in-process
+    gate rides tier-1 in tests/test_lint.py): ``python -m pint_tpu.lint``
+    must exit 0 on the shipped tree and its JSON must be machine-readable."""
+
+    @pytest.mark.skipif(
+        __import__("os").environ.get("PINT_TPU_SKIP_LINT") == "1",
+        reason="PINT_TPU_SKIP_LINT=1")
+    def test_module_entry_point_clean_json(self):
+        import json
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "pint_tpu.lint", "--format=json"],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["findings"] == []
+        assert doc["baselined"] >= 0 and doc["stale_baseline"] == 0
+
+
 class TestTupleChisq:
     def test_matches_grid(self):
         """tuple_chisq over an arbitrary point list equals grid_chisq_flat
