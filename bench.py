@@ -158,7 +158,9 @@ def bench_ngc6440e():
             times.append(time.time() - t0)
     t = min(times)
     out = {"wall_s": round(t, 4), "fits_per_sec": round(1.0 / t, 2),
-           "compile_s": round(compile_s, 2), "ntoas": toas.ntoas}
+           "compile_s": round(compile_s, 2), "ntoas": toas.ntoas,
+           "fit_status": f.fitresult.status.name,
+           "guard_trips": dict(f.fitresult.guard_trips or {})}
     out.update(_util(toas.ntoas, len(f.fit_params), t, niter=4))
     return out
 
@@ -508,6 +510,12 @@ def bench_quick():
         "chi2": round(float(chi2), 4), "dataset": dataset,
         "ntoas": toas.ntoas, "nfit": len(f.fit_params),
         "compile_s": round(compile_s, 2),
+        # guarded-fit-engine provenance (ISSUE 3): the terminal
+        # FitStatus of the timed fit and every guard that tripped —
+        # a bench regression to DIVERGED/backtracking shows up in the
+        # series even when the wall-clock looks fine
+        "fit_status": f.fitresult.status.name,
+        "guard_trips": dict(f.fitresult.guard_trips or {}),
         "submetrics": {},
     }
 
@@ -636,6 +644,12 @@ def main(argv=None):
         # >0: compile_s figures are cache-LOAD cost (~10 s/program over
         # the tunnel), not recompiles
         "xla_cache_entries_at_start": n_cached,
+        # guarded-fit-engine provenance (from the single-fit submetric —
+        # the grid itself is a vmapped program with no per-point status)
+        "fit_status": (submetrics.get("ngc6440e_wls") or {}).get(
+            "fit_status"),
+        "guard_trips": (submetrics.get("ngc6440e_wls") or {}).get(
+            "guard_trips", {}),
         "submetrics": submetrics,
     }))
 

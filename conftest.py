@@ -106,6 +106,11 @@ def pytest_configure(config):
         "lint: the pint_tpu.lint precision/trace-safety gate "
         "(tests/test_lint.py; part of tier-1 by default, skip WIP "
         "branches with PINT_TPU_SKIP_LINT=1)")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection coverage of the guarded fit engine "
+        "(tests/test_faults.py; rides the tier-1 'not slow' smoke "
+        "selection — every guard must fire on every run)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -116,6 +121,10 @@ def pytest_collection_modifyitems(config, items):
     skip_lint = os.environ.get("PINT_TPU_SKIP_LINT") == "1"
     for item in items:
         fname = os.path.basename(str(item.fspath))
+        if fname == "test_faults.py":
+            # deliberately NOT slow-marked: the guards are tier-1
+            # robustness evidence
+            item.add_marker(_pytest.mark.faults)
         if fname == "test_lint.py":
             # the static-analysis gate rides in the smoke tier so every
             # tier-1 run enforces the precision/trace-safety invariants;

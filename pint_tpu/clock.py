@@ -29,7 +29,9 @@ from typing import List, Optional
 
 import numpy as np
 
-from pint_tpu.exceptions import ClockCorrectionError, ClockCorrectionOutOfRange
+from pint_tpu.exceptions import (ClockCorrectionError,
+                                 ClockCorrectionOutOfRange,
+                                 ClockCorrectionWarning)
 
 
 class ClockFile:
@@ -59,11 +61,14 @@ class ClockFile:
             if np.any(bad):
                 msg = (
                     f"{np.sum(bad)} MJD(s) outside clock file "
-                    f"{self.friendly_name} span [{self.mjd[0]}, {self.mjd[-1]}]"
+                    f"{self.friendly_name} span [{self.mjd[0]}, {self.mjd[-1]}] "
+                    f"(last correction at MJD {self.last_correction_mjd:.2f}"
+                    " — the clock file may simply be stale; see "
+                    "pint_tpu.clockcorr.update_clock_files)"
                 )
                 if limits == "error":
                     raise ClockCorrectionOutOfRange(msg)
-                warnings.warn(msg)
+                warnings.warn(msg, ClockCorrectionWarning)
         return np.interp(mjd, self.mjd, self.offset)
 
     @property
@@ -257,7 +262,7 @@ def find_clock_file(name: str, fmt="tempo", obscode=None, limits="warn",
         if limits == "error":
             raise ClockCorrectionError(msg)
         if name not in _warned:
-            warnings.warn(msg)
+            warnings.warn(msg, ClockCorrectionWarning)
             _warned.add(name)
     return cf
 

@@ -150,6 +150,11 @@ def load_fits_TOAs(eventfile: str, extname: str = "EVENTS",
     # would cost minutes + GBs at 1e7 events); TOAs.select carries them
     out.energies = energies
     out.weights = weights
+    # photon events carry NO per-TOA uncertainty by construction (the
+    # zero error above feeds unbinned template likelihoods, never a
+    # whitened solve) — exempt them from the TOABatch validation
+    # policy, which would otherwise reject the zeros
+    out.is_photon_events = True
     out.extra = {c: np.asarray(ev[c], np.float64)[keep]
                  for c in extra_columns if c in ev}
     return out

@@ -61,6 +61,12 @@ class TimFileError(TOAError):
     """Malformed .tim file line or command."""
 
 
+class InvalidTOAs(TOAError):
+    """TOA data failed batch validation (non-finite/nonpositive
+    uncertainties, non-finite MJDs, or an empty selection) under
+    ``policy="raise"`` — see :func:`pint_tpu.toabatch.make_batch`."""
+
+
 # --- observatory / clock ------------------------------------------------------
 class ObservatoryError(PintTpuError):
     """Unknown observatory or bad observatory definition."""
@@ -89,7 +95,20 @@ class FitError(PintTpuError):
 
 
 class ConvergenceFailure(FitError):
-    """Iterative fit failed to converge."""
+    """Iterative fit failed to converge.
+
+    When raised by the guarded fit engine's degradation chain
+    (``Fitter._fit_fused`` fused -> eager stepwise -> damped LM), the
+    exception carries the evidence: ``status`` is the terminal
+    :class:`pint_tpu.fitter.FitStatus` and ``rung_statuses`` maps each
+    attempted rung name (``"fused"``/``"eager"``/``"lm"``) to the
+    status it ended with, so callers can see exactly how far the chain
+    got before giving up."""
+
+    def __init__(self, msg="", status=None, rung_statuses=None):
+        self.status = status
+        self.rung_statuses = dict(rung_statuses or {})
+        super().__init__(msg)
 
 
 class MaxiterReached(ConvergenceFailure):

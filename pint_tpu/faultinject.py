@@ -1,0 +1,231 @@
+"""Fault injection: deterministic corruption of the fit pipeline's inputs
+and kernels, so every guard in the guarded fit engine is testable.
+
+The reference's robustness machinery (``DownhillFitter`` step-quality
+control, degeneracy warnings — `/root/reference/src/pint/fitter.py:915`)
+is exercised in its test suite by *finding* naturally ill-posed datasets.
+That does not scale to a jit-compiled core: inside a fused
+``lax.while_loop`` the only observable is the flat result vector, so the
+failure modes (NaN chi2, degenerate columns, solver garbage) must be
+*injected* at known points and the guards asserted to fire — the
+failpoint pattern databases use for crash-recovery testing.
+
+Two mechanisms, both context-managed and restored on exit:
+
+* **Patch-based injectors** replace a module-level function or method
+  that the fitters look up dynamically (``TimingModel.
+  scaled_toa_uncertainty``, ``fitter.fit_wls_svd``/``fit_wls_eigh``,
+  ``fitter._whiten_normalize``, ``clock.find_clock_file``).  Because jit
+  traces capture these at TRACE time, injection only affects programs
+  built (fitters constructed) inside the context — enter the context
+  first, then build the fitter.
+* **Registry failpoints** (:func:`wrap`) for call sites that close over
+  locals and cannot be patched from outside (the downhill noise-fit
+  gradient).  Core code calls ``faultinject.wrap("name", fn)``, which is
+  ``fn`` itself unless an injection is active — a dict lookup at build
+  time, zero cost in jitted code.
+
+Data-level corruptors (:func:`corrupt_toa_errors`, :func:`corrupt_mjds`)
+mutate a ``TOAs`` object in place (and restore it), driving the
+``TOABatch`` validation policy rather than the in-fit guards.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
+           "degenerate_column", "clock_out_of_range",
+           "nonfinite_noise_grad", "corrupt_toa_errors", "corrupt_mjds"]
+
+#: active registry failpoints: name -> wrapper factory ``fn -> fn'``
+_active: dict = {}
+
+
+def is_active(name: str) -> bool:
+    return name in _active
+
+
+def wrap(name: str, fn):
+    """The failpoint hook core code consults: returns ``fn`` unless an
+    injection named ``name`` is active, in which case the injection's
+    wrapper of ``fn``."""
+    factory = _active.get(name)
+    return fn if factory is None else factory(fn)
+
+
+@contextlib.contextmanager
+def _registered(name: str, factory) -> Iterator[None]:
+    if name in _active:
+        raise RuntimeError(f"faultinject {name!r} already active")
+    _active[name] = factory
+    try:
+        yield
+    finally:
+        _active.pop(name, None)
+
+
+@contextlib.contextmanager
+def _patched(obj, attr: str, new) -> Iterator[None]:
+    old = getattr(obj, attr)
+    setattr(obj, attr, new)
+    try:
+        yield
+    finally:
+        setattr(obj, attr, old)
+
+
+# --- model / solver injectors -------------------------------------------------
+
+@contextlib.contextmanager
+def nan_sigma(rows: Optional[Sequence[int]] = None) -> Iterator[None]:
+    """Scatter NaN into the scaled per-TOA uncertainties (every fitter's
+    whitening input), BELOW the TOABatch validation layer — the raw
+    ``error_us`` stays clean, so this drives the in-fit non-finite
+    guards (fused NONFINITE sentinel, eager ConvergenceFailure, LM
+    lambda bailout), not the input-validation policy.
+
+    ``rows``: row indices to poison (default: row 0).  Build the fitter
+    INSIDE the context (jit traces bind the patched method at trace
+    time).
+    """
+    import jax.numpy as jnp
+
+    from pint_tpu.models.timing_model import TimingModel
+
+    idx = np.asarray([0] if rows is None else list(rows), np.int64)
+    orig = TimingModel.scaled_toa_uncertainty
+
+    def poisoned(self, p, batch):
+        sigma = orig(self, p, batch)
+        return sigma.at[jnp.asarray(idx)].set(jnp.nan) \
+            if hasattr(sigma, "at") else _np_scatter_nan(sigma, idx)
+
+    with _patched(TimingModel, "scaled_toa_uncertainty", poisoned):
+        yield
+
+
+def _np_scatter_nan(sigma, idx):
+    out = np.asarray(sigma, np.float64).copy()
+    out[idx] = np.nan
+    return out
+
+
+@contextlib.contextmanager
+def nan_wls_solver() -> Iterator[None]:
+    """Force both WLS solve kernels (`fit_wls_svd`, `fit_wls_eigh`) to
+    return NaN parameter steps — solver-output garbage with perfectly
+    finite inputs, the failure mode a wedged accelerator produces.  The
+    fused sentinel must report NONFINITE (the NaN step poisons x, then
+    chi2) and the degradation chain must reach the damped-LM rung
+    (whose solve is independent of these kernels)."""
+    from pint_tpu import fitter
+
+    def _nan_wrap(kern):
+        def bad(M, r_sec, sigma_sec, threshold=None):
+            dpars, Sigma_n, norms, n_bad = kern(M, r_sec, sigma_sec,
+                                                threshold)
+            return dpars * np.nan, Sigma_n, norms, n_bad
+        return bad
+
+    with _patched(fitter, "fit_wls_svd", _nan_wrap(fitter.fit_wls_svd)), \
+            _patched(fitter, "fit_wls_eigh",
+                     _nan_wrap(fitter.fit_wls_eigh)):
+        yield
+
+
+@contextlib.contextmanager
+def degenerate_column(src: int = 0, dst: int = 1) -> Iterator[None]:
+    """Overwrite normalized design-matrix column ``dst`` with column
+    ``src`` inside ``_whiten_normalize`` (the shared entry of every WLS/
+    GLS solve): an EXACTLY degenerate pair, which the SVD/eigh threshold
+    must drop (``n_bad >= 1`` -> DegeneracyWarning) instead of letting a
+    1/0 direction poison the step."""
+    from pint_tpu import fitter
+
+    orig = fitter._whiten_normalize
+
+    def degen(M, r_sec, sigma_sec):
+        Mn, rw, norms = orig(M, r_sec, sigma_sec)
+        if hasattr(Mn, "at"):
+            Mn = Mn.at[:, dst].set(Mn[:, src])
+        else:
+            Mn = Mn.copy()
+            Mn[:, dst] = Mn[:, src]
+        return Mn, rw, norms
+
+    with _patched(fitter, "_whiten_normalize", degen):
+        yield
+
+
+@contextlib.contextmanager
+def clock_out_of_range(span=(50000.0, 50010.0)) -> Iterator[None]:
+    """Make every clock-file lookup resolve to a file whose span is
+    ``span`` (default far in the past), so evaluating any modern TOA is
+    out of range: drives the ``limits="warn"|"error"`` policy
+    end-to-end through ``TOAs.apply_clock_corrections`` ->
+    ``Observatory.clock_corrections`` -> ``ClockFile.evaluate``."""
+    from pint_tpu import clock
+
+    lo, hi = float(span[0]), float(span[1])
+
+    def tiny(name, fmt="tempo", obscode=None, limits="warn",
+             bogus_last_correction=False):
+        return clock.ClockFile([lo, hi], [0.0, 1e-6],
+                               friendly_name=f"faultinject:{name}")
+
+    with _patched(clock, "find_clock_file", tiny):
+        yield
+
+
+@contextlib.contextmanager
+def nonfinite_noise_grad() -> Iterator[None]:
+    """Registry failpoint ``"noise_grad"``: the downhill noise-fit
+    gradient returns NaN, so L-BFGS-B aborts at its start point and the
+    finite-difference Hessian is non-finite — the
+    ``DownhillWLSFitter._fit_noise`` fallback (uncertainties withheld
+    with a warning, never NaN-written) must engage."""
+    def factory(fn):
+        def bad_grad(x, p):
+            return fn(x, p) * np.nan
+        return bad_grad
+
+    with _registered("noise_grad", factory):
+        yield
+
+
+# --- data-level corruptors (drive the TOABatch validation policy) -------------
+
+@contextlib.contextmanager
+def corrupt_toa_errors(toas, rows: Sequence[int],
+                       value: float = np.nan) -> Iterator[None]:
+    """Overwrite ``toas.error_us[rows]`` with ``value`` (NaN/0/negative),
+    restoring on exit — validation-policy fodder for
+    ``toas.to_batch(policy=...)``."""
+    err = np.asarray(toas.error_us, np.float64)
+    saved = err[list(rows)].copy()
+    err[list(rows)] = value
+    toas.error_us = err
+    try:
+        yield
+    finally:
+        err[list(rows)] = saved
+        toas.error_us = err
+
+
+@contextlib.contextmanager
+def corrupt_mjds(toas, rows: Sequence[int]) -> Iterator[None]:
+    """NaN the TDB fractional MJD of ``rows`` (restored on exit).  The
+    TOAs must already carry TDBs (``compute_TDBs``/``get_TOAs``)."""
+    if toas.tdb is None:
+        raise ValueError("corrupt_mjds needs computed TDBs")
+    frac = np.asarray(toas.tdb.frac, np.float64)
+    saved = frac[list(rows)].copy()
+    frac[list(rows)] = np.nan
+    try:
+        yield
+    finally:
+        frac[list(rows)] = saved

@@ -154,7 +154,27 @@ def grid_chisq_flat(fitter: Fitter, grid_values: Dict[str, np.ndarray],
             jax.vmap(lambda pp: fit_one(pp), in_axes=(axes,)))
     stacked = stack_grid_pdict(model, p, grid_values)
     chi2, _ = vfit(stacked)
-    return np.asarray(chi2)
+    return _check_grid_chi2(np.asarray(chi2))
+
+
+def _check_grid_chi2(chi2: np.ndarray) -> np.ndarray:
+    """Non-finite guard for vmapped/sharded grid fits: inside the one
+    compiled program a poisoned grid point is invisible, so the host
+    boundary is where a NaN chi2 must be called out (the values are
+    still returned — a partial grid is useful — but never silently)."""
+    bad = int(np.sum(~np.isfinite(chi2)))
+    if bad:
+        import warnings
+
+        from pint_tpu import profiling
+        from pint_tpu.exceptions import PintTpuWarning
+
+        profiling.count("guard.grid_nonfinite", bad)
+        warnings.warn(
+            f"{bad}/{chi2.size} grid points returned non-finite chi2 "
+            "(degenerate or diverging fits at those parameter values)",
+            PintTpuWarning)
+    return chi2
 
 
 def grid_chisq(fitter: Fitter, parnames: Sequence[str],

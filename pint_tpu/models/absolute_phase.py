@@ -58,7 +58,11 @@ class AbsPhase(PhaseComponent):
         return t
 
     def make_tzr_batch(self, ephem="DE421", planets=False, toas=None):
-        return self.make_tzr_toas(ephem=ephem, planets=planets).to_batch()
+        # policy="off": the TZR reference TOA carries a deliberate zero
+        # uncertainty (it is a phase reference, never whitened), which
+        # the user-facing validation policies would reject
+        return self.make_tzr_toas(ephem=ephem,
+                                  planets=planets).to_batch(policy="off")
 
     def phase(self, p, batch, delay, is_tzr=False):
         """AbsPhase defines the reference TOA; it adds no phase itself."""

@@ -260,9 +260,13 @@ def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
                        maxiter: int = 2) -> np.ndarray:
     """chi2 over a flat grid, sharded over the mesh: the distributed
     replacement for the reference's ProcessPoolExecutor grid."""
+    from pint_tpu.gridutils import _check_grid_chi2
+
     mesh = mesh or make_mesh()
     fit, stacked, batch, _ = prep_sharded_grid(
         fitter, grid_values, mesh, mesh.devices.shape[0], maxiter,
         "sharded")
     chi2, _ = fit(stacked, batch)
-    return np.asarray(chi2)
+    # same host-boundary non-finite guard as the single-device grid:
+    # the sharded program cannot report a poisoned point from in-graph
+    return _check_grid_chi2(np.asarray(chi2))

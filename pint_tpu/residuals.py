@@ -93,9 +93,13 @@ class Residuals:
     `/root/reference/src/pint/residuals.py:43`)."""
 
     def __init__(self, toas, model: TimingModel, track_mode: Optional[str] = None,
-                 subtract_mean: bool = True, use_weighted_mean: bool = True):
+                 subtract_mean: bool = True, use_weighted_mean: bool = True,
+                 policy: Optional[str] = None):
         self.toas = toas
         self.model = model
+        #: input-validation policy ("raise"|"mask"|"warn") applied at
+        #: batch export — see pint_tpu.toabatch.make_batch
+        self.policy = policy
         if track_mode is None:
             tm = getattr(model, "TRACK", None)
             track_mode = "use_pulse_numbers" if (
@@ -109,7 +113,7 @@ class Residuals:
         has_phoff = "PhaseOffset" in model.components
         self.subtract_mean = subtract_mean and not has_phoff
         self.use_weighted_mean = use_weighted_mean
-        self.batch = toas.to_batch()
+        self.batch = toas.to_batch(policy=policy)
         if model.tzr_batch is None and "AbsPhase" in model.components:
             model.attach_tzr(toas)
         self._fn = build_resid_fn(model, self.batch, self.track_mode,
@@ -277,13 +281,40 @@ class WidebandTOAResiduals:
     """
 
     def __init__(self, toas, model: TimingModel,
-                 track_mode: Optional[str] = None):
+                 track_mode: Optional[str] = None,
+                 policy: Optional[str] = None):
         dmdata = toas.get_dm_data()
         if dmdata is None:
             raise ValueError(
                 "wideband residuals need TOAs with -pp_dm/-pp_dme flags")
         self.dm_index, self.dm_data, self.dm_error = dmdata
-        self.toa = Residuals(toas, model, track_mode=track_mode)
+        from pint_tpu.toabatch import (ValidationWarning,
+                                       resolve_validate_policy)
+
+        pol = resolve_validate_policy(policy)
+        # the DM rows ride the same whitened solve as the TOA rows:
+        # judge their uncertainties under the same policy ("mask" is
+        # not row-consistent across the two blocks, so invalid DM
+        # errors raise under both "raise" and "mask")
+        dme = np.asarray(self.dm_error, np.float64)
+        bad = ~np.isfinite(dme) | (dme <= 0.0)
+        if bad.any():
+            if pol != "warn":
+                from pint_tpu.exceptions import InvalidTOAs
+
+                raise InvalidTOAs(
+                    f"{int(bad.sum())} non-finite/nonpositive wideband "
+                    'DM uncertainties (-pp_dme); policy="warn" to '
+                    "downweight")
+            import warnings as _warnings
+
+            _warnings.warn(
+                f"downweighting {int(bad.sum())} wideband DM row(s) "
+                "with non-finite/nonpositive -pp_dme",
+                ValidationWarning)
+            self.dm_error = np.where(bad, 1e12, dme)
+        self.toa = Residuals(toas, model, track_mode=track_mode,
+                             policy=policy)
         self.toas = toas
         self.model = model
 

@@ -466,6 +466,7 @@ class TOAs:
             col = getattr(self, attr, None)
             if col is not None:
                 setattr(out, attr, np.asarray(col)[idx])
+        out.is_photon_events = getattr(self, "is_photon_events", False)
         extra = getattr(self, "extra", None)
         if extra is not None:
             out.extra = {k: np.asarray(v)[idx] for k, v in extra.items()}
@@ -589,8 +590,18 @@ class TOAs:
             self._tdb_topo_applied = True
 
     # -- export -------------------------------------------------------------
-    def to_batch(self) -> TOABatch:
-        """Export the device-facing struct-of-arrays pytree."""
+    def to_batch(self, policy: Optional[str] = None) -> TOABatch:
+        """Export the device-facing struct-of-arrays pytree.
+
+        ``policy`` ("raise" | "mask" | "warn") is the input-validation
+        policy applied by :func:`pint_tpu.toabatch.make_batch` to
+        non-finite/nonpositive uncertainties, non-finite MJDs and empty
+        selections; default $PINT_TPU_VALIDATE -> "raise".  Photon-event
+        TOAs (``is_photon_events``) default to "off": their zero
+        uncertainties are by construction (unbinned likelihoods), not
+        data corruption."""
+        if policy is None and getattr(self, "is_photon_events", False):
+            policy = "off"
         if self.tdb is None:
             raise ValueError("run compute_TDBs/compute_posvels before to_batch")
         if self.ssb_obs_pos is None and any(
@@ -617,6 +628,7 @@ class TOAs:
             pulse_number=pn,
             obs_planet_pos_ls={k: v / C_LIGHT
                                for k, v in self.obs_planet_pos.items()},
+            policy=policy,
         )
 
 

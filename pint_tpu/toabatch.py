@@ -21,10 +21,45 @@ Unit conventions (documented once, used everywhere):
 
 from __future__ import annotations
 
+import os
+import warnings
 from typing import Dict, NamedTuple, Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+from pint_tpu.exceptions import InvalidTOAs, PintTpuWarning
+
+
+class ValidationWarning(PintTpuWarning):
+    """TOA batch validation found invalid rows and the active policy
+    ("mask"/"warn") handled them without raising."""
+
+
+#: the three user-facing input-validation policies (see
+#: :func:`make_batch`); "off" additionally exists for INTERNAL trusted
+#: reference batches (the 1-row TZR batch carries a deliberate zero
+#: uncertainty — it is a phase reference, not a measurement)
+VALIDATE_POLICIES = ("raise", "mask", "warn", "off")
+
+#: explicit downweight sentinel [us] for invalid rows under
+#: policy="warn": weight ratio (1e12/1us)^2 = 1e24 makes the row
+#: chi2/fit-neutral while staying inside TPU's emulated-f64 exponent
+#: range (inf is NOT used — 0*inf from mask arithmetic would turn a
+#: downweight into a NaN); same sentinel as `parallel.pad_batch`
+DOWNWEIGHT_ERROR_US = 1e12
+
+
+def resolve_validate_policy(policy: Optional[str]) -> str:
+    """``policy`` if given, else $PINT_TPU_VALIDATE, else "raise" —
+    invalid inputs fail loudly by default (see MIGRATION.md)."""
+    if policy is None:
+        policy = os.environ.get("PINT_TPU_VALIDATE", "raise")
+    if policy not in VALIDATE_POLICIES:
+        raise ValueError(
+            f"validation policy must be one of {VALIDATE_POLICIES}, "
+            f"got {policy!r}")
+    return policy
 
 
 def split_f64_words(x: np.ndarray, nwords: int = 3) -> np.ndarray:
@@ -103,6 +138,43 @@ class TOABatch(NamedTuple):
         )
 
 
+def _validate_rows(day_f, frac64, error, policy):
+    """The input-validation policy (ISSUE 3 leg 4): non-finite/zero/
+    negative uncertainties and non-finite MJDs, judged BEFORE anything
+    reaches the device — inside a jitted program a NaN sigma is
+    unobservable until it has already poisoned chi2.  Returns
+    ``(keep_mask_or_None, error, day_f, frac64)``: under "mask" the
+    caller drops ``~keep`` rows; under "warn" the bad rows come back
+    neutralized (finite MJD, DOWNWEIGHT_ERROR_US) with a warning —
+    the explicit replacement for the silent ``np.where(..., inf)``
+    downweighting this policy supersedes."""
+    bad_sigma = ~np.isfinite(error) | (error <= 0.0)
+    bad_mjd = ~(np.isfinite(day_f) & np.isfinite(frac64))
+    bad = bad_sigma | bad_mjd
+    if not bad.any():
+        return None, error, day_f, frac64
+    msg = (f"invalid TOA rows: {int(bad_sigma.sum())} non-finite/"
+           f"nonpositive uncertainties, {int(bad_mjd.sum())} non-finite "
+           f"MJDs (of {len(bad)} TOAs)")
+    if policy == "raise":
+        raise InvalidTOAs(
+            msg + '; use policy="mask" to drop them or policy="warn" '
+            "to downweight them")
+    if policy == "mask":
+        warnings.warn(msg + f"; masking {int(bad.sum())} TOA(s)",
+                      ValidationWarning)
+        return ~bad, error, day_f, frac64
+    warnings.warn(
+        msg + f"; downweighting {int(bad.sum())} TOA(s) to "
+        f"error={DOWNWEIGHT_ERROR_US:g} us", ValidationWarning)
+    error = np.where(bad, DOWNWEIGHT_ERROR_US, error)
+    good_day = day_f[~bad_mjd]
+    fill_day = float(good_day[0]) if good_day.size else 50000.0
+    day_f = np.where(bad_mjd, fill_day, day_f)
+    frac64 = np.where(bad_mjd, 0.0, frac64)
+    return None, error, day_f, frac64
+
+
 def make_batch(
     tdb_day,
     tdb_frac,
@@ -113,15 +185,57 @@ def make_batch(
     obs_sun_pos_ls=None,
     pulse_number=None,
     obs_planet_pos_ls: Optional[Dict[str, np.ndarray]] = None,
+    policy: Optional[str] = None,
 ) -> TOABatch:
     """Build a TOABatch, filling absent geometry with zeros.
 
     Zero geometry corresponds to data already at the solar-system barycenter
     (the reference's ``@``/``bat`` observatory,
     `/root/reference/src/pint/observatory/special_locations.py:71`).
+
+    ``policy`` ("raise" | "mask" | "warn"; default $PINT_TPU_VALIDATE ->
+    "raise") governs invalid inputs — non-finite/zero/negative
+    uncertainties, non-finite MJDs, empty selections: raise
+    :class:`~pint_tpu.exceptions.InvalidTOAs`, drop the offending rows,
+    or warn and neutralize them (``DOWNWEIGHT_ERROR_US``).  An empty
+    selection always raises except under "warn".
     """
+    policy = resolve_validate_policy(policy)
     frac64 = np.asarray(tdb_frac, np.float64)
-    tdb_day = jnp.asarray(tdb_day, dtype=jnp.int64)
+    day_f = np.asarray(tdb_day, np.float64)
+    error = np.broadcast_to(
+        np.asarray(error_us, np.float64), frac64.shape).copy()
+    keep = None
+    if policy != "off":
+        if frac64.shape[0] == 0:
+            if policy == "warn":
+                warnings.warn("empty TOA selection (0 rows)",
+                              ValidationWarning)
+            else:
+                raise InvalidTOAs(
+                    "empty TOA selection: cannot build a 0-row TOABatch "
+                    '(policy="warn" to permit)')
+        keep, error, day_f, frac64 = _validate_rows(day_f, frac64, error,
+                                                    policy)
+    if keep is not None:
+        if not keep.any():
+            raise InvalidTOAs(
+                "every TOA row is invalid; nothing left after masking")
+        frac64, day_f, error = frac64[keep], day_f[keep], error[keep]
+        freq_mhz = np.asarray(freq_mhz, np.float64)[keep]
+        ssb_obs_pos_ls = None if ssb_obs_pos_ls is None else \
+            np.asarray(ssb_obs_pos_ls)[keep]
+        ssb_obs_vel_c = None if ssb_obs_vel_c is None else \
+            np.asarray(ssb_obs_vel_c)[keep]
+        obs_sun_pos_ls = None if obs_sun_pos_ls is None else \
+            np.asarray(obs_sun_pos_ls)[keep]
+        pulse_number = None if pulse_number is None else \
+            np.asarray(pulse_number)[keep]
+        if obs_planet_pos_ls is not None:
+            obs_planet_pos_ls = {k: np.asarray(v)[keep]
+                                 for k, v in obs_planet_pos_ls.items()}
+    error_us = error
+    tdb_day = jnp.asarray(np.asarray(day_f, np.int64), dtype=jnp.int64)
     tdb_frac = jnp.asarray(frac64, dtype=jnp.float64)
     n = tdb_day.shape[0]
     z3 = jnp.zeros((n, 3), dtype=jnp.float64)

@@ -41,6 +41,20 @@ def test_schema(quick_line):
     assert d["design_matrix"] in ("split", "full")
 
 
+def test_guarded_fit_provenance(quick_line):
+    """ISSUE 3 satellite: the bench JSON carries the guarded fit
+    engine's provenance — the timed fit's terminal FitStatus and the
+    guard-trip counters — so a robustness regression shows up in the
+    bench series even when wall-clock looks fine."""
+    d = quick_line
+    assert d["fit_status"] in ("CONVERGED", "MAXITER", "DIVERGED",
+                               "NONFINITE")
+    # the quick fit is well-posed: it must not have degraded
+    assert d["fit_status"] in ("CONVERGED", "MAXITER")
+    assert isinstance(d["guard_trips"], dict)
+    assert d["guard_trips"] == {}
+
+
 def test_value_is_a_real_number(quick_line):
     d = quick_line
     # the satellite's point: a REAL number, never an error-only line

@@ -141,6 +141,56 @@ def test_known_invalid_cache_never_served_on_failure(repo, tmp_path):
                            invalid_if_older_than=time.time())
 
 
+def test_missing_file_warns_once(tmp_path, monkeypatch):
+    """The module-global `_warned` one-shot set (ISSUE 3 satellite):
+    a missing clock file warns ONCE per name, stays silent on repeat
+    lookups, and re-arms after reset_cache()."""
+    import warnings
+
+    from pint_tpu import clock as clockmod
+
+    monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path / "empty"))
+    clockmod.reset_cache()
+    with pytest.warns(UserWarning, match="not found"):
+        assert clockmod.find_clock_file("no_such.clk",
+                                        fmt="tempo2") is None
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a second warning would raise
+        assert clockmod.find_clock_file("no_such.clk",
+                                        fmt="tempo2") is None
+    clockmod.reset_cache()
+    with pytest.warns(UserWarning, match="not found"):
+        clockmod.find_clock_file("no_such.clk", fmt="tempo2")
+
+
+def test_downloaded_file_limits_policy(repo, tmp_path, monkeypatch):
+    """evaluate(limits=...) end-to-end on a file fetched through the
+    clockcorr client: out-of-range MJDs raise under "error" (message
+    carrying last_correction_mjd — the actionable number for a stale
+    clock file) and clamp-with-warning under "warn"."""
+    from pint_tpu import clock as clockmod
+    from pint_tpu.exceptions import (ClockCorrectionOutOfRange,
+                                     ClockCorrectionWarning)
+
+    url, _ = repo
+    cache = tmp_path / "c6"
+    monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(cache))
+    clockmod.reset_cache()
+    clockcorr.update_clock_files(["gps2utc.clk"], url_base=url)
+    cf = clockmod.find_clock_file("gps2utc.clk", fmt="tempo2")
+    assert cf is not None
+    beyond = float(cf.last_correction_mjd) + 1000.0
+    with pytest.raises(ClockCorrectionOutOfRange) as ei:
+        cf.evaluate(np.array([beyond]), limits="error")
+    assert (f"last correction at MJD {cf.last_correction_mjd:.2f}"
+            in str(ei.value))
+    with pytest.warns(ClockCorrectionWarning,
+                      match="last correction at MJD"):
+        out = cf.evaluate(np.array([beyond]), limits="warn")
+    assert np.allclose(out, cf.offset[-1])  # clamped to the end value
+    clockmod.reset_cache()
+
+
 def test_update_invalidates_clock_lookup_cache(repo, tmp_path,
                                                monkeypatch):
     from pint_tpu import clock as clockmod

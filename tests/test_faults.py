@@ -1,0 +1,405 @@
+"""Fault-injection coverage of the guarded fit engine (ISSUE 3).
+
+Every injected fault must be either caught by its guard or surfaced as
+a typed exception — never a silent NaN/garbage return:
+
+* NaN scaled uncertainties -> fused NONFINITE sentinel -> degradation
+  chain -> ConvergenceFailure with per-rung statuses (nothing written
+  back to the model);
+* NaN WLS solver output -> chain recovers through the damped-LM rung
+  (whose solve is independent of the WLS kernels);
+* the seeded degenerate 3-frequency/free-DM config (the PR 1 FD
+  oscillator) -> fused DIVERGED, chain recovers through the eager rung
+  to a chi2 bit-matching the eager-path reference;
+* an exactly degenerate design column -> DegeneracyWarning, finite fit;
+* out-of-range clock evaluation -> limits policy end-to-end through
+  apply_clock_corrections, message carrying last_correction_mjd;
+* LM lambda overflow and the downhill non-finite-Hessian fallback
+  (the two previously untested failure paths);
+* the TOABatch validation policy knob (raise/mask/warn) on corrupted
+  uncertainties, NaN MJDs and empty selections.
+
+Runs in the tier-1 smoke selection (marker ``faults``; see conftest).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu import faultinject
+from pint_tpu.examples import simulate_j0740_class
+from pint_tpu.exceptions import (ClockCorrectionOutOfRange,
+                                 ClockCorrectionWarning,
+                                 ConvergenceFailure, DegeneracyWarning,
+                                 InvalidTOAs)
+from pint_tpu.fitter import (DownhillWLSFitter, FitDegradedWarning,
+                             FitStatus, LMFitter, WLSFitter)
+from pint_tpu.toabatch import DOWNWEIGHT_ERROR_US, ValidationWarning
+
+
+@pytest.fixture(scope="module")
+def _sim_once():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return simulate_j0740_class(ntoas=40, span_days=600.0, seed=7)
+
+
+@pytest.fixture()
+def small_sim(_sim_once):
+    """A fresh, well-posed 40-TOA J0740-class (model, toas) per test:
+    simulated once, deep-copied per test — fits write back into the
+    model and the corruptors mutate the TOAs."""
+    import copy
+
+    return copy.deepcopy(_sim_once)
+
+
+# --- the in-graph sentinel + degradation chain --------------------------------
+
+class TestFusedSentinelAndChain:
+    def test_nan_sigma_fails_whole_chain_typed(self, small_sim,
+                                               monkeypatch):
+        """NaN uncertainties poison every rung: the chain must raise
+        ConvergenceFailure carrying the per-rung statuses, with the
+        model left untouched (never a garbage write-back)."""
+        monkeypatch.setenv("PINT_TPU_FUSED", "1")
+        m, toas = small_sim
+        f0_before = float(m.F0.value)
+        with faultinject.nan_sigma(rows=[0, 3]):
+            f = WLSFitter(toas, m)
+            with pytest.raises(ConvergenceFailure) as ei, \
+                    warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                f.fit_toas(maxiter=4)
+        e = ei.value
+        assert e.rung_statuses == {"fused": FitStatus.NONFINITE,
+                                   "eager": FitStatus.NONFINITE,
+                                   "lm": FitStatus.NONFINITE}
+        assert e.status is FitStatus.NONFINITE
+        assert float(m.F0.value) == f0_before
+        assert m.F0.uncertainty is None or np.isfinite(
+            float(m.F0.uncertainty))
+
+    def test_nan_solver_recovers_through_lm_rung(self, small_sim,
+                                                 monkeypatch):
+        """Solver-output garbage (finite inputs, NaN steps): fused and
+        eager rungs report NONFINITE, the damped-LM rung — independent
+        of the WLS kernels — recovers a finite chi2, with a
+        FitDegradedWarning per hand-off."""
+        monkeypatch.setenv("PINT_TPU_FUSED", "1")
+        m, toas = small_sim
+        with faultinject.nan_wls_solver():
+            f = WLSFitter(toas, m)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                chi2 = f.fit_toas(maxiter=4)
+        assert np.isfinite(chi2)
+        assert f.fitresult.rung == "lm"
+        assert f.fitresult.converged
+        prov = m.fit_provenance
+        assert prov["rung_statuses"]["fused"] == "NONFINITE"
+        assert prov["rung_statuses"]["eager"] == "NONFINITE"
+        assert prov["rung_statuses"]["lm"] in ("CONVERGED", "MAXITER")
+        degr = [x for x in w
+                if isinstance(x.message, FitDegradedWarning)]
+        assert len(degr) >= 2  # fused->eager and eager->lm hand-offs
+
+    def test_fused_happy_path_one_dispatch(self, small_sim,
+                                           monkeypatch):
+        """The guards are free on the happy path: an entire fused fit
+        stays ONE jitted call + ONE fetch (status/iterations ride the
+        same flat transfer)."""
+        from pint_tpu import profiling
+
+        monkeypatch.setenv("PINT_TPU_FUSED", "1")
+        m, toas = small_sim
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f = WLSFitter(toas, m)
+            with profiling.session() as s:
+                f.fit_toas(maxiter=4)
+        assert s.dispatches.get("jit_call", 0) == 1, s.dispatches
+        assert s.dispatches.get("fetch", 0) == 1, s.dispatches
+        assert f.fitresult.status in (FitStatus.CONVERGED,
+                                      FitStatus.MAXITER)
+        assert f.fitresult.rung == "fused"
+        assert not s.dispatches.get("guard.fused_diverged", 0)
+        assert not s.dispatches.get("guard.fused_nonfinite", 0)
+
+
+class TestDegenerateConfigChain:
+    """The acceptance config: the PR 1 oscillator — 3 observing
+    frequencies cannot determine 4 FD terms with DM free and full-span
+    DMX; the fused loop's frozen linear columns make Gauss-Newton
+    bounce at the ~1e-5 chi2 level forever."""
+
+    @staticmethod
+    def _degenerate_setup(seed=0):
+        from pint_tpu.examples import j0740_realistic_par
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        ntoas, span, bins = 450, 2000.0, 30
+        par = j0740_realistic_par(dmx_bins=bins, span_days=span)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par.splitlines())
+            freqs = np.tile([1400.0, 800.0, 1420.0],
+                            (ntoas + 2) // 3)[:ntoas]
+            toas = make_fake_toas_uniform(
+                54975 - span / 2, 54975 + span / 2, ntoas, model,
+                obs="gbt", error_us=1.0, freq_mhz=freqs,
+                add_noise=True, seed=seed)
+        fe = {800.0: "RCVR800", 1400.0: "RCVR1400",
+              1420.0: "RCVR1400L"}
+        for f_mhz, fl in zip(freqs, toas.flags):
+            fl["fe"] = fe[float(f_mhz)]
+        model.M2.frozen = True
+        model.SINI.frozen = True
+        # DM stays FREE: degenerate with full-span DMX + 3 frequencies
+        return model, toas
+
+    def test_oscillator_diverges_fused_and_recovers(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_FUSED", "1")
+        m, toas = self._degenerate_setup()
+        f = WLSFitter(toas, m)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            chi2 = f.fit_toas(maxiter=16)
+        # the fused attempt must NOT have converged...
+        prov = m.fit_provenance
+        assert prov["rung_statuses"]["fused"] in ("DIVERGED",
+                                                  "NONFINITE")
+        # ...and the chain recovered a finite chi2 through eager
+        assert np.isfinite(chi2)
+        assert f.fitresult.rung == "eager"
+        assert any(isinstance(x.message, FitDegradedWarning)
+                   for x in w)
+
+        # the recovered chi2 matches the direct eager-path reference
+        monkeypatch.setenv("PINT_TPU_FUSED", "0")
+        m2, toas2 = self._degenerate_setup()
+        f2 = WLSFitter(toas2, m2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ref = f2.fit_toas(maxiter=16)
+        assert chi2 == pytest.approx(ref, rel=1e-10)
+
+
+# --- step-quality + degeneracy guards on the eager paths ----------------------
+
+class TestEagerGuards:
+    def test_nan_sigma_raises_eager(self, small_sim, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_FUSED", "0")
+        m, toas = small_sim
+        with faultinject.nan_sigma():
+            f = WLSFitter(toas, m)
+            with pytest.raises(ConvergenceFailure) as ei:
+                f.fit_toas(maxiter=3)
+        assert ei.value.status is FitStatus.NONFINITE
+
+    def test_nan_solver_raises_eager(self, small_sim, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_FUSED", "0")
+        m, toas = small_sim
+        with faultinject.nan_wls_solver():
+            f = WLSFitter(toas, m)
+            with pytest.raises(ConvergenceFailure) as ei:
+                f.fit_toas(maxiter=3)
+        assert ei.value.status is FitStatus.NONFINITE
+
+    def test_degenerate_column_guard(self, small_sim, monkeypatch):
+        """An exactly degenerate column pair is dropped by the SVD/eigh
+        threshold (DegeneracyWarning), never a 1/0 step."""
+        monkeypatch.setenv("PINT_TPU_FUSED", "0")
+        m, toas = small_sim
+        with faultinject.degenerate_column(src=0, dst=1):
+            f = WLSFitter(toas, m)
+            with pytest.warns(DegeneracyWarning):
+                chi2 = f.fit_toas(maxiter=3)
+        assert np.isfinite(chi2)
+
+    def test_guard_trips_recorded(self, small_sim, monkeypatch):
+        """Happy-path eager fit: no guard trips, status recorded."""
+        monkeypatch.setenv("PINT_TPU_FUSED", "0")
+        m, toas = small_sim
+        f = WLSFitter(toas, m)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            chi2 = f.fit_toas(maxiter=4)
+        fr = f.fitresult
+        assert np.isfinite(chi2)
+        assert fr.guard_trips == {}
+        assert fr.rung == "eager"
+        assert fr.status in (FitStatus.CONVERGED, FitStatus.MAXITER)
+        assert fr.converged
+
+
+# --- the previously-untested failure paths (satellite) ------------------------
+
+class TestLMOverflowBailout:
+    def test_lambda_overflow_warns_then_raises(self, small_sim,
+                                               monkeypatch):
+        """fitter.py LM loop: with every trial chi2 NaN, lambda climbs
+        5x per iteration from 1e-3 past 1e12 (~22 iterations) — the
+        overflow bailout must warn, and the non-finite final chi2 must
+        raise instead of being returned."""
+        monkeypatch.setenv("PINT_TPU_FUSED", "0")
+        m, toas = small_sim
+        with faultinject.nan_sigma():
+            f = LMFitter(toas, m)
+            with pytest.raises(ConvergenceFailure) as ei, \
+                    pytest.warns(UserWarning, match="lambda overflow"):
+                f.fit_toas(maxiter=30)
+        assert ei.value.status is FitStatus.NONFINITE
+
+
+class TestDownhillNoiseHessian:
+    PAR = """
+PSR J1744-TEST
+RAJ 17:44:29.4 1
+DECJ -11:34:54.6 1
+F0 245.4261196 1
+F1 -5.38e-16 1
+PEPOCH 54500
+DM 3.1 0
+EFAC mjd 50000 60000 1.0
+TZRMJD 54500
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+    def test_nonfinite_hessian_fallback(self, monkeypatch):
+        """fitter.py DownhillWLSFitter._fit_noise: a poisoned noise
+        gradient makes the finite-difference Hessian non-finite — the
+        fallback must warn and withhold the uncertainty, never write
+        NaN into the model."""
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        monkeypatch.setenv("PINT_TPU_FUSED", "0")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(self.PAR.strip().splitlines())
+            toas = make_fake_toas_uniform(54000, 55000, 50, m,
+                                          obs="gbt", error_us=1.0,
+                                          add_noise=True, seed=3)
+        m.EFAC1.frozen = False
+        with faultinject.nonfinite_noise_grad():
+            f = DownhillWLSFitter(toas, m)
+            with pytest.warns(UserWarning,
+                              match="Hessian is non-finite"):
+                chi2 = f.fit_toas(maxiter=6, noise_fit_niter=1)
+        assert np.isfinite(chi2)
+        assert m.EFAC1.uncertainty is None
+
+
+# --- clock limits policy end-to-end (satellite) -------------------------------
+
+class TestClockLimits:
+    def test_error_limits_raises_through_clockcorr(self):
+        from pint_tpu.toa import get_TOAs_array
+
+        with faultinject.clock_out_of_range():
+            with pytest.raises(ClockCorrectionOutOfRange) as ei:
+                get_TOAs_array(np.array([53000.0, 53001.0]), obs="gbt",
+                               errors_us=1.0, freqs_mhz=1400.0,
+                               limits="error")
+        assert "last correction at MJD" in str(ei.value)
+
+    def test_warn_limits_clamps_with_warning(self):
+        from pint_tpu.toa import get_TOAs_array
+
+        with faultinject.clock_out_of_range():
+            with pytest.warns(ClockCorrectionWarning,
+                              match="last correction at MJD"):
+                t = get_TOAs_array(np.array([53000.0]), obs="gbt",
+                                   errors_us=1.0, freqs_mhz=1400.0,
+                                   limits="warn")
+        # clamped-to-end-value correction was applied
+        assert any("clkcorr" in fl for fl in t.flags)
+
+
+# --- TOABatch validation policy (tentpole leg 4) ------------------------------
+
+class TestValidationPolicy:
+    def test_raise_on_nan_zero_negative_sigma(self, small_sim):
+        _, toas = small_sim
+        for bad in (np.nan, 0.0, -1.0, np.inf):
+            with faultinject.corrupt_toa_errors(toas, [2], bad):
+                with pytest.raises(InvalidTOAs,
+                                   match="uncertainties"):
+                    toas.to_batch(policy="raise")
+        # restored clean on exit
+        toas.to_batch(policy="raise")
+
+    def test_raise_on_nan_mjd(self, small_sim):
+        _, toas = small_sim
+        with faultinject.corrupt_mjds(toas, [4]):
+            with pytest.raises(InvalidTOAs, match="MJD"):
+                toas.to_batch(policy="raise")
+
+    def test_mask_drops_rows(self, small_sim):
+        _, toas = small_sim
+        n = toas.ntoas
+        with faultinject.corrupt_toa_errors(toas, [2, 5], np.nan):
+            with pytest.warns(ValidationWarning, match="masking"):
+                b = toas.to_batch(policy="mask")
+        assert b.ntoas == n - 2
+        assert np.all(np.isfinite(np.asarray(b.error_us)))
+
+    def test_warn_downweights_explicitly(self, small_sim):
+        _, toas = small_sim
+        with faultinject.corrupt_toa_errors(toas, [2], np.nan):
+            with pytest.warns(ValidationWarning,
+                              match="downweighting"):
+                b = toas.to_batch(policy="warn")
+        err = np.asarray(b.error_us)
+        assert b.ntoas == toas.ntoas
+        assert err[2] == DOWNWEIGHT_ERROR_US
+        assert np.all(np.isfinite(err))
+
+    def test_empty_selection_raises(self, small_sim):
+        _, toas = small_sim
+        empty = toas.select(np.zeros(toas.ntoas, bool))
+        with pytest.raises(InvalidTOAs, match="empty"):
+            empty.to_batch(policy="raise")
+        with pytest.raises(InvalidTOAs, match="empty"):
+            empty.to_batch(policy="mask")
+
+    def test_policy_threaded_through_fitter(self, small_sim,
+                                            monkeypatch):
+        monkeypatch.setenv("PINT_TPU_FUSED", "0")
+        m, toas = small_sim
+        with faultinject.corrupt_toa_errors(toas, [0], 0.0):
+            with pytest.raises(InvalidTOAs):
+                WLSFitter(toas, m, policy="raise")
+            # warn policy: the fit proceeds on the downweighted batch
+            with pytest.warns(ValidationWarning):
+                f = WLSFitter(toas, m, policy="warn")
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                chi2 = f.fit_toas(maxiter=2)
+            assert np.isfinite(chi2)
+
+    def test_bad_policy_rejected(self, small_sim):
+        _, toas = small_sim
+        with pytest.raises(ValueError, match="policy"):
+            toas.to_batch(policy="banana")
+
+
+# --- grid non-finite guard ----------------------------------------------------
+
+class TestGridGuard:
+    def test_nonfinite_grid_points_warned(self, small_sim,
+                                          monkeypatch):
+        from pint_tpu.gridutils import _check_grid_chi2
+
+        with pytest.warns(UserWarning, match="non-finite chi2"):
+            out = _check_grid_chi2(np.array([1.0, np.nan, 3.0]))
+        assert out.shape == (3,)
+        # clean grids pass silently
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            _check_grid_chi2(np.array([1.0, 2.0]))

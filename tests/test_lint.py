@@ -390,6 +390,15 @@ class TestBaseline:
         n_entries = sum(load_baseline(default_baseline_path()).values())
         assert n_entries == meta["current"]
 
+    def test_shipped_baseline_is_empty(self):
+        """ISSUE 3 satellite: the baseline is burned to ZERO — every
+        grandfathered finding is now fixed or carries an inline
+        justified suppression.  New findings must be dealt with at the
+        source, not re-grandfathered (growing this back is a
+        regression)."""
+        assert sum(load_baseline(default_baseline_path()).values()) == 0
+        assert parse_header(default_baseline_path())["current"] == 0
+
 
 # --- the package gate ---------------------------------------------------------
 class TestGate:
