@@ -440,30 +440,7 @@ def _run_in_subprocess(func_name: str, timeout_s: float = 900):
         f"tail: {out.stderr[-300:]}")
 
 
-def _probe_accelerator(timeout_s: float = 300.0):
-    """None if the accelerator backend responds, else a string saying
-    HOW it failed (hang vs crash — they need different debugging).
-    Checked in a subprocess: a wedged tunnel hangs jax.devices()
-    itself (observed 2026-08), which would otherwise hang the whole
-    bench with no output for the driver to record."""
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(len(jax.devices()))"],
-            capture_output=True, text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return (f"jax.devices() did not return within {timeout_s:.0f} s "
-                "in a probe subprocess (wedged tunnel)")
-    if out.returncode != 0:
-        return ("backend probe subprocess failed "
-                f"(rc {out.returncode}); stderr tail: "
-                + out.stderr[-400:])
-    return None
-
-
-def bench_quick():
+def bench_quick(backend_status=None):
     """CPU-only smoke (``--quick``): ONE small WLS fit, no grid — the
     bench-regression canary that needs no accelerator (run by
     tests/test_bench_quick.py).  NGC6440E when the reference datafiles
@@ -502,10 +479,19 @@ def bench_quick():
             f.fit_toas(maxiter=2)
             times.append(time.time() - t0)
     t = min(times)
+    # supervised-acquisition provenance (ISSUE 4): how the backend was
+    # obtained — a wedged-probe run shows up as backend_rung
+    # "cpu_fallback" with attempts > 1 instead of a null metric
+    status = backend_status
+    if status is None:
+        from pint_tpu.runtime import BackendStatus
+        status = BackendStatus(True, "cpu", 0, 0.0, 0.0, ())
+    backend = "cpu_fallback" if status.degraded else jax.default_backend()
     return {
         "metric": "quick_wls_single_fit_cpu",
         "value": round(t, 4), "unit": "s", "vs_baseline": None,
-        "backend": jax.default_backend(), "mode": "quick",
+        "backend": backend, "mode": "quick",
+        **status.as_dict(),
         "design_matrix": f.design_matrix,
         "chi2": round(float(chi2), 4), "dataset": dataset,
         "ntoas": toas.ntoas, "nfit": len(f.fit_params),
@@ -539,25 +525,35 @@ def main(argv=None):
     if args.quick:
         # force the CPU backend BEFORE jax initializes: quick mode must
         # produce a number with no accelerator (and no wedged-tunnel
-        # probe wait)
+        # probe wait) — but the supervised-acquisition chain still runs
+        # (cheap on CPU) so a PINT_TPU_FAULTS=wedged_probe injection
+        # drives the full bounded-retry -> cpu_fallback path from tests
         os.environ["JAX_PLATFORMS"] = "cpu"
-        import pint_tpu  # noqa: F401  (wires the compilation cache)
+        from pint_tpu import runtime  # (wires the compilation cache)
 
-        print(json.dumps(bench_quick()))
+        status = runtime.acquire_backend()
+        log(f"backend acquisition: {status.as_dict()}")
+        print(json.dumps(bench_quick(status)))
         return
+    # BENCH r05 recorded value: null from one unretried wedged 300 s
+    # probe.  The supervisor retries with backoff under a deadline, then
+    # degrades to the CPU backend: slower but REAL — emit it tagged, so
+    # the bench series never goes dark when the accelerator does.
+    from pint_tpu import runtime  # (wires the compilation cache)
+
+    status = runtime.acquire_backend()
     backend_tag = None
-    fail = _probe_accelerator()
-    if fail is not None:
-        # BENCH r05 recorded value: null from a wedged tunnel.  A
-        # CPU-backend number is slower but REAL — emit it tagged, so the
-        # bench series never goes dark when the accelerator does.
-        log("accelerator backend unavailable:", fail)
+    if status.degraded:
+        log("accelerator backend unavailable after "
+            f"{status.attempts} probe attempt(s) "
+            f"({status.wait_s:.1f} s of backoff):")
+        for fail in status.failures:
+            log("  -", fail)
         log("falling back to the CPU backend (backend=cpu_fallback)")
-        os.environ["JAX_PLATFORMS"] = "cpu"
         backend_tag = "cpu_fallback"
     import jax
 
-    import pint_tpu  # noqa: F401  (wires the compilation cache)
+    import pint_tpu  # noqa: F401
 
     # flat->fingerprint cache migration happens in the package wiring
     # (pint_tpu/__init__.py, PINT_TPU_XLA_CACHE path only)
@@ -635,6 +631,9 @@ def main(argv=None):
         # "cpu_fallback" = accelerator probe failed, number is from the
         # CPU backend (real but not comparable to accelerator rounds)
         "backend": backend_tag,
+        # supervised-acquisition provenance (ISSUE 4): probe_attempts /
+        # probe_wait_s / backend_rung from runtime.acquire_backend
+        **status.as_dict(),
         "design_matrix": os.environ.get("PINT_TPU_DESIGN_MATRIX",
                                         "split"),
         "setup_s": round(setup_s, 1),

@@ -91,6 +91,17 @@ _SLOW_TESTS = {
 _PARITY_FILES = {"test_tempo2_parity.py", "test_gls.py"}
 _PARITY_TESTS = {("test_crossbackend.py", "test_cpu_tpu_fit_parity")}
 
+#: the PREEMPT tier (``pytest -m preempt``): the preemption-tolerant
+#: execution layer — checkpoint/resume bit-identity, backend
+#: acquisition, shard retry/requeue, multihost dead-peer detection
+_PREEMPT_FILES = {"test_runtime.py", "test_mcmc_resume.py",
+                  "test_multihost.py"}
+_PREEMPT_TESTS = {
+    ("test_design_split.py", "TestCheckpointResume"),
+    ("test_parallel.py", "TestCheckpointedShardedScan"),
+    ("test_bench_quick.py", "test_wedged_probe"),
+}
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -111,6 +122,12 @@ def pytest_configure(config):
         "faults: fault-injection coverage of the guarded fit engine "
         "(tests/test_faults.py; rides the tier-1 'not slow' smoke "
         "selection — every guard must fire on every run)")
+    config.addinivalue_line(
+        "markers",
+        "preempt: preemption-tolerance coverage (supervised backend "
+        "acquisition, checkpointed chunked scans, shard retry/requeue, "
+        "kill-and-resume bit-identity; rides tier-1 except where the "
+        "containing file is slow-marked)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -143,3 +160,9 @@ def pytest_collection_modifyitems(config, items):
                 fname == f and item.name.startswith(p)
                 for f, p in _PARITY_TESTS):
             item.add_marker(_pytest.mark.parity)
+        if fname in _PREEMPT_FILES or any(
+                fname == f and (item.name.startswith(p) or
+                                (getattr(item, "cls", None) is not None
+                                 and item.cls.__name__ == p))
+                for f, p in _PREEMPT_TESTS):
+            item.add_marker(_pytest.mark.preempt)

@@ -129,6 +129,44 @@ class CorrelatedErrors(FitError):
         )
 
 
+# --- execution / preemption ---------------------------------------------------
+class CheckpointError(PintTpuError):
+    """Base for scan/chain checkpoint problems."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint file failed its integrity check on load: the ``.npz``
+    container is truncated/unreadable, or the stored CRC32 does not match
+    the recomputed checksum of the arrays (see
+    :func:`pint_tpu.runtime.load_checkpoint`).  Raised instead of the
+    numpy/zipfile internals so a resume caller can catch one type and
+    decide to restart from scratch."""
+
+
+class ScanInterrupted(PintTpuError):
+    """A checkpointed chunked scan received SIGTERM/SIGINT.  A final
+    checkpoint was flushed before this was raised (when a checkpoint path
+    was configured), so re-running with ``resume=True`` continues from
+    the last completed chunk bit-identically.
+
+    Attributes: ``checkpoint`` (path or None), ``chunks_done``,
+    ``n_chunks``, ``signum``."""
+
+    def __init__(self, msg="", checkpoint=None, chunks_done=0,
+                 n_chunks=0, signum=None):
+        self.checkpoint = checkpoint
+        self.chunks_done = chunks_done
+        self.n_chunks = n_chunks
+        self.signum = signum
+        super().__init__(msg)
+
+
+class MultihostTimeoutError(PintTpuError):
+    """A multi-host rendezvous (``multihost.init``) or collective barrier
+    did not complete within its deadline — a peer process is likely dead
+    or never joined.  Replaces the indefinite hang."""
+
+
 # --- warnings -----------------------------------------------------------------
 class PintTpuWarning(UserWarning):
     """Base warning class."""

@@ -28,6 +28,15 @@ Two mechanisms, both context-managed and restored on exit:
 Data-level corruptors (:func:`corrupt_toa_errors`, :func:`corrupt_mjds`)
 mutate a ``TOAs`` object in place (and restore it), driving the
 ``TOABatch`` validation policy rather than the in-fit guards.
+
+Execution-layer failpoints (:func:`wedged_probe`,
+:func:`chunk_nonfinite`, :func:`chunk_raise`, :func:`sigterm_midscan`,
+:func:`corrupt_checkpoint`) drive the preemption-tolerant runtime
+(:mod:`pint_tpu.runtime`): backend acquisition retries, scan-chunk
+retry/requeue, checkpoint integrity, and the SIGTERM flush.  A subset
+is additionally activatable across a process boundary with
+``PINT_TPU_FAULTS=<name>[,<name>...]`` (process-lifetime) so subprocess
+harnesses like the bench can be fault-injected from their parent.
 """
 
 from __future__ import annotations
@@ -39,7 +48,9 @@ import numpy as np
 
 __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "degenerate_column", "clock_out_of_range",
-           "nonfinite_noise_grad", "corrupt_toa_errors", "corrupt_mjds"]
+           "nonfinite_noise_grad", "corrupt_toa_errors", "corrupt_mjds",
+           "wedged_probe", "chunk_nonfinite", "chunk_raise",
+           "sigterm_midscan", "corrupt_checkpoint"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -195,6 +206,150 @@ def nonfinite_noise_grad() -> Iterator[None]:
 
     with _registered("noise_grad", factory):
         yield
+
+
+# --- execution-layer failpoints (drive pint_tpu.runtime, ISSUE 4) -------------
+
+def _wedged_probe_factory(fn):
+    """Every backend probe attempt reports a wedge — the BENCH r05
+    failure mode (a tunnel whose ``jax.devices()`` never returns),
+    simulated instantly so the retry/backoff/degradation chain is
+    drivable without a real 300 s hang."""
+    def wedged(timeout_s=300.0, **kw):
+        return (f"jax.devices() did not return within {timeout_s:.0f} s "
+                "in a probe subprocess (wedged_probe failpoint)")
+    return wedged
+
+
+@contextlib.contextmanager
+def wedged_probe() -> Iterator[None]:
+    """Failpoint ``"wedged_probe"``: :func:`pint_tpu.runtime.
+    acquire_backend`'s probe reports a hang on every attempt, so the
+    supervisor must exhaust its bounded retries and degrade to the
+    ``cpu_fallback`` rung.  Also activatable across a process boundary
+    with ``PINT_TPU_FAULTS=wedged_probe`` (the bench-subprocess leg)."""
+    with _registered("wedged_probe", _wedged_probe_factory):
+        yield
+
+
+@contextlib.contextmanager
+def chunk_nonfinite(chunks: Sequence[int] = (0,),
+                    times: int = 1) -> Iterator[None]:
+    """Failpoint ``"chunk_nonfinite"``: the scan chunks in ``chunks``
+    return NaN-poisoned values for their first ``times`` dispatches —
+    the transient-garbage failure a flaky device produces.  The engine
+    must retry (ChunkStatus.RETRIED) and converge to the clean values."""
+    hit = set(int(c) for c in chunks)
+    counts: dict = {}
+
+    def factory(fn):
+        def poisoned(ci, lo, hi):
+            out = np.asarray(fn(ci, lo, hi), np.float64)
+            if ci in hit and counts.get(ci, 0) < times:
+                counts[ci] = counts.get(ci, 0) + 1
+                out = out.copy()
+                out[:] = np.nan
+            return out
+        return poisoned
+
+    with _registered("chunk_nonfinite", factory):
+        yield
+
+
+@contextlib.contextmanager
+def chunk_raise(chunks: Sequence[int] = (0,),
+                times: int = 1) -> Iterator[None]:
+    """Failpoint ``"chunk_raise"``: the scan chunks in ``chunks`` raise
+    from their first ``times`` dispatches — the crashed-dispatch failure
+    mode (device OOM, wedged transfer).  ``times > max_retries`` drives
+    the requeue-to-fallback path (ChunkStatus.REROUTED)."""
+    hit = set(int(c) for c in chunks)
+    counts: dict = {}
+
+    def factory(fn):
+        def crashing(ci, lo, hi):
+            if ci in hit and counts.get(ci, 0) < times:
+                counts[ci] = counts.get(ci, 0) + 1
+                raise RuntimeError(
+                    f"injected dispatch failure on chunk {ci} "
+                    "(chunk_raise failpoint)")
+            return fn(ci, lo, hi)
+        return crashing
+
+    with _registered("chunk_raise", factory):
+        yield
+
+
+@contextlib.contextmanager
+def sigterm_midscan(after_chunk: int = 0) -> Iterator[None]:
+    """Failpoint ``"sigterm_midscan"``: deliver a real SIGTERM to this
+    process immediately after scan chunk ``after_chunk`` completes — the
+    preemption-notice shape (the engine's handler flushes a final
+    checkpoint and raises ScanInterrupted at the chunk boundary)."""
+    import os
+    import signal as _signal
+
+    def factory(fn):
+        def fire(ci):
+            fn(ci)
+            if ci == after_chunk:
+                os.kill(os.getpid(), _signal.SIGTERM)
+        return fire
+
+    with _registered("sigterm_midscan", factory):
+        yield
+
+
+@contextlib.contextmanager
+def corrupt_checkpoint(path: str, mode: str = "truncate") -> Iterator[None]:
+    """Corrupt the checkpoint file at ``path`` in place (restored on
+    exit): ``"truncate"`` cuts the file in half (a crash mid-write on a
+    non-atomic filesystem / partial copy), ``"flip"`` flips one byte in
+    the middle (bit rot — the container may still unzip, so only the
+    CRC32 catches it).  Loading must raise CheckpointCorruptError."""
+    with open(path, "rb") as fh:
+        orig = fh.read()
+    if mode == "truncate":
+        bad = orig[: max(1, len(orig) // 2)]
+    elif mode == "flip":
+        pos = len(orig) // 2
+        bad = orig[:pos] + bytes([orig[pos] ^ 0xFF]) + orig[pos + 1:]
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "wb") as fh:
+        fh.write(bad)
+    try:
+        yield
+    finally:
+        with open(path, "wb") as fh:
+            fh.write(orig)
+
+
+#: failpoints activatable across a process boundary via the
+#: PINT_TPU_FAULTS env var (comma-separated names; process-lifetime,
+#: no context manager to exit) — the bench/CLI-subprocess test leg
+_ENV_FACTORIES = {
+    "wedged_probe": _wedged_probe_factory,
+}
+
+
+def _activate_from_env() -> None:
+    import os
+
+    for name in filter(None, (s.strip() for s in
+                              os.environ.get("PINT_TPU_FAULTS",
+                                             "").split(","))):
+        factory = _ENV_FACTORIES.get(name)
+        if factory is None:
+            import warnings
+
+            warnings.warn(f"PINT_TPU_FAULTS names unknown or "
+                          f"non-env-activatable failpoint {name!r}")
+        else:
+            _active[name] = factory
+
+
+_activate_from_env()
 
 
 # --- data-level corruptors (drive the TOABatch validation policy) -------------

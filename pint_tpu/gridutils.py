@@ -121,40 +121,145 @@ def build_grid_fit_fn(model: TimingModel, batch, fit_params: Sequence[str],
     return fit_one
 
 
+def _grid_fit_program(fitter: Fitter, grid_values: Dict[str, np.ndarray],
+                      names, maxiter: int, kernel, form: str):
+    """Fetch/compile the cached grid fit program on the fitter: a fresh
+    jit wrapper per call would retrace the whole grid program every
+    time.  ``form="vmap"`` is the one-program whole-grid path;
+    ``form="point"`` the unvmapped single-point fit (the eager requeue
+    path of checkpointed scans)."""
+    model = fitter.model
+    r = fitter.resids
+    key = (form, tuple(sorted(grid_values)), tuple(names), maxiter,
+           kernel, getattr(fitter, "design_matrix", None))
+    cache = getattr(fitter, "_grid_fit_cache", None)
+    if cache is None:
+        cache = fitter._grid_fit_cache = {}
+    fit = cache.get(key)
+    if fit is None:
+        fit_one = build_grid_fit_fn(
+            model, r.batch, names, fitter.track_mode, maxiter=maxiter,
+            kernel=kernel,
+            design_matrix=getattr(fitter, "design_matrix", None))
+        if form == "point":
+            fit = cache[key] = jax.jit(lambda pp: fit_one(pp))
+        else:
+            axes = grid_in_axes(r.pdict, list(grid_values))
+            # per-point cached columns (computed inside fit_one, hoisted
+            # out of its iteration loop) — see build_grid_fit_fn for why
+            # they are not shared across points
+            fit = cache[key] = jax.jit(
+                jax.vmap(lambda pp: fit_one(pp), in_axes=(axes,)))
+    return fit
+
+
+def _slice_stacked(stacked: dict, grid_names: Sequence[str], lo: int,
+                   hi: int, width: Optional[int]) -> dict:
+    """The [lo:hi) slice of a stacked grid pytree, padded to ``width``
+    points by repeating the last row (pad results are computed and
+    discarded, so every chunk dispatch reuses ONE compiled shape).
+    ``width=None`` with ``hi == lo + 1`` yields scalar grid leaves —
+    the unvmapped point form."""
+    gset = set(grid_names)
+    delta = {}
+    for k, v in stacked["delta"].items():
+        if k not in gset:
+            delta[k] = v
+            continue
+        arr = jnp.asarray(v)
+        if width is None:
+            delta[k] = arr[lo]
+            continue
+        sl = arr[lo:hi]
+        if hi - lo < width:
+            sl = jnp.concatenate(
+                [sl, jnp.repeat(sl[-1:], width - (hi - lo), axis=0)])
+        delta[k] = sl
+    return {"const": stacked["const"], "delta": delta,
+            "mask": stacked["mask"]}
+
+
+def _eager_grid_chisq(fitter: Fitter, grid_values: Dict[str, np.ndarray],
+                      maxiter: int = 2, kernel=None) -> np.ndarray:
+    """The requeue path of checkpointed scans: chi2 of each grid point
+    from the EAGER single-device fit — one unvmapped jitted fit per
+    point, no vmap, no sharding — slower but independent of whatever
+    poisoned the batched dispatch."""
+    names = [n for n in fitter.fit_params if n not in grid_values]
+    pfit = _grid_fit_program(fitter, grid_values, names, maxiter, kernel,
+                             "point")
+    stacked = stack_grid_pdict(fitter.model, fitter.resids.pdict,
+                               grid_values)
+    gnames = list(grid_values)
+    g = len(np.asarray(next(iter(grid_values.values()))))
+    out = np.empty(g, np.float64)
+    for i in range(g):
+        chi2, _ = pfit(_slice_stacked(stacked, gnames, i, i + 1, None))
+        out[i] = float(chi2)
+    return out
+
+
 def grid_chisq_flat(fitter: Fitter, grid_values: Dict[str, np.ndarray],
-                    maxiter: int = 2, kernel=None) -> np.ndarray:
+                    maxiter: int = 2, kernel=None, *,
+                    chunk_size: Optional[int] = None,
+                    checkpoint: Optional[str] = None,
+                    resume: bool = False, max_retries: int = 2,
+                    checkpoint_every: int = 1,
+                    return_summary: bool = False) -> np.ndarray:
     """chi2 at each of G grid points (all grid arrays shape (G,)); the
     non-grid free parameters are re-fit at every point.  ``kernel``
-    forces a specific WLS solve kernel (default: backend-matched)."""
+    forces a specific WLS solve kernel (default: backend-matched).
+
+    Preemption tolerance (ISSUE 4): with ``chunk_size``/``checkpoint``
+    set, the grid executes in chunks through
+    :func:`pint_tpu.runtime.run_checkpointed_scan` — CRC32-verified
+    atomic shard checkpoints after every ``checkpoint_every`` chunks, a
+    SIGTERM/SIGINT mid-scan flushes a final checkpoint and raises
+    ``ScanInterrupted``, and ``resume=True`` skips completed chunks
+    bit-identically.  A chunk that raises or returns non-finite chi2 is
+    retried ``max_retries`` times, then requeued onto the eager
+    single-device path.  ``return_summary=True`` returns
+    ``(chi2, ScanSummary)``."""
     model = fitter.model
     r = fitter.resids
     names = [n for n in fitter.fit_params if n not in grid_values]
     for n in grid_values:
         if not model[n].frozen:
             raise ValueError(f"grid parameter {n} must be frozen")
-    p = r.pdict
-    # cache the compiled vmapped fit on the fitter: a fresh jit wrapper
-    # per call would retrace the whole grid program every time
-    key = (tuple(sorted(grid_values)), tuple(names), maxiter, kernel,
-           getattr(fitter, "design_matrix", None))
-    cache = getattr(fitter, "_grid_fit_cache", None)
-    if cache is None:
-        cache = fitter._grid_fit_cache = {}
-    vfit = cache.get(key)
-    if vfit is None:
-        fit_one = build_grid_fit_fn(
-            model, r.batch, names, fitter.track_mode, maxiter=maxiter,
-            kernel=kernel,
-            design_matrix=getattr(fitter, "design_matrix", None))
-        axes = grid_in_axes(p, list(grid_values))
-        # per-point cached columns (computed inside fit_one, hoisted out
-        # of its iteration loop) — see build_grid_fit_fn for why they
-        # are not shared across points
-        vfit = cache[key] = jax.jit(
-            jax.vmap(lambda pp: fit_one(pp), in_axes=(axes,)))
-    stacked = stack_grid_pdict(model, p, grid_values)
-    chi2, _ = vfit(stacked)
-    return _check_grid_chi2(np.asarray(chi2))
+    vfit = _grid_fit_program(fitter, grid_values, names, maxiter, kernel,
+                             "vmap")
+    stacked = stack_grid_pdict(model, r.pdict, grid_values)
+    if chunk_size is None and checkpoint is None and not return_summary:
+        # the historical one-program whole-grid fast path
+        chi2, _ = vfit(stacked)
+        return _check_grid_chi2(np.asarray(chi2))
+
+    from pint_tpu import runtime
+
+    sizes = {n: len(np.asarray(v)) for n, v in grid_values.items()}
+    if len(set(sizes.values())) != 1:
+        raise ValueError(f"grid arrays differ in length: {sizes}")
+    g = next(iter(sizes.values()))
+    cs = int(chunk_size) if chunk_size else g
+    gnames = list(grid_values)
+
+    def run_chunk(ci, lo, hi):
+        chi2, _ = vfit(_slice_stacked(stacked, gnames, lo, hi, cs))
+        return np.asarray(chi2)[: hi - lo]
+
+    def fallback(ci, lo, hi):
+        return _eager_grid_chisq(
+            fitter, {k: np.asarray(v)[lo:hi]
+                     for k, v in grid_values.items()},
+            maxiter=maxiter, kernel=kernel)
+
+    sig = runtime.scan_signature("grid", grid_values, names, maxiter, cs)
+    chi2, summary = runtime.run_checkpointed_scan(
+        g, run_chunk, chunk_size=cs, fallback=fallback,
+        checkpoint=checkpoint, resume=resume, max_retries=max_retries,
+        checkpoint_every=checkpoint_every, signature=sig)
+    chi2 = _check_grid_chi2(chi2)
+    return (chi2, summary) if return_summary else chi2
 
 
 def _check_grid_chi2(chi2: np.ndarray) -> np.ndarray:

@@ -14,9 +14,17 @@ import logging as _logging
 import warnings as _warnings
 from typing import Optional
 
-__all__ = ["setup", "log", "DedupFilter"]
+__all__ = ["setup", "log", "child", "DedupFilter"]
 
 log = _logging.getLogger("pint_tpu")
+
+
+def child(name: str) -> _logging.Logger:
+    """A namespaced child of the package logger (``pint_tpu.<name>``):
+    subsystem modules (``runtime``, ``multihost``) log through it so
+    records carry their origin while riding the one configured handler
+    and its :class:`DedupFilter`."""
+    return log.getChild(name)
 
 
 class DedupFilter(_logging.Filter):

@@ -64,6 +64,12 @@ def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
     from (seed, nsteps) and indexed by absolute step, so a killed and
     resumed run reproduces the uninterrupted chain EXACTLY (bitwise on
     a given backend) — asserted by tests/test_mcmc_resume.py.
+
+    Checkpoints carry a CRC32 and are verified on load
+    (:func:`pint_tpu.runtime.load_checkpoint`): a truncated or
+    bit-flipped file raises a typed
+    :class:`~pint_tpu.exceptions.CheckpointCorruptError` instead of
+    propagating a numpy unpickling error (ISSUE 4 satellite).
     """
     import os
 
@@ -121,33 +127,38 @@ def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
     truncated = False
     x, lnp = x0, None
     if resume and checkpoint and os.path.exists(checkpoint):
-        with np.load(checkpoint) as f:
-            if int(f["seed"]) != seed or f["chain"].shape[1:] != (nw, nd):
-                raise ValueError(
-                    f"checkpoint {checkpoint} does not match this "
-                    "sampler configuration (seed/walkers/ndim)")
-            start = min(int(f["steps_done"]), nsteps)
-            truncated = int(f["steps_done"]) > nsteps
-            chains = [f["chain"][:start]]
-            lnplist = [f["lnpost"][:start]]
-            nacc_total = float(f["nacc"])
-            x = jnp.asarray(f["x_last"])
-            lnp = jnp.asarray(f["lnp_last"])
+        # CRC32-verified load: truncation/corruption raises a typed
+        # CheckpointCorruptError, not a numpy/zipfile internal
+        from pint_tpu.runtime import load_checkpoint
+
+        f = load_checkpoint(checkpoint)
+        if int(f["seed"]) != seed or f["chain"].shape[1:] != (nw, nd):
+            raise ValueError(
+                f"checkpoint {checkpoint} does not match this "
+                "sampler configuration (seed/walkers/ndim)")
+        start = min(int(f["steps_done"]), nsteps)
+        truncated = int(f["steps_done"]) > nsteps
+        chains = [f["chain"][:start]]
+        lnplist = [f["lnpost"][:start]]
+        nacc_total = float(f["nacc"])
+        x = jnp.asarray(f["x_last"])
+        lnp = jnp.asarray(f["lnp_last"])
     if lnp is None:
         lnp = vln(x0)   # lazily: a resumed run restores it instead
 
     def _save():
         if not checkpoint:
             return
-        tmp = checkpoint + f".tmp{os.getpid()}.npz"
-        np.savez_compressed(
-            tmp, chain=np.concatenate(chains) if chains else
+        from pint_tpu.runtime import write_checkpoint
+
+        write_checkpoint(checkpoint, {
+            "chain": np.concatenate(chains) if chains else
             np.zeros((0, nw, nd)),
-            lnpost=np.concatenate(lnplist) if lnplist else
+            "lnpost": np.concatenate(lnplist) if lnplist else
             np.zeros((0, nw)),
-            nacc=nacc_total, steps_done=k, seed=seed,
-            x_last=np.asarray(x), lnp_last=np.asarray(lnp))
-        os.replace(tmp, checkpoint)
+            "nacc": nacc_total, "steps_done": k, "seed": seed,
+            "x_last": np.asarray(x), "lnp_last": np.asarray(lnp),
+        }, compressed=True)
 
     k = start
     chunk = checkpoint_every if (checkpoint and checkpoint_every) \

@@ -38,11 +38,12 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["init", "global_mesh", "multihost_grid_chisq"]
+__all__ = ["init", "global_mesh", "barrier", "multihost_grid_chisq"]
 
 
 def init(coordinator: str, num_processes: int, process_id: int,
-         local_devices: Optional[int] = None, platform: str = "cpu"):
+         local_devices: Optional[int] = None, platform: str = "cpu",
+         timeout_s: Optional[float] = None):
     """Initialize the distributed runtime for this process.  MUST run
     before anything touches a jax backend (same constraint as
     `__graft_entry__.dryrun_multichip`).
@@ -50,7 +51,18 @@ def init(coordinator: str, num_processes: int, process_id: int,
     ``local_devices``: on CPU, the number of virtual devices this process
     exposes (the "ICI island" size per host); on real TPU hosts the
     hardware decides and this is ignored.
+
+    ``timeout_s`` bounds the coordinator rendezvous (default
+    ``PINT_TPU_MH_INIT_TIMEOUT_S`` or 300 s): a peer that died before
+    joining, or an unreachable coordinator, raises an actionable
+    :class:`~pint_tpu.exceptions.MultihostTimeoutError` instead of
+    hanging this process forever (ISSUE 4 multihost hardening).
     """
+    from pint_tpu.exceptions import MultihostTimeoutError
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("PINT_TPU_MH_INIT_TIMEOUT_S",
+                                         300.0))
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         if local_devices:
@@ -68,40 +80,105 @@ def init(coordinator: str, num_processes: int, process_id: int,
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+        try:
+            # without a CPU collectives implementation the CPU client is
+            # built single-node and every cross-process dispatch dies
+            # with "Multiprocess computations aren't implemented on the
+            # CPU backend" — Gloo over TCP is the localhost DCN stand-in
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # pragma: no cover - jax without the flag
+            pass
+    from pint_tpu import runtime
+
+    def _initialize():
+        # the C++ deadline is kept LONGER than ours: on expiry this
+        # jax's coordination client LOG(FATAL)s the whole process
+        # (client.h "Terminating process ... DEADLINE_EXCEEDED", a
+        # SIGABRT) instead of raising — so the catchable Python-level
+        # deadline below must win the race, raise our typed error, and
+        # let the caller exit before the C++ fatal ever fires
+        kw = ({"initialization_timeout": max(1, int(2 * timeout_s))}
+              if timeout_s else {})
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes, process_id=process_id,
+                **kw)
+        except TypeError:  # pragma: no cover - jax without the kwarg
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+
+    try:
+        runtime.call_with_deadline(
+            _initialize, timeout_s,
+            f"distributed init of process {process_id}/{num_processes} "
+            f"(coordinator {coordinator})")
+    except MultihostTimeoutError:
+        raise
+    except Exception as e:
+        msg = str(e).lower()
+        if ("deadline" in msg or "timeout" in msg or "timed out" in msg
+                or "unavailable" in msg):
+            raise MultihostTimeoutError(
+                f"distributed init of process {process_id}/"
+                f"{num_processes} did not complete within "
+                f"{timeout_s:.0f} s (coordinator {coordinator}): {e} — "
+                "a peer process likely died before the rendezvous or "
+                "the coordinator address is unreachable; check every "
+                "worker's logs and restart the ensemble") from e
+        raise
 
 
-def global_mesh():
+def global_mesh(timeout_s: Optional[float] = None):
     """("batch", "toa") mesh over every device of every process: the
     batch axis spans processes (DCN), the toa axis each process's local
-    devices (ICI)."""
+    devices (ICI).  ``timeout_s`` bounds the global device-list
+    formation (which blocks on every process having initialized)."""
     import jax
     from jax.sharding import Mesh
 
+    from pint_tpu import runtime
+
+    devs = runtime.call_with_deadline(
+        jax.devices, timeout_s, "multihost global device enumeration")
     nproc = jax.process_count()
     nlocal = jax.local_device_count()
-    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
     arr = np.array(devs).reshape(nproc, nlocal)
     return Mesh(arr, ("batch", "toa"))
 
 
-def multihost_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
-                         mesh=None, maxiter: int = 2) -> np.ndarray:
-    """chi2 over a flat grid, grid points sharded across PROCESSES and
-    TOAs across each process's local devices — the multi-host analogue of
-    `pint_tpu.parallel.sharded_grid_chisq` (same inner shard_map program,
-    same psum'd thresholded-eigh normal equations).  Every process passes
-    the SAME full ``grid_values``; the full chi2 vector is returned on
-    every process (allgathered over DCN)."""
+def barrier(name: str = "pint_tpu_mh_barrier",
+            timeout_s: Optional[float] = None) -> None:
+    """A cross-process barrier with a deadline: every process must call
+    this with the same ``name``.  A dead peer raises an actionable
+    :class:`~pint_tpu.exceptions.MultihostTimeoutError` after
+    ``timeout_s`` (default ``PINT_TPU_MH_BARRIER_TIMEOUT_S``, unset =
+    no deadline) instead of blocking this process indefinitely."""
+    from jax.experimental import multihost_utils
+
+    from pint_tpu import runtime
+
+    if timeout_s is None:
+        env = os.environ.get("PINT_TPU_MH_BARRIER_TIMEOUT_S")
+        timeout_s = float(env) if env else None
+    runtime.call_with_deadline(
+        lambda: multihost_utils.sync_global_devices(name), timeout_s,
+        f"multihost barrier {name!r}")
+
+
+def _multihost_dispatch(fitter, grid_values: Dict[str, np.ndarray],
+                        mesh, maxiter: int) -> np.ndarray:
+    """One whole-grid multihost dispatch: the shard_map fit over the
+    global mesh, host-local slices in, allgathered chi2 out."""
     import jax
     from jax.experimental import multihost_utils
     from jax.sharding import PartitionSpec as P
 
     from pint_tpu.parallel import prep_sharded_grid
 
-    mesh = mesh or global_mesh()
     nproc = mesh.devices.shape[0]
     fit, stacked, batch, g = prep_sharded_grid(
         fitter, grid_values, mesh, nproc, maxiter, "multihost")
@@ -135,3 +212,74 @@ def multihost_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
         chi2_g, mesh, P("batch"))
     full = multihost_utils.process_allgather(np.asarray(chi2_local))
     return np.asarray(full).reshape(g)
+
+
+def multihost_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
+                         mesh=None, maxiter: int = 2, *,
+                         timeout_s: Optional[float] = None,
+                         chunk_size: Optional[int] = None,
+                         checkpoint: Optional[str] = None,
+                         resume: bool = False, max_retries: int = 2,
+                         checkpoint_every: int = 1,
+                         return_summary: bool = False) -> np.ndarray:
+    """chi2 over a flat grid, grid points sharded across PROCESSES and
+    TOAs across each process's local devices — the multi-host analogue of
+    `pint_tpu.parallel.sharded_grid_chisq` (same inner shard_map program,
+    same psum'd thresholded-eigh normal equations).  Every process passes
+    the SAME full ``grid_values``; the full chi2 vector is returned on
+    every process (allgathered over DCN).
+
+    Hardening (ISSUE 4): ``timeout_s`` bounds the entry barrier, so a
+    dead peer raises ``MultihostTimeoutError`` instead of hanging the
+    collective.  ``chunk_size``/``checkpoint``/``resume`` execute the
+    grid in chunks through ``runtime.run_checkpointed_scan`` — every
+    process runs the identical chunk sequence in SPMD lockstep, process
+    0 alone writes the CRC32-verified checkpoints, every process reads
+    them on resume (the checkpoint path must be on a filesystem all
+    hosts share).  The fallback requeue path is the eager single-device
+    fit, computed REPLICATED on every process (no collectives, so a
+    poisoned mesh cannot poison the requeue)."""
+    import jax
+
+    mesh = mesh or global_mesh(timeout_s=timeout_s)
+    if timeout_s:
+        barrier("multihost_grid_chisq_entry", timeout_s=timeout_s)
+    if chunk_size is None and checkpoint is None and not return_summary:
+        return _multihost_dispatch(fitter, grid_values, mesh, maxiter)
+
+    from pint_tpu import runtime
+    from pint_tpu.gridutils import _eager_grid_chisq
+    from pint_tpu.parallel import _chunk_values
+
+    nproc = mesh.devices.shape[0]
+    if not grid_values:
+        raise ValueError("grid_values is empty")
+    gvals = {k: np.asarray(v, np.float64) for k, v in grid_values.items()}
+    sizes = {n: len(v) for n, v in gvals.items()}
+    if len(set(sizes.values())) != 1:
+        raise ValueError(f"grid arrays differ in length: {sizes}")
+    g = next(iter(sizes.values()))
+    cs = int(chunk_size) if chunk_size else g
+    if cs % nproc:
+        raise ValueError(f"chunk_size {cs} does not split over {nproc} "
+                         "processes")
+
+    def run_chunk(ci, lo, hi):
+        vals = _chunk_values(gvals, lo, hi, cs)
+        return _multihost_dispatch(fitter, vals, mesh, maxiter)[: hi - lo]
+
+    def fallback(ci, lo, hi):
+        # replicated on every process: identical inputs -> identical
+        # results, keeping the SPMD chunk sequence in lockstep
+        return _eager_grid_chisq(
+            fitter, {k: v[lo:hi] for k, v in gvals.items()},
+            maxiter=maxiter)
+
+    names = [n for n in fitter.fit_params if n not in gvals]
+    sig = runtime.scan_signature("multihost", gvals, names, maxiter, cs)
+    chi2, summary = runtime.run_checkpointed_scan(
+        g, run_chunk, chunk_size=cs, fallback=fallback,
+        checkpoint=checkpoint, resume=resume, max_retries=max_retries,
+        checkpoint_every=checkpoint_every, signature=sig,
+        write_checkpoints=jax.process_index() == 0)
+    return (chi2, summary) if return_summary else chi2

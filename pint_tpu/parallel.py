@@ -255,18 +255,85 @@ def prep_sharded_grid(fitter, grid_values: Dict[str, np.ndarray],
     return fit, stacked, batch, g
 
 
+def _chunk_values(gvals: Dict[str, np.ndarray], lo: int, hi: int,
+                  width: int) -> Dict[str, np.ndarray]:
+    """The [lo:hi) slice of every grid array, padded to ``width`` points
+    by repeating the last value (pad results computed and discarded, so
+    every chunk reuses one compiled shard_map shape)."""
+    out = {}
+    for k, v in gvals.items():
+        sl = v[lo:hi]
+        if hi - lo < width:
+            sl = np.concatenate([sl, np.repeat(sl[-1:], width - (hi - lo))])
+        out[k] = sl
+    return out
+
+
 def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
                        mesh: Optional[Mesh] = None,
-                       maxiter: int = 2) -> np.ndarray:
+                       maxiter: int = 2, *,
+                       chunk_size: Optional[int] = None,
+                       checkpoint: Optional[str] = None,
+                       resume: bool = False, max_retries: int = 2,
+                       checkpoint_every: int = 1,
+                       return_summary: bool = False) -> np.ndarray:
     """chi2 over a flat grid, sharded over the mesh: the distributed
-    replacement for the reference's ProcessPoolExecutor grid."""
-    from pint_tpu.gridutils import _check_grid_chi2
+    replacement for the reference's ProcessPoolExecutor grid.
+
+    Preemption tolerance (ISSUE 4): ``chunk_size``/``checkpoint``/
+    ``resume`` execute the grid in chunks through
+    :func:`pint_tpu.runtime.run_checkpointed_scan` (CRC32-verified
+    atomic checkpoints, SIGTERM flush, resume skipping completed chunks
+    bit-identically).  ``chunk_size`` must split over the mesh's batch
+    axis.  A chunk whose sharded dispatch raises or returns non-finite
+    chi2 is retried, then requeued onto the EAGER SINGLE-DEVICE path
+    (``gridutils._eager_grid_chisq`` — independent of the mesh and its
+    collectives).  ``return_summary=True`` returns
+    ``(chi2, ScanSummary)``."""
+    from pint_tpu.gridutils import _check_grid_chi2, _eager_grid_chisq
 
     mesh = mesh or make_mesh()
-    fit, stacked, batch, _ = prep_sharded_grid(
-        fitter, grid_values, mesh, mesh.devices.shape[0], maxiter,
-        "sharded")
-    chi2, _ = fit(stacked, batch)
-    # same host-boundary non-finite guard as the single-device grid:
-    # the sharded program cannot report a poisoned point from in-graph
-    return _check_grid_chi2(np.asarray(chi2))
+    nb = mesh.devices.shape[0]
+    if chunk_size is None and checkpoint is None and not return_summary:
+        # the historical one-dispatch whole-grid fast path
+        fit, stacked, batch, _ = prep_sharded_grid(
+            fitter, grid_values, mesh, nb, maxiter, "sharded")
+        chi2, _ = fit(stacked, batch)
+        # same host-boundary non-finite guard as the single-device grid:
+        # the sharded program cannot report a poisoned point in-graph
+        return _check_grid_chi2(np.asarray(chi2))
+
+    from pint_tpu import runtime
+
+    if not grid_values:
+        raise ValueError("grid_values is empty")
+    gvals = {k: np.asarray(v, np.float64) for k, v in grid_values.items()}
+    sizes = {n: len(v) for n, v in gvals.items()}
+    if len(set(sizes.values())) != 1:
+        raise ValueError(f"grid arrays differ in length: {sizes}")
+    g = next(iter(sizes.values()))
+    cs = int(chunk_size) if chunk_size else g
+    if cs % nb:
+        raise ValueError(f"chunk_size {cs} does not split over {nb} "
+                         "batch-axis shards")
+
+    def run_chunk(ci, lo, hi):
+        fit, stacked, batch, _ = prep_sharded_grid(
+            fitter, _chunk_values(gvals, lo, hi, cs), mesh, nb, maxiter,
+            "sharded")
+        chi2, _ = fit(stacked, batch)
+        return np.asarray(chi2)[: hi - lo]
+
+    def fallback(ci, lo, hi):
+        return _eager_grid_chisq(
+            fitter, {k: v[lo:hi] for k, v in gvals.items()},
+            maxiter=maxiter)
+
+    names = [n for n in fitter.fit_params if n not in gvals]
+    sig = runtime.scan_signature("sharded", gvals, names, maxiter, cs)
+    chi2, summary = runtime.run_checkpointed_scan(
+        g, run_chunk, chunk_size=cs, fallback=fallback,
+        checkpoint=checkpoint, resume=resume, max_retries=max_retries,
+        checkpoint_every=checkpoint_every, signature=sig)
+    chi2 = _check_grid_chi2(chi2)
+    return (chi2, summary) if return_summary else chi2

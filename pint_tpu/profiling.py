@@ -18,6 +18,16 @@ and one-time compilation.  This module is that harness:
   test can assert "one fused fit = N dispatches" and catch a stray
   ``np.asarray`` (one hidden transfer = +0.1 s over the tunnel).
 
+Preemption-tolerant runtime counters (see :mod:`pint_tpu.runtime`):
+``runtime.probe_attempt``/``runtime.probe_failure``/
+``runtime.backend_fallback`` track supervised backend acquisition;
+``runtime.chunk_retry``/``runtime.chunk_reroute``/
+``runtime.chunk_failed``/``runtime.chunks_resumed``/
+``runtime.checkpoint_write`` the checkpointed chunked scans; and
+``runtime.deadline_expired`` multihost barrier/init deadlines — so a
+scan that silently limped through retries shows up in the dispatch
+table even when its final chi2 looks fine.
+
 Split design-matrix names (see ``fitter._make_assembly``): stage/counter
 ``assemble.linear_refresh`` marks a recomputation of the cached
 linear-block columns, counter ``assemble.linear_cached`` a cache hit,

@@ -1,0 +1,570 @@
+"""Preemption-tolerant execution supervisor: the out-of-graph survival
+layer matching the in-graph guarded fit engine (``fitter.FitStatus`` and
+the fused->eager->LM degradation chain).
+
+Real PTA pipelines run hours-long noise/grid/ensemble jobs on
+preemptible accelerators (PINT noise-parameter MLE, arXiv:2405.01977;
+Vela.jl's long Bayesian runs, arXiv:2412.15858).  On this stack the
+observed failure modes are *out-of-graph*: a wedged tunnel hangs
+``jax.devices()`` itself (BENCH r05 recorded a ``null`` headline metric
+from one unretried 300 s probe), and a grid scan that dies at 95% loses
+everything because only ``mcmc.ensemble_sample`` could resume.  This
+module closes both holes:
+
+* :func:`acquire_backend` — supervised backend acquisition: bounded
+  probe retries with exponential backoff and an overall deadline, then a
+  degradation to the CPU backend (``cpu_fallback``), returning a
+  :class:`BackendStatus` provenance record (attempts, waits, winning
+  rung) instead of hanging or silently nulling.  The probe rides the
+  ``wedged_probe`` failpoint (:mod:`pint_tpu.faultinject`).
+* :func:`write_checkpoint` / :func:`load_checkpoint` — atomic,
+  CRC32-checksummed ``.npz`` checkpoints.  The same atomic-rename
+  discipline ``mcmc.py`` always used, now *verified*: a truncated or
+  bit-flipped file raises a typed
+  :class:`~pint_tpu.exceptions.CheckpointCorruptError` on load instead
+  of propagating numpy/zipfile internals.
+* :func:`run_checkpointed_scan` — the chunked scan engine behind the
+  ``checkpoint=``/``resume=`` knobs of ``gridutils.grid_chisq_flat``,
+  ``parallel.sharded_grid_chisq`` and
+  ``multihost.multihost_grid_chisq``: executes a scan in chunks, writes
+  a shard checkpoint after each, installs a SIGTERM/SIGINT handler that
+  flushes a final checkpoint before raising
+  :class:`~pint_tpu.exceptions.ScanInterrupted`, and on resume skips
+  completed chunks bit-identically to an uninterrupted run.  A chunk
+  whose values come back non-finite or whose dispatch raises is retried
+  up to N times, then requeued onto the caller-supplied fallback path
+  (the eager single-device fit); per-chunk :class:`ChunkStatus`
+  aggregates into a :class:`ScanSummary` alongside the fit engine's
+  ``FitSummary``.
+
+This module is deliberately import-light (no jax at module level):
+``bench.py`` must call :func:`acquire_backend` *before* a backend
+initializes, and the degradation must be able to redirect
+``JAX_PLATFORMS`` whether or not jax is already imported.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import signal
+import sys
+import threading
+import time
+import zlib
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu import faultinject, profiling
+from pint_tpu.exceptions import (CheckpointCorruptError, ScanInterrupted)
+from pint_tpu.logging import child as _logchild
+
+_log = _logchild("runtime")
+
+__all__ = ["BackendStatus", "acquire_backend",
+           "write_checkpoint", "load_checkpoint", "scan_signature",
+           "ChunkStatus", "ScanSummary", "run_checkpointed_scan",
+           "call_with_deadline"]
+
+
+# --- supervised backend acquisition -------------------------------------------
+
+class BackendStatus(NamedTuple):
+    """Provenance record of one :func:`acquire_backend` call.
+
+    ``rung`` is the winning rung of the acquisition chain:
+    ``"accelerator"`` (the configured accelerator probe answered),
+    ``"cpu"`` (CPU was the configured backend and it answered), or
+    ``"cpu_fallback"`` (the configured backend never answered within the
+    retry/deadline budget and ``JAX_PLATFORMS`` was redirected to the
+    CPU backend — a degraded but REAL backend, mirroring the fit
+    engine's fused->eager->LM chain)."""
+
+    ok: bool                      #: a usable backend was acquired
+    rung: str                     #: "accelerator" | "cpu" | "cpu_fallback"
+    attempts: int                 #: probe attempts made
+    wait_s: float                 #: total backoff sleep between attempts
+    probe_timeout_s: float        #: per-attempt probe deadline
+    failures: Tuple[str, ...]     #: one failure description per failed probe
+
+    @property
+    def degraded(self) -> bool:
+        return self.rung == "cpu_fallback"
+
+    def as_dict(self) -> dict:
+        return {"backend_rung": self.rung,
+                "probe_attempts": self.attempts,
+                "probe_wait_s": round(self.wait_s, 3)}
+
+
+def probe_backend(timeout_s: float = 120.0) -> Optional[str]:
+    """None if the configured jax backend responds, else a string saying
+    HOW it failed (hang vs crash — they need different debugging).
+    Checked in a subprocess: a wedged tunnel hangs ``jax.devices()``
+    itself (observed 2026-08), which would otherwise hang the calling
+    process with no output for any driver to record."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return (f"jax.devices() did not return within {timeout_s:.0f} s "
+                "in a probe subprocess (wedged tunnel)")
+    if out.returncode != 0:
+        return ("backend probe subprocess failed "
+                f"(rc {out.returncode}); stderr tail: "
+                + out.stderr[-400:])
+    return None
+
+
+def _force_cpu() -> None:
+    """Redirect this process to the CPU backend, whether or not jax is
+    already imported (an already-imported jax has read JAX_PLATFORMS
+    into its config default, so the env mutation alone is not enough)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jaxmod = sys.modules.get("jax")
+    if jaxmod is not None:
+        try:
+            jaxmod.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def acquire_backend(max_attempts: Optional[int] = None,
+                    probe_timeout_s: Optional[float] = None,
+                    backoff_s: Optional[float] = None,
+                    deadline_s: Optional[float] = None,
+                    probe: Optional[Callable] = None) -> BackendStatus:
+    """Acquire a usable jax backend with bounded retries, then degrade.
+
+    Probes the CURRENTLY CONFIGURED backend (whatever ``JAX_PLATFORMS``
+    says) in a subprocess up to ``max_attempts`` times with exponential
+    backoff (``backoff_s * 2**i`` between attempts) under an overall
+    ``deadline_s``; if every probe fails, redirects the process to the
+    CPU backend and returns ``rung="cpu_fallback"``.  Never hangs
+    indefinitely, never returns "no backend": the CPU rung is in-process
+    and cannot wedge, so it is trusted without a probe.
+
+    Env-tunable defaults: ``PINT_TPU_PROBE_ATTEMPTS`` (3),
+    ``PINT_TPU_PROBE_TIMEOUT_S`` (120), ``PINT_TPU_PROBE_BACKOFF_S``
+    (2), ``PINT_TPU_PROBE_DEADLINE_S`` (420).  The probe is routed
+    through the ``wedged_probe`` failpoint so the whole chain is
+    drivable from tests and from a bench subprocess
+    (``PINT_TPU_FAULTS=wedged_probe``)."""
+    if max_attempts is None:
+        max_attempts = int(_env_float("PINT_TPU_PROBE_ATTEMPTS", 3))
+    if probe_timeout_s is None:
+        probe_timeout_s = _env_float("PINT_TPU_PROBE_TIMEOUT_S", 120.0)
+    if backoff_s is None:
+        backoff_s = _env_float("PINT_TPU_PROBE_BACKOFF_S", 2.0)
+    if deadline_s is None:
+        deadline_s = _env_float("PINT_TPU_PROBE_DEADLINE_S", 420.0)
+    probe = faultinject.wrap("wedged_probe",
+                             probe if probe is not None else probe_backend)
+
+    configured = os.environ.get("JAX_PLATFORMS", "")
+    primary = "cpu" if configured.strip() == "cpu" else "accelerator"
+    deadline = time.monotonic() + deadline_s if deadline_s else None
+    attempts, waited = 0, 0.0
+    failures = []
+    for i in range(max(1, max_attempts)):
+        budget = probe_timeout_s
+        if deadline is not None:
+            budget = min(budget, deadline - time.monotonic())
+            if budget <= 0:
+                failures.append(
+                    f"acquisition deadline ({deadline_s:.0f} s) exhausted "
+                    f"before attempt {attempts + 1}")
+                break
+        attempts += 1
+        profiling.count("runtime.probe_attempt")
+        fail = probe(timeout_s=budget)
+        if fail is None:
+            return BackendStatus(True, primary, attempts, waited,
+                                 probe_timeout_s, tuple(failures))
+        failures.append(fail)
+        profiling.count("runtime.probe_failure")
+        _log.warning("backend probe attempt %d/%d failed: %s",
+                     attempts, max_attempts, fail)
+        if i < max_attempts - 1:
+            w = backoff_s * (2.0 ** i)
+            if deadline is not None:
+                w = min(w, max(0.0, deadline - time.monotonic()))
+            if w > 0:
+                time.sleep(w)
+                waited += w
+    # every probe failed: degrade to the CPU backend (the terminal rung
+    # of the chain — in-process, cannot wedge, trusted without a probe)
+    profiling.count("runtime.backend_fallback")
+    _log.warning("backend acquisition degraded to cpu_fallback after "
+                 "%d attempt(s), %.1f s of backoff", attempts, waited)
+    _force_cpu()
+    return BackendStatus(True, "cpu_fallback", attempts, waited,
+                         probe_timeout_s, tuple(failures))
+
+
+# --- verified atomic checkpoints ----------------------------------------------
+
+CHECKPOINT_VERSION = 1
+
+
+def _arrays_crc(arrays: Dict[str, np.ndarray]) -> int:
+    """CRC32 over names, dtypes, shapes and bytes of every array, in
+    sorted-name order — any truncation, bit flip, or dropped/renamed
+    entry changes it."""
+    crc = 0
+    for k in sorted(arrays):
+        a = np.ascontiguousarray(np.asarray(arrays[k]))
+        crc = zlib.crc32(k.encode(), crc)
+        crc = zlib.crc32(str(a.dtype).encode(), crc)
+        crc = zlib.crc32(np.asarray(a.shape, np.int64).tobytes(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_checkpoint(path: str, arrays: Dict[str, np.ndarray],
+                     compressed: bool = False) -> None:
+    """Atomically write ``arrays`` to ``path`` as an ``.npz`` with an
+    embedded CRC32 (same write-to-tmp + ``os.replace`` discipline
+    ``mcmc.py`` established; a reader never sees a half-written file,
+    and :func:`load_checkpoint` verifies the checksum)."""
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    crc = _arrays_crc(payload)
+    tmp = path + f".tmp{os.getpid()}.npz"
+    save = np.savez_compressed if compressed else np.savez
+    save(tmp, _crc32=np.uint32(crc),
+         _version=np.int64(CHECKPOINT_VERSION), **payload)
+    os.replace(tmp, path)
+    profiling.count("runtime.checkpoint_write")
+
+
+def load_checkpoint(path: str, verify: bool = True) -> Dict[str, np.ndarray]:
+    """Load a checkpoint written by :func:`write_checkpoint`, raising
+    :class:`~pint_tpu.exceptions.CheckpointCorruptError` on a truncated/
+    unreadable container or a CRC mismatch.  Legacy checkpoints without
+    an embedded CRC (pre-runtime format) load unverified."""
+    try:
+        with np.load(path, allow_pickle=False) as f:
+            data = {k: np.asarray(f[k]) for k in f.files}
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} is unreadable (truncated or corrupt "
+            f"container): {type(e).__name__}: {e}") from e
+    stored = data.pop("_crc32", None)
+    data.pop("_version", None)
+    if verify and stored is not None and int(stored) != _arrays_crc(data):
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} failed its CRC32 integrity check "
+            f"(stored {int(stored):#010x}, recomputed "
+            f"{_arrays_crc(data):#010x}) — the file was corrupted after "
+            "it was written")
+    return data
+
+
+def scan_signature(tag: str, grid_values: Dict[str, np.ndarray],
+                   names, maxiter: int, chunk_size: int) -> str:
+    """A configuration fingerprint stored in scan checkpoints so a
+    resume against a different grid/fit configuration is rejected
+    instead of silently mixing results."""
+    crc = 0
+    for k in sorted(grid_values):
+        a = np.ascontiguousarray(np.asarray(grid_values[k], np.float64))
+        crc = zlib.crc32(k.encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return (f"{tag}|names={','.join(names)}|maxiter={maxiter}"
+            f"|cs={chunk_size}|grid_crc={crc & 0xFFFFFFFF:#010x}")
+
+
+# --- checkpointed chunked scans -----------------------------------------------
+
+class ChunkStatus(enum.IntEnum):
+    """Terminal state of one scan chunk (the out-of-graph analogue of
+    ``fitter.FitStatus``)."""
+
+    OK = 0         #: first dispatch returned finite values
+    RETRIED = 1    #: succeeded after >= 1 retry of the primary path
+    REROUTED = 2   #: primary path exhausted; the fallback path succeeded
+    FAILED = 3     #: every attempt (and the fallback) failed
+
+
+#: checkpoint code for "not yet run"
+_PENDING = -1
+
+
+class ScanSummary(NamedTuple):
+    """Aggregate provenance of one checkpointed chunked scan — the
+    scan-level companion of ``fitter.FitSummary``."""
+
+    n_points: int
+    chunk_size: int
+    n_chunks: int
+    statuses: Tuple[ChunkStatus, ...]   #: per-chunk terminal status
+    retries: int                        #: primary-path re-dispatches
+    reroutes: int                       #: chunks requeued to the fallback
+    failures: int                       #: chunks with no usable result
+    resumed_chunks: int                 #: chunks skipped via resume
+    checkpoint: Optional[str]
+    interrupted: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return self.failures == 0
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for s in self.statuses:
+            out[s.name] = out.get(s.name, 0) + 1
+        return out
+
+
+class _SignalFlush:
+    """Install SIGTERM/SIGINT handlers that record the signal instead of
+    killing the process, so the scan loop can flush a final checkpoint
+    and raise :class:`ScanInterrupted` at the next chunk boundary.
+    No-op outside the main thread (``signal.signal`` is main-thread
+    only; a worker-thread scan keeps the process default handlers)."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.fired: Optional[int] = None
+        self._old: dict = {}
+
+    def __enter__(self):
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in self.SIGNALS:
+            try:
+                self._old[sig] = signal.signal(sig, self._handler)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        return self
+
+    def _handler(self, signum, frame):
+        self.fired = signum
+
+    def __exit__(self, *exc):
+        for sig, old in self._old.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        return False
+
+
+def run_checkpointed_scan(
+        n_points: int,
+        run_chunk: Callable[[int, int, int], np.ndarray],
+        chunk_size: Optional[int] = None,
+        fallback: Optional[Callable[[int, int, int], np.ndarray]] = None,
+        checkpoint: Optional[str] = None,
+        resume: bool = False,
+        max_retries: int = 2,
+        checkpoint_every: int = 1,
+        signature: str = "",
+        write_checkpoints: bool = True,
+) -> Tuple[np.ndarray, ScanSummary]:
+    """Execute a scan of ``n_points`` results in chunks, preemption-
+    tolerantly.  Returns ``(results, ScanSummary)``.
+
+    ``run_chunk(ci, lo, hi)`` computes the ``(hi - lo,)`` float result
+    slice for chunk ``ci`` (e.g. one vmapped/sharded grid dispatch);
+    ``fallback(ci, lo, hi)`` is the requeue path (e.g. the eager
+    single-device fit) tried once after ``max_retries`` re-dispatches of
+    the primary path all raised or returned non-finite values.
+
+    With ``checkpoint`` set, a CRC32-verified shard checkpoint is
+    written atomically every ``checkpoint_every`` completed chunks, a
+    SIGTERM/SIGINT arriving mid-scan flushes a final checkpoint and
+    raises :class:`~pint_tpu.exceptions.ScanInterrupted` at the next
+    chunk boundary, and ``resume=True`` skips previously completed
+    chunks (bit-identically: their results are restored from the
+    checkpoint, not recomputed).  ``FAILED`` chunks are re-run on
+    resume.  ``write_checkpoints=False`` makes this process read-only
+    against the checkpoint (the non-zero ranks of a multihost scan).
+
+    Failpoints (:mod:`pint_tpu.faultinject`): ``chunk_nonfinite`` /
+    ``chunk_raise`` wrap the primary dispatch, ``sigterm_midscan`` the
+    post-chunk hook, ``corrupt_checkpoint`` the file itself."""
+    n_points = int(n_points)
+    cs = int(chunk_size) if chunk_size else n_points
+    if n_points <= 0:
+        raise ValueError("n_points must be positive")
+    if cs <= 0:
+        raise ValueError("chunk_size must be positive")
+    n_chunks = -(-n_points // cs)
+
+    results = np.full(n_points, np.nan, np.float64)
+    statuses = np.full(n_chunks, _PENDING, np.int8)
+    retries = reroutes = failures = 0
+    resumed_chunks = 0
+
+    if resume and checkpoint and os.path.exists(checkpoint):
+        data = load_checkpoint(checkpoint)
+        stored_sig = bytes(np.asarray(
+            data.get("signature", np.zeros(0, np.uint8)),
+            np.uint8)).decode(errors="replace")
+        if (int(data["n_points"]) != n_points
+                or int(data["chunk_size"]) != cs
+                or (signature and stored_sig != signature)):
+            raise ValueError(
+                f"checkpoint {checkpoint!r} does not match this scan "
+                f"configuration (stored n_points="
+                f"{int(data['n_points'])}/chunk_size="
+                f"{int(data['chunk_size'])}/signature={stored_sig!r}; "
+                f"requested {n_points}/{cs}/{signature!r})")
+        results = np.asarray(data["results"], np.float64).copy()
+        statuses = np.asarray(data["statuses"], np.int8).copy()
+        # FAILED chunks are requeued on resume; completed ones are final
+        statuses[statuses == ChunkStatus.FAILED] = _PENDING
+        retries = int(data.get("retries", 0))
+        reroutes = int(data.get("reroutes", 0))
+        resumed_chunks = int(np.sum(statuses != _PENDING))
+        if resumed_chunks:
+            profiling.count("runtime.chunks_resumed", resumed_chunks)
+            _log.info("resuming scan from %s: %d/%d chunks already done",
+                      checkpoint, resumed_chunks, n_chunks)
+
+    def _flush() -> None:
+        if not (checkpoint and write_checkpoints):
+            return
+        write_checkpoint(checkpoint, {
+            "results": results, "statuses": statuses,
+            "n_points": np.int64(n_points), "chunk_size": np.int64(cs),
+            "retries": np.int64(retries), "reroutes": np.int64(reroutes),
+            "signature": np.frombuffer(signature.encode(), np.uint8),
+        })
+
+    after_chunk = faultinject.wrap("sigterm_midscan", lambda ci: None)
+    ck_every = max(1, int(checkpoint_every))
+    with _SignalFlush() as sigs:
+        for ci in range(n_chunks):
+            if statuses[ci] != _PENDING:
+                continue
+            lo, hi = ci * cs, min(n_points, (ci + 1) * cs)
+            runner = faultinject.wrap(
+                "chunk_nonfinite", faultinject.wrap("chunk_raise",
+                                                    run_chunk))
+            vals: Optional[np.ndarray] = None
+            status = ChunkStatus.FAILED
+            for attempt in range(max_retries + 1):
+                if attempt:
+                    retries += 1
+                    profiling.count("runtime.chunk_retry")
+                try:
+                    v = np.asarray(runner(ci, lo, hi), np.float64)
+                except ScanInterrupted:
+                    raise
+                except Exception as e:
+                    _log.warning(
+                        "scan chunk %d/%d dispatch raised (attempt %d): "
+                        "%s: %s", ci, n_chunks, attempt + 1,
+                        type(e).__name__, e)
+                    continue
+                if v.shape != (hi - lo,):
+                    raise ValueError(
+                        f"run_chunk returned shape {v.shape}, expected "
+                        f"({hi - lo},)")
+                if np.all(np.isfinite(v)):
+                    vals = v
+                    status = ChunkStatus.OK if attempt == 0 else \
+                        ChunkStatus.RETRIED
+                    break
+                _log.warning(
+                    "scan chunk %d/%d returned non-finite values "
+                    "(attempt %d)", ci, n_chunks, attempt + 1)
+            if vals is None and fallback is not None:
+                # requeue onto the degraded path; its values are kept
+                # even when non-finite (a partial grid is useful), but
+                # only finite values count as a successful reroute
+                profiling.count("runtime.chunk_reroute")
+                _log.warning("scan chunk %d/%d requeued onto the "
+                             "fallback path", ci, n_chunks)
+                try:
+                    v = np.asarray(fallback(ci, lo, hi), np.float64)
+                except ScanInterrupted:
+                    raise
+                except Exception as e:
+                    _log.warning(
+                        "scan chunk %d/%d fallback raised: %s: %s",
+                        ci, n_chunks, type(e).__name__, e)
+                else:
+                    vals = v
+                    if np.all(np.isfinite(v)):
+                        status = ChunkStatus.REROUTED
+                        reroutes += 1
+            if vals is not None:
+                results[lo:hi] = vals
+            if status == ChunkStatus.FAILED:
+                failures += 1
+                profiling.count("runtime.chunk_failed")
+            statuses[ci] = status
+            after_chunk(ci)
+            done = int(np.sum(statuses != _PENDING))
+            if (done % ck_every == 0) or ci == n_chunks - 1:
+                _flush()
+            if sigs.fired is not None:
+                _flush()
+                raise ScanInterrupted(
+                    f"scan interrupted by signal {sigs.fired} after "
+                    f"chunk {ci} ({done}/{n_chunks} chunks done"
+                    + (f"; checkpoint flushed to {checkpoint}"
+                       if checkpoint and write_checkpoints else
+                       "; no checkpoint configured") + ")",
+                    checkpoint=checkpoint, chunks_done=done,
+                    n_chunks=n_chunks, signum=sigs.fired)
+    _flush()
+    summary = ScanSummary(
+        n_points=n_points, chunk_size=cs, n_chunks=n_chunks,
+        statuses=tuple(ChunkStatus(int(s)) for s in statuses),
+        retries=retries, reroutes=reroutes, failures=failures,
+        resumed_chunks=resumed_chunks, checkpoint=checkpoint,
+        interrupted=False)
+    return results, summary
+
+
+def call_with_deadline(fn: Callable, timeout_s: Optional[float],
+                       what: str):
+    """Run ``fn()`` in a daemon thread and join with ``timeout_s``,
+    raising :class:`~pint_tpu.exceptions.MultihostTimeoutError` if it
+    does not finish — the only portable way to bound a collective that
+    blocks inside a C extension.  ``timeout_s`` of None/0 runs ``fn``
+    inline with no deadline.  On timeout the worker thread is leaked
+    (daemonic, dies with the process); the caller gets an actionable
+    error instead of an indefinite hang."""
+    from pint_tpu.exceptions import MultihostTimeoutError
+
+    if not timeout_s:
+        return fn()
+    box: dict = {}
+
+    def target():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # surfaced in the caller below
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"deadline:{what}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        profiling.count("runtime.deadline_expired")
+        raise MultihostTimeoutError(
+            f"{what} did not complete within {timeout_s:.0f} s — a peer "
+            "process is likely dead or never joined; check every "
+            "worker's logs/phase file and restart the ensemble")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")

@@ -4,29 +4,123 @@ Every worker builds the identical tiny problem, joins the distributed
 runtime, runs the multi-process grid fit, and process 0 writes the chi2
 vector as JSON to the path in argv[5] (a file, because the Gloo/absl
 runtime logs to stdout from other threads) for the parent to compare
-against the single-process path."""
+against the single-process path.
+
+Preemption hardening (ISSUE 4): each worker continuously reports its
+phase ("start" -> "init" -> "fit" -> "write" -> "done") with a
+heartbeat into ``PINT_TPU_MH_PHASE_DIR/worker<pid>.json``, and runs a
+watchdog thread that monitors its peers' heartbeats — a peer whose
+heartbeat goes stale for ``PINT_TPU_MH_STALE_S`` seconds while not done
+is reported as dead (``@@DEADPEER@@`` line naming the peer and its last
+phase) and this worker exits rc 3 instead of blocking forever inside a
+collective.  ``multihost.init`` failures (e.g. a peer that never
+joined, bounded by ``PINT_TPU_MH_INIT_TIMEOUT_S``) are reported as
+``@@PHASEFAIL@@`` naming the worker and phase, rc 2.  Setting
+``PINT_TPU_MH_CHUNKED`` to a chunk size routes the fit through the
+checkpointed chunked scan path (checkpoint next to the output file).
+"""
 
 import json
+import os
 import sys
+import threading
+import time
 import warnings
 
 warnings.filterwarnings("ignore")
+
+HEARTBEAT_S = 0.5
+
+
+class PhaseReporter:
+    """Write {"pid", "phase", "t"} for this worker, re-stamped every
+    HEARTBEAT_S by a daemon thread so a live-but-busy worker never looks
+    dead; watch peers and os._exit(3) when one goes stale."""
+
+    def __init__(self, phase_dir, pid, nproc, stale_s):
+        self.dir = phase_dir
+        self.pid = pid
+        self.nproc = nproc
+        self.stale_s = stale_s
+        self.phase = "start"
+        self._write()
+        threading.Thread(target=self._beat, daemon=True).start()
+        if stale_s:
+            threading.Thread(target=self._watch, daemon=True).start()
+
+    def _path(self, pid):
+        return os.path.join(self.dir, f"worker{pid}.json")
+
+    def _write(self):
+        tmp = self._path(self.pid) + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps({"pid": self.pid, "phase": self.phase,
+                                 "t": time.time()}))
+        os.replace(tmp, self._path(self.pid))
+
+    def set(self, phase):
+        self.phase = phase
+        self._write()
+
+    def _beat(self):
+        while self.phase != "done":
+            time.sleep(HEARTBEAT_S)
+            self._write()
+
+    def _watch(self):
+        while self.phase != "done":
+            time.sleep(HEARTBEAT_S)
+            now = time.time()
+            for j in range(self.nproc):
+                if j == self.pid:
+                    continue
+                try:
+                    with open(self._path(j)) as fh:
+                        peer = json.loads(fh.read())
+                except (OSError, ValueError):
+                    continue    # not started yet / mid-replace
+                age = now - float(peer.get("t", now))
+                if peer.get("phase") != "done" and age > self.stale_s:
+                    print(f"@@DEADPEER@@ worker {self.pid}: peer worker "
+                          f"{j} appears dead (last phase "
+                          f"{peer.get('phase')!r}, heartbeat {age:.1f} s"
+                          " stale)", file=sys.stderr, flush=True)
+                    os._exit(3)
 
 
 def main():
     coord, pid, nproc, nlocal = (sys.argv[1], int(sys.argv[2]),
                                  int(sys.argv[3]), int(sys.argv[4]))
     out_path = sys.argv[5] if len(sys.argv) > 5 else None
+
+    phase_dir = os.environ.get("PINT_TPU_MH_PHASE_DIR")
+    stale_s = float(os.environ.get("PINT_TPU_MH_STALE_S", 0) or 0)
+    rep = None
+    if phase_dir:
+        rep = PhaseReporter(phase_dir, pid, nproc, stale_s)
+
+    def phase(name):
+        if rep is not None:
+            rep.set(name)
+
     from pint_tpu import multihost
 
-    multihost.init(coordinator=coord, num_processes=nproc, process_id=pid,
-                   local_devices=nlocal)
+    phase("init")
+    try:
+        multihost.init(coordinator=coord, num_processes=nproc,
+                       process_id=pid, local_devices=nlocal)
+    except Exception as e:
+        print(f"@@PHASEFAIL@@ worker {pid} failed in phase 'init': "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        phase("done")
+        sys.exit(2)
 
     import numpy as np
 
     from pint_tpu.examples import simulate_j0740_class
     from pint_tpu.fitter import WLSFitter
 
+    phase("fit")
     model, toas = simulate_j0740_class(ntoas=40, span_days=600.0)
     model.M2.frozen = True
     model.SINI.frozen = True
@@ -36,8 +130,19 @@ def main():
         "SINI": np.tile(np.array([0.95, 0.99]), 2),
     }
     mesh = multihost.global_mesh()
-    chi2 = multihost.multihost_grid_chisq(fitter, grid, mesh=mesh,
-                                          maxiter=2)
+    chunked = int(os.environ.get("PINT_TPU_MH_CHUNKED", 0) or 0)
+    if chunked:
+        # the checkpointed chunked scan path over DCN: every process
+        # runs the same chunk sequence, process 0 writes checkpoints
+        chi2, summary = multihost.multihost_grid_chisq(
+            fitter, grid, mesh=mesh, maxiter=2, chunk_size=chunked,
+            checkpoint=(out_path + ".ck") if out_path else None,
+            return_summary=True)
+        assert summary.ok, summary
+    else:
+        chi2 = multihost.multihost_grid_chisq(fitter, grid, mesh=mesh,
+                                              maxiter=2)
+    phase("write")
     if pid == 0:
         payload = json.dumps([float(c) for c in chi2])
         if out_path:
@@ -48,6 +153,7 @@ def main():
                 fh.write(payload)
         else:
             print("@@CHI2@@" + payload, flush=True)
+    phase("done")
 
 
 if __name__ == "__main__":

@@ -4,13 +4,20 @@ collectives, global arrays — checked against the single-process path.
 The reference has no multi-host capability at all (SURVEY §2.8: its only
 parallelism is a same-host process pool, `gridutils.py:322`); this
 validates the DCN layer of the TPU-native scale-out
-(`pint_tpu/multihost.py`)."""
+(`pint_tpu/multihost.py`).
+
+Preemption hardening (ISSUE 4): workers report phases with heartbeats,
+the parent enforces a hard join timeout and converts a hang into a
+NAMED failure (which host, which phase), a deliberately-killed worker
+is detected by its surviving peer's watchdog, and init against a
+never-joining peer raises an actionable timeout instead of hanging."""
 
 import json
 import os
 import socket
 import subprocess
 import sys
+import time
 import warnings
 
 import numpy as np
@@ -25,36 +32,71 @@ def _free_port():
     return port
 
 
-def test_two_process_grid_matches_single_process(tmp_path):
-    nproc, nlocal = 2, 2
+def _read_phases(phase_dir, nproc):
+    out = {}
+    for j in range(nproc):
+        path = os.path.join(phase_dir, f"worker{j}.json")
+        try:
+            with open(path) as fh:
+                out[j] = json.loads(fh.read()).get("phase", "?")
+        except (OSError, ValueError):
+            out[j] = "(no phase file)"
+    return out
+
+
+def _spawn_workers(tmp_path, nproc=2, nlocal=2, env_extra=None,
+                   out_name="chi2.json"):
+    """Start the SPMD workers with phase reporting wired up.  Returns
+    (procs, out_path, phase_dir, env)."""
     coord = f"127.0.0.1:{_free_port()}"
+    phase_dir = str(tmp_path / "phases")
+    os.makedirs(phase_dir, exist_ok=True)
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))) + ":" + env.get("PYTHONPATH", "")
+    env["PINT_TPU_MH_PHASE_DIR"] = phase_dir
+    env.update(env_extra or {})
     worker = os.path.join(os.path.dirname(__file__),
                           "multihost_worker.py")
-    out_path = str(tmp_path / "chi2.json")
+    out_path = str(tmp_path / out_name)
     procs = [subprocess.Popen(
         [sys.executable, worker, coord, str(i), str(nproc), str(nlocal),
          out_path],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True) for i in range(nproc)]
+    return procs, out_path, phase_dir
+
+
+def _join_workers(procs, phase_dir, timeout=850):
+    """Hard join: a hang becomes a NAMED pytest failure (which host,
+    which phase) instead of an indefinite wait (ISSUE 4 satellite)."""
+    outs = []
     try:
-        outs = [p.communicate(timeout=850) for p in procs]
+        for p in procs:
+            remaining = timeout  # per-process cap; total is bounded too
+            try:
+                outs.append(p.communicate(timeout=remaining))
+            except subprocess.TimeoutExpired:
+                phases = _read_phases(phase_dir, len(procs))
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                        q.wait()
+                pytest.fail(
+                    f"multihost workers hung past the {timeout} s join "
+                    "timeout; last reported phases: " + ", ".join(
+                        f"worker {j}: {ph!r}"
+                        for j, ph in sorted(phases.items())))
     finally:
         for p in procs:  # no leaked workers if one hangs the rendezvous
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    for p, (so, se) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{se[-2000:]}"
-    assert os.path.isfile(out_path), \
-        f"worker 0 wrote no result; stdout tail: {outs[0][0][-500:]}"
-    with open(out_path) as fh:
-        chi2_mp = np.array(json.loads(fh.read()))
+    return outs
 
-    # single-process reference: the same problem on this process's own
-    # (2, 2) virtual mesh
+
+def _single_process_reference():
+    """The same problem on this process's own (2, 2) virtual mesh."""
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         from pint_tpu.examples import simulate_j0740_class
@@ -70,8 +112,85 @@ def test_two_process_grid_matches_single_process(tmp_path):
             "SINI": np.tile(np.array([0.95, 0.99]), 2),
         }
         mesh = make_mesh(4, batch=2)  # (2, 2), same shape as 2 hosts x 2
-        chi2_sp = sharded_grid_chisq(fitter, grid, mesh=mesh, maxiter=2)
+        return sharded_grid_chisq(fitter, grid, mesh=mesh, maxiter=2)
 
+
+def test_two_process_grid_matches_single_process(tmp_path):
+    procs, out_path, phase_dir = _spawn_workers(tmp_path)
+    outs = _join_workers(procs, phase_dir)
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{se[-2000:]}"
+    assert os.path.isfile(out_path), \
+        f"worker 0 wrote no result; stdout tail: {outs[0][0][-500:]}"
+    with open(out_path) as fh:
+        chi2_mp = np.array(json.loads(fh.read()))
+
+    chi2_sp = _single_process_reference()
     assert chi2_mp.shape == chi2_sp.shape == (4,)
     assert np.all(np.isfinite(chi2_mp))
     np.testing.assert_allclose(chi2_mp, chi2_sp, rtol=1e-9)
+
+
+def test_two_process_chunked_checkpointed_grid(tmp_path):
+    """The checkpointed chunked scan over DCN (ISSUE 4): both processes
+    run the chunk sequence in lockstep, process 0 writes the verified
+    checkpoints, and the assembled chi2 still matches the
+    single-process path."""
+    procs, out_path, phase_dir = _spawn_workers(
+        tmp_path, env_extra={"PINT_TPU_MH_CHUNKED": "2"})
+    outs = _join_workers(procs, phase_dir)
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{se[-2000:]}"
+    with open(out_path) as fh:
+        chi2_mp = np.array(json.loads(fh.read()))
+    assert os.path.isfile(out_path + ".ck"), \
+        "process 0 wrote no scan checkpoint"
+    from pint_tpu.runtime import load_checkpoint
+
+    ck = load_checkpoint(out_path + ".ck")  # CRC-verified
+    assert int(ck["n_points"]) == 4 and int(ck["chunk_size"]) == 2
+    chi2_sp = _single_process_reference()
+    np.testing.assert_allclose(chi2_mp, chi2_sp, rtol=1e-9)
+
+
+def test_kill_one_worker_is_reported_not_hung(tmp_path):
+    """ISSUE 4 satellite: a deliberately-killed worker produces a NAMED
+    failure (which host, which phase) from its surviving peer's
+    watchdog, and nothing hangs."""
+    procs, out_path, phase_dir = _spawn_workers(
+        tmp_path, env_extra={"PINT_TPU_MH_STALE_S": "4",
+                             "PINT_TPU_MH_INIT_TIMEOUT_S": "120"})
+    victim, survivor = procs[1], procs[0]
+    # wait for the victim's phase file to appear, then kill it
+    vpath = os.path.join(phase_dir, "worker1.json")
+    deadline = time.time() + 120
+    while not os.path.exists(vpath) and time.time() < deadline:
+        time.sleep(0.2)
+    assert os.path.exists(vpath), "victim never reported a phase"
+    victim.kill()
+    victim.wait()
+    outs = _join_workers(procs, phase_dir, timeout=120)
+    so, se = outs[0]
+    assert survivor.returncode == 3, \
+        f"survivor rc {survivor.returncode}; stderr:\n{se[-2000:]}"
+    assert "@@DEADPEER@@" in se
+    assert "peer worker 1" in se       # names WHICH host...
+    assert "last phase" in se          # ...and which phase it died in
+
+
+def test_init_timeout_is_actionable_not_hung(tmp_path):
+    """ISSUE 4: `multihost.init` against a peer that never joins raises
+    a named, actionable error within its deadline instead of hanging
+    the process forever."""
+    # spawn ONE worker of a declared 2-process ensemble: the rendezvous
+    # can never complete
+    procs, out_path, phase_dir = _spawn_workers(
+        tmp_path, env_extra={"PINT_TPU_MH_INIT_TIMEOUT_S": "8"})
+    lone = procs[0]
+    procs[1].kill()
+    procs[1].wait()
+    outs = _join_workers([lone], phase_dir, timeout=120)
+    so, se = outs[0]
+    assert lone.returncode == 2, \
+        f"lone worker rc {lone.returncode}; stderr:\n{se[-2000:]}"
+    assert "@@PHASEFAIL@@ worker 0 failed in phase 'init'" in se
