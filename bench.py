@@ -76,6 +76,25 @@ def _util(ntoa, nfit, wall_s, niter=1, nbatch=1):
                               nbatch=nbatch), wall_s)
 
 
+def _dispatch_counters(call):
+    """Steady-state XLA-boundary counters for one already-warm call
+    (ISSUE 5): compiles/dispatches/transfers measured by
+    ``pint_tpu.lint.tracehooks`` — the bench regression axis beyond
+    wall-clock.  A healthy steady state has compiles == retraces == 0;
+    a drift upward in dispatches/transfers flags a perf regression the
+    wall-clock may hide (host noise swamps a stray dispatch on CPU, a
+    tunnel RTT does not)."""
+    from pint_tpu.lint.tracehooks import instrument
+
+    with instrument() as th:
+        m0 = th.mark()
+        call()
+        d = th.since(m0)
+    return {"compiles": d.compiles, "dispatches": d.dispatches,
+            "transfers": d.transfers, "host_bytes": d.host_bytes,
+            "retraces": len(d.retraces)}
+
+
 def get_dataset():
     from pint_tpu.examples import simulate_j0740_realistic
     from pint_tpu.models import get_model
@@ -133,7 +152,10 @@ def bench_headline_grid():
     util = _util(toas.ntoas, len(fitter.fit_params), min(times),
                  niter=2, nbatch=len(grid["M2"]))
     log(f"headline solve utilization: {util}")
-    return min(times), setup_s, compile_s, util
+    counters = _dispatch_counters(
+        lambda: grid_chisq_flat(fitter, grid, maxiter=2))
+    log(f"headline dispatch counters: {counters}")
+    return min(times), setup_s, compile_s, util, counters
 
 
 def bench_ngc6440e():
@@ -479,6 +501,7 @@ def bench_quick(backend_status=None):
             f.fit_toas(maxiter=2)
             times.append(time.time() - t0)
     t = min(times)
+    counters = _dispatch_counters(lambda: f.fit_toas(maxiter=2))
     # supervised-acquisition provenance (ISSUE 4): how the backend was
     # obtained — a wedged-probe run shows up as backend_rung
     # "cpu_fallback" with attempts > 1 instead of a null metric
@@ -502,6 +525,10 @@ def bench_quick(backend_status=None):
         # series even when the wall-clock looks fine
         "fit_status": f.fitresult.status.name,
         "guard_trips": dict(f.fitresult.guard_trips or {}),
+        # steady-state XLA-boundary counters (ISSUE 5): compiles and
+        # retraces must stay 0 on a warm fit — the regression axis
+        # beyond wall-clock, schema-checked in tests/test_bench_quick.py
+        "dispatch_counters": counters,
         "submetrics": {},
     }
 
@@ -567,7 +594,8 @@ def main(argv=None):
     log("jax devices:", jax.devices())
     log(f"xla cache: {cache_dir} ({n_cached} entries)")
 
-    t, setup_s, compile_s, headline_util = bench_headline_grid()
+    t, setup_s, compile_s, headline_util, headline_counters = \
+        bench_headline_grid()
 
     def release_device():
         # drop compiled executables and live buffers between phases: the
@@ -640,6 +668,9 @@ def main(argv=None):
         "compile_s": round(compile_s, 1),
         # analytic solve-FLOP floor / measured wall (profiling.solve_flops)
         "solve_utilization": headline_util,
+        # steady-state XLA-boundary counters (ISSUE 5): the regression
+        # axis beyond wall-clock — compiles/retraces must stay 0
+        "dispatch_counters": headline_counters,
         # >0: compile_s figures are cache-LOAD cost (~10 s/program over
         # the tunnel), not recompiles
         "xla_cache_entries_at_start": n_cached,

@@ -128,6 +128,11 @@ def pytest_configure(config):
         "acquisition, checkpointed chunked scans, shard retry/requeue, "
         "kill-and-resume bit-identity; rides tier-1 except where the "
         "containing file is slow-marked)")
+    config.addinivalue_line(
+        "markers",
+        "contracts: the dispatch-contract audit gate "
+        "(tests/test_contracts.py; rides tier-1 next to the lint gate, "
+        "skip WIP branches with PINT_TPU_SKIP_CONTRACTS=1)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -136,8 +141,17 @@ def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 
     skip_lint = os.environ.get("PINT_TPU_SKIP_LINT") == "1"
+    skip_contracts = os.environ.get("PINT_TPU_SKIP_CONTRACTS") == "1"
     for item in items:
         fname = os.path.basename(str(item.fspath))
+        if fname == "test_contracts.py":
+            # the compiled-program contract gate rides tier-1 next to
+            # the lint gate; WIP branches opt out with
+            # PINT_TPU_SKIP_CONTRACTS=1
+            item.add_marker(_pytest.mark.contracts)
+            if skip_contracts:
+                item.add_marker(_pytest.mark.skip(
+                    reason="PINT_TPU_SKIP_CONTRACTS=1"))
         if fname == "test_faults.py":
             # deliberately NOT slow-marked: the guards are tier-1
             # robustness evidence

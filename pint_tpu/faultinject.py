@@ -50,7 +50,8 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "degenerate_column", "clock_out_of_range",
            "nonfinite_noise_grad", "corrupt_toa_errors", "corrupt_mjds",
            "wedged_probe", "chunk_nonfinite", "chunk_raise",
-           "sigterm_midscan", "corrupt_checkpoint"]
+           "sigterm_midscan", "corrupt_checkpoint", "retrace_storm",
+           "chatty_transfer"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -325,11 +326,65 @@ def corrupt_checkpoint(path: str, mode: str = "truncate") -> Iterator[None]:
             fh.write(orig)
 
 
+# --- contract-auditor failpoints (drive pint_tpu.lint.contracts, ISSUE 5) ----
+
+def _retrace_storm_factory(fn):
+    """Wrap a jitted entrypoint so EVERY call re-jits a fresh wrapper —
+    the classic "jit inside the loop" regression: the tracing-cache key
+    churns through function identity, so each steady-state call pays a
+    full retrace + recompile.  The contract auditor must fail
+    CONTRACT002 with the "function identity" attribution."""
+    def storm(*args, **kwargs):
+        import jax
+
+        return jax.jit(lambda *a, **k: fn(*a, **k))(*args, **kwargs)
+    return storm
+
+
+@contextlib.contextmanager
+def retrace_storm() -> Iterator[None]:
+    """Failpoint ``"retrace_storm"``: residual programs built inside the
+    context recompile on every call (see
+    :func:`pint_tpu.residuals.build_resid_fn`, which consults this
+    failpoint at build time).  Build the entrypoint INSIDE the context —
+    the wrapper binds when the program is built, same trace-time rule as
+    the model/solver injectors above.  Also env-activatable
+    (``PINT_TPU_FAULTS=retrace_storm``) for the
+    ``python -m pint_tpu.lint --contracts`` subprocess leg."""
+    with _registered("retrace_storm", _retrace_storm_factory):
+        yield
+
+
+def _chatty_transfer_factory(fn):
+    """Wrap a jitted entrypoint with per-element host pulls after every
+    call — the "stray float() in the hot loop" regression (each
+    ``float(out[i])`` is a separate slice dispatch + device->host
+    materialization; over a tunneled TPU, ~100 ms apiece).  The
+    contract auditor must fail CONTRACT001 on the transfer budget."""
+    def chatty(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        for i in range(min(8, out.shape[0])):
+            float(out[i])
+        return out
+    return chatty
+
+
+@contextlib.contextmanager
+def chatty_transfer() -> Iterator[None]:
+    """Failpoint ``"chatty_transfer"``: residual programs built inside
+    the context host-sync per element on every call.  Env-activatable
+    (``PINT_TPU_FAULTS=chatty_transfer``)."""
+    with _registered("chatty_transfer", _chatty_transfer_factory):
+        yield
+
+
 #: failpoints activatable across a process boundary via the
 #: PINT_TPU_FAULTS env var (comma-separated names; process-lifetime,
 #: no context manager to exit) — the bench/CLI-subprocess test leg
 _ENV_FACTORIES = {
     "wedged_probe": _wedged_probe_factory,
+    "retrace_storm": _retrace_storm_factory,
+    "chatty_transfer": _chatty_transfer_factory,
 }
 
 

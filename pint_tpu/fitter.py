@@ -38,6 +38,7 @@ import numpy as np
 from pint_tpu import faultinject, profiling
 from pint_tpu.exceptions import (ConvergenceFailure, DegeneracyWarning,
                                  PintTpuWarning)
+from pint_tpu.lint.contracts import dispatch_contract
 from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.residuals import Residuals, raw_phase_resids
 from pint_tpu.toabatch import TOABatch
@@ -497,6 +498,8 @@ def _make_assembly(model: TimingModel, names: Sequence[str], combined,
     return assemble
 
 
+@dispatch_contract("split_assembly", max_compiles=30, max_dispatches=2,
+                   max_transfers=2)
 def build_whitened_assembly(model: TimingModel, batch: TOABatch,
                             fit_params: Sequence[str], track_mode: str,
                             include_offset: bool,
@@ -569,6 +572,8 @@ def build_wideband_chi2_fn(model: TimingModel, batch: TOABatch,
     return chi2
 
 
+@dispatch_contract("wideband_step", max_compiles=40, max_dispatches=3,
+                   max_transfers=3)
 def build_wideband_assembly(model: TimingModel, batch: TOABatch,
                             dm_index, dm_data, dm_error,
                             fit_params: Sequence[str], track_mode: str,
@@ -615,6 +620,8 @@ def build_wideband_assembly(model: TimingModel, batch: TOABatch,
                           design_matrix)
 
 
+@dispatch_contract("gls_step", max_compiles=40, max_dispatches=3,
+                   max_transfers=3)
 def build_gls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
@@ -1057,6 +1064,8 @@ def _exact_assemble_factory(batch, default_builder):
     return assemble_exact
 
 
+@dispatch_contract("wls_step", max_compiles=40, max_dispatches=3,
+                   max_transfers=3)
 def build_wls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
@@ -1233,6 +1242,8 @@ def _host_noise_basis(model: TimingModel, p_host: dict):
          for c in comps], axis=1)
 
 
+@dispatch_contract("fused_fit", max_compiles=40, max_dispatches=1,
+                   max_transfers=2)
 def build_fused_fit(model: TimingModel, batch: TOABatch,
                     fit_params: Sequence[str], track_mode: str, *,
                     threshold: Optional[float] = None,

@@ -22,6 +22,7 @@ import numpy as np
 
 from pint_tpu.fitter import (Fitter, _default_wls_kernel,
                              build_whitened_assembly, wls_solve)
+from pint_tpu.lint.contracts import dispatch_contract
 from pint_tpu.models.timing_model import TimingModel
 from pint_tpu.residuals import Residuals
 
@@ -37,8 +38,10 @@ def _grid_deltas(model: TimingModel, p: dict,
     out = {}
     for name, vals in grid_values.items():
         par = model[name]
-        vals = np.asarray(vals, np.float64)
-        base = np.asarray(par.device_value, np.float64)
+        # host parameter metadata, never device values: no sync here
+        vals = np.asarray(vals, np.float64)    # ddlint: disable=TRACE002
+        base = np.asarray(par.device_value,
+                          np.float64)          # ddlint: disable=TRACE002
         if par.kind == "mjd":
             out[name] = vals - (base[0] + base[1])  # grid given in MJD
         else:
@@ -195,10 +198,14 @@ def _eager_grid_chisq(fitter: Fitter, grid_values: Dict[str, np.ndarray],
     out = np.empty(g, np.float64)
     for i in range(g):
         chi2, _ = pfit(_slice_stacked(stacked, gnames, i, i + 1, None))
-        out[i] = float(chi2)
+        # per-point fetch is the REQUEUE path's design: one eager
+        # single-device fit per poisoned point, isolation over speed
+        out[i] = float(chi2)                   # ddlint: disable=TRACE002
     return out
 
 
+@dispatch_contract("grid_chunk", max_compiles=40, max_dispatches=6,
+                   max_transfers=3)
 def grid_chisq_flat(fitter: Fitter, grid_values: Dict[str, np.ndarray],
                     maxiter: int = 2, kernel=None, *,
                     chunk_size: Optional[int] = None,

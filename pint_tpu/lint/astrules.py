@@ -67,10 +67,20 @@ RULES = {
                "module (dd, qs, mjd, phase, tdbseries, residuals)",
     "TRACE001": "host sync (float()/int()/bool()/.item()/np.*) inside "
                 "jit-reachable code",
+    "TRACE002": "per-iteration host conversion (float()/np.asarray/"
+                ".tolist()/.item()) inside a loop reachable from a "
+                "dispatch-contract entrypoint",
     "JIT001": "retrace hazard: mutable-global closure, unhashable "
               "static_argnums, or Python-scalar default in a jit signature",
+    "JIT002": "Python float literal passed at a non-static position of a "
+              "jit-wrapped function — weak-type retrace hazard per "
+              "call-site spelling",
     "JAXPR001": "runtime jaxpr audit: narrowing convert_element_type in a "
                 "traced precision-critical entry point",
+    "CONTRACT001": "dispatch-contract budget breach (steady-state "
+                   "dispatches/transfers/host bytes, or warmup compiles)",
+    "CONTRACT002": "steady-state retrace/recompile of a dispatch-contract "
+                   "entrypoint (unstable jit cache key)",
 }
 
 PRECISION_MODULES = {
@@ -93,6 +103,34 @@ _TRANSFORMS = {
     "while_loop", "cond", "switch", "fori_loop", "map", "associative_scan",
     "shard_map", "pjit", "custom_jvp", "custom_vjp",
 }
+
+
+def _static_positions(call: ast.Call) -> set:
+    """Literal static_argnums positions of a jit(...) / partial(jit, ...)
+    call (ints and int-tuples only; anything dynamic is ignored)."""
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnums":
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+    return out
+
+
+def _static_names(call: ast.Call) -> set:
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+    return out
 
 
 def _attr_name(func) -> Optional[str]:
@@ -194,7 +232,8 @@ def _block_terminates(body) -> bool:
 
 class _FuncInfo:
     __slots__ = ("node", "name", "parent", "jit_root", "jit_reachable",
-                 "calls", "local_names")
+                 "contract_root", "contract_reachable", "static_argnums",
+                 "static_argnames", "calls", "local_names")
 
     def __init__(self, node, name: str, parent: Optional["_FuncInfo"]):
         self.node = node
@@ -202,6 +241,10 @@ class _FuncInfo:
         self.parent = parent
         self.jit_root = False
         self.jit_reachable = False
+        self.contract_root = False       # carries @dispatch_contract
+        self.contract_reachable = False
+        self.static_argnums: set = set()
+        self.static_argnames: set = set()
         self.calls: set = set()
         self.local_names: set = set()
 
@@ -266,6 +309,11 @@ class _ModuleIndex(ast.NodeVisitor):
                 info.jit_root = True
             if isinstance(deco, ast.Call) and _is_jit_expr(deco):
                 self._add_jit_site(deco)
+                info.static_argnums |= _static_positions(deco)
+                info.static_argnames |= _static_names(deco)
+            if isinstance(deco, ast.Call) and \
+                    _attr_name(deco.func) == "dispatch_contract":
+                info.contract_root = True
         self._stack.append(info)
         self.generic_visit(node)
         self._stack.pop()
@@ -291,9 +339,11 @@ class _ModuleIndex(ast.NodeVisitor):
             info = self._resolve(arg.id)
             if info is not None:
                 info.jit_root = True
+            return info
         elif isinstance(arg, ast.Call) and \
                 _attr_name(arg.func) == "partial" and arg.args:
-            self._mark_fn_arg(arg.args[0])
+            return self._mark_fn_arg(arg.args[0])
+        return None
 
     def _check_wrap_call(self, value):
         """``f_j = jax.jit(f)`` / ``jax.vmap(f)`` style wrapping."""
@@ -304,7 +354,10 @@ class _ModuleIndex(ast.NodeVisitor):
                              and _is_jit_expr(value.func)):
             self._add_jit_site(value)
             for arg in value.args:
-                self._mark_fn_arg(arg)
+                info = self._mark_fn_arg(arg)
+                if info is not None:
+                    info.static_argnums |= _static_positions(value)
+                    info.static_argnames |= _static_names(value)
         elif name in _TRANSFORMS:
             # bare `map(...)` is the builtin, not jax.lax.map
             if name == "map" and isinstance(value.func, ast.Name):
@@ -471,6 +524,124 @@ class _BodyScanner:
                         "numpy cannot trace jax values; use jnp or the "
                         "get_xp dispatch")
 
+    # -- JIT002: weak-type scalars at jit call sites -----------------------
+    def _scan_jit002(self, tree):
+        """Float literals passed positionally (or by keyword) to a
+        module-local jit-wrapped function at a position not covered by
+        ``static_argnums``/``static_argnames``: the scalar enters the
+        trace weak-typed, so call sites spelling the value differently
+        (Python float vs np/jnp scalar vs array) each get their own
+        trace — the cache-key churn the contract auditor reports as
+        ``weak_type``."""
+        scopes = {id(info.node): info for info in self.index.functions}
+
+        def walk(node, scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = scopes.get(id(node), scope)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name):
+                callee = self._resolve_from_scope(scope, node.func.id)
+                if callee is not None and callee.jit_root:
+                    self._check_jit002_args(node, callee)
+            for child in ast.iter_child_nodes(node):
+                walk(child, scope)
+
+        walk(tree, None)
+
+    def _resolve_from_scope(self, scope, name):
+        while True:
+            hit = self.index.by_scope.get((id(scope), name))
+            if hit is not None:
+                return hit
+            if scope is None:
+                return None
+            scope = scope.parent
+
+    def _check_jit002_args(self, call: ast.Call, callee: _FuncInfo):
+        a = callee.node.args
+        argnames = [x.arg for x in list(a.posonlyargs) + list(a.args)]
+        for i, arg in enumerate(call.args):
+            if i in callee.static_argnums:
+                continue
+            if i < len(argnames) and argnames[i] in callee.static_argnames:
+                continue
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, float):
+                self.report(
+                    "JIT002", arg,
+                    f"Python float literal at non-static position {i} of "
+                    f"jit-wrapped '{callee.name}' — enters the trace "
+                    "weak-typed; call sites spelling it differently each "
+                    "retrace (pass an array/np.float64, or make the "
+                    "position static)")
+        for kw in call.keywords:
+            if kw.arg is None or kw.arg in callee.static_argnames:
+                continue
+            if kw.arg in argnames and \
+                    argnames.index(kw.arg) in callee.static_argnums:
+                continue
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, float):
+                self.report(
+                    "JIT002", kw.value,
+                    f"Python float literal for non-static parameter "
+                    f"'{kw.arg}' of jit-wrapped '{callee.name}' — "
+                    "weak-type retrace hazard per call-site spelling")
+
+    # -- TRACE002: per-iteration host conversions in contract code ---------
+    _TRACE2_NP = {"asarray", "array"}
+
+    def _scan_trace002(self, info: _FuncInfo):
+        """Host-conversion calls lexically inside a for/while loop of a
+        function reachable from a ``@dispatch_contract`` entrypoint:
+        each iteration's ``np.asarray``/``float()``/``.tolist()`` is a
+        separate device sync (~100 ms over a tunneled TPU), which turns
+        an O(1)-transfer entrypoint into O(steps).  jit-reachable
+        functions are TRACE001's domain and skipped here."""
+
+        def walk(node, in_loop):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not info.node:
+                return      # nested defs are scanned as their own scope
+            if isinstance(node, (ast.For, ast.While)):
+                for child in ast.iter_child_nodes(node):
+                    walk(child, True)
+                return
+            if in_loop and isinstance(node, ast.Call):
+                self._check_trace002_call(node)
+            for child in ast.iter_child_nodes(node):
+                walk(child, in_loop)
+
+        walk(info.node, False)
+
+    def _check_trace002_call(self, node: ast.Call):
+        fn = node.func
+        name = _attr_name(fn)
+        if isinstance(fn, ast.Attribute) and name in ("tolist", "item"):
+            self.report("TRACE002", node,
+                        f".{name}() inside a loop on a contract path — "
+                        "one device sync per iteration; hoist the fetch "
+                        "out of the loop or batch it")
+            return
+        if isinstance(fn, ast.Name) and fn.id == "float" and \
+                len(node.args) == 1:
+            arg = node.args[0]
+            if not _is_constlike(arg) and not _is_metadata_expr(arg):
+                self.report("TRACE002", node,
+                            "float() inside a loop on a contract path — "
+                            "one device sync per iteration; keep values "
+                            "on device or fetch once after the loop")
+            return
+        if isinstance(fn, ast.Attribute) and name in self._TRACE2_NP and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in (self.index.np_aliases or {"np", "numpy"}):
+            if node.args and not all(_is_constlike(a) for a in node.args):
+                self.report(
+                    "TRACE002", node,
+                    f"np.{name}() inside a loop on a contract path — a "
+                    "per-iteration device->host materialization; fetch "
+                    "once per chunk boundary or keep the loop on device")
+
     # -- JIT001 body checks ------------------------------------------------
     def _scan_jit001(self, info: _FuncInfo):
         node = info.node
@@ -537,12 +708,17 @@ def _collect_calls(info: _FuncInfo):
 
 
 def _propagate_jit(index: _ModuleIndex):
-    """jit-reachable = jit roots + transitive module-local callees."""
+    """jit-reachable = jit roots + transitive module-local callees;
+    contract-reachable additionally flows from a function into its
+    nested definitions (a closure returned by a contract entrypoint IS
+    the entrypoint's steady-state body)."""
     for info in index.functions:
         _collect_calls(info)
         _collect_locals(info)
         if info.jit_root:
             info.jit_reachable = True
+        if info.contract_root:
+            info.contract_reachable = True
 
     def resolve_from(info: _FuncInfo, name: str) -> Optional[_FuncInfo]:
         scope = info
@@ -558,13 +734,23 @@ def _propagate_jit(index: _ModuleIndex):
     while changed:
         changed = False
         for info in index.functions:
-            if not info.jit_reachable:
-                continue
-            for name in info.calls:
-                callee = resolve_from(info, name)
-                if callee is not None and not callee.jit_reachable:
-                    callee.jit_reachable = True
-                    changed = True
+            if info.jit_reachable:
+                for name in info.calls:
+                    callee = resolve_from(info, name)
+                    if callee is not None and not callee.jit_reachable:
+                        callee.jit_reachable = True
+                        changed = True
+            if info.contract_reachable:
+                for name in info.calls:
+                    callee = resolve_from(info, name)
+                    if callee is not None and \
+                            not callee.contract_reachable:
+                        callee.contract_reachable = True
+                        changed = True
+            elif info.parent is not None and \
+                    info.parent.contract_reachable:
+                info.contract_reachable = True
+                changed = True
 
 
 def lint_source(source: str, filename: str) -> List[Finding]:
@@ -603,12 +789,16 @@ def lint_source(source: str, filename: str) -> List[Finding]:
     # jit cache-key hazards at every jit(...) call site
     for call in index.jit_call_sites:
         scanner._check_jit_params(call)
+    # weak-type scalars flowing into jit call sites
+    scanner._scan_jit002(tree)
     # per-function trace-safety / retrace rules
     for info in index.functions:
         if info.jit_reachable:
             scanner._scan_trace_block(info.node.body, False)
         if info.jit_root:
             scanner._scan_jit001(info)
+        if info.contract_reachable and not info.jit_reachable:
+            scanner._scan_trace002(info)
 
     findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings

@@ -1,0 +1,507 @@
+"""Compiled-program dispatch contracts: declared budgets, audited runs.
+
+The framework's performance architecture is a set of *counting*
+invariants: the fused fit is one jitted call plus one fetch
+(:func:`pint_tpu.fitter.build_fused_fit`), a split-assembly step is one
+device program (:func:`pint_tpu.fitter._make_assembly`), a checkpointed
+scan compiles ONE chunk shape no matter how many chunks run
+(:func:`pint_tpu.runtime.run_checkpointed_scan`).  Until this module,
+those invariants lived in scattered ad-hoc test assertions over
+self-reported counters; nothing audited the package itself, so a stray
+``float()`` or an unstable jit cache key could silently reintroduce
+per-step recompiles — the exact failure mode that separates a
+TPU-native rebuild from eager NumPy timing (PINT, arxiv 2012.00074) and
+that Vela.jl's compiled-kernel design names as the cost to guard
+(arxiv 2412.15858).
+
+**Declaring a contract.**  Every hot public entrypoint carries a
+:func:`dispatch_contract` decorator naming its budgets::
+
+    @dispatch_contract("fused_fit", max_compiles=40, max_dispatches=2,
+                       max_transfers=2)
+    def build_fused_fit(model, batch, ...): ...
+
+The decorator is zero-cost at call time (it only records the contract
+in :data:`REGISTRY` and returns the function unchanged).  Budgets bound
+the STEADY-STATE call (dispatches / transfers / host bytes) and the
+one-time warmup (compiles); steady-state compiles and retraces are
+always-fail — there is no legitimate steady-state retrace.
+
+**Auditing.**  :func:`audit_contracts` drives each registered
+entrypoint on a small synthetic fixture under
+:mod:`pint_tpu.lint.tracehooks` — warmup call(s), then a steady-state
+call — and emits findings through the shared
+:mod:`pint_tpu.lint.findings` machinery:
+
+* **CONTRACT001** — a declared budget was exceeded (the finding names
+  the axis, the measured value and the budget).
+* **CONTRACT002** — the steady-state call retraced or recompiled; the
+  finding carries jax's own cache-miss attribution naming the unstable
+  cache-key component (shapes / dtypes / weak_type / pytree structure /
+  function identity / tracing context).
+
+Scan-shaped entrypoints whose programs are rebuilt per call
+(``mcmc_step``) are measured in *marginal* mode: a short run and a
+longer run of the same call, with steady state defined as the
+difference — the "one compiled chunk shape" property then reads as
+``marginal compiles == 0``.
+
+Sanctioning a breach uses the shared suppression syntax on (or next
+to) the decorator line::
+
+    @dispatch_contract("name", ...)  # ddlint: disable=CONTRACT001 <why>
+
+Run it: ``python -m pint_tpu.lint --contracts`` (or
+``--contracts=name1,name2`` for a subset); the pytest gate is
+``tests/test_contracts.py`` (marker ``contracts``, opt out with
+``PINT_TPU_SKIP_CONTRACTS=1``).  The seeded regressions proving the
+auditor catches real failures are ``faultinject.retrace_storm`` and
+``faultinject.chatty_transfer``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+from pint_tpu.lint.findings import Finding, scan_suppressions
+from pint_tpu.lint.tracehooks import TraceCounters, instrument
+
+__all__ = ["Contract", "ContractReport", "REGISTRY", "dispatch_contract",
+           "check", "audit_contracts", "steady_state_counters",
+           "ContractFixture"]
+
+
+class Contract(NamedTuple):
+    """One entrypoint's declared dispatch budget."""
+
+    name: str
+    max_compiles: int        #: warmup ceiling (one-time cost)
+    max_dispatches: int      #: steady-state ceiling
+    max_transfers: int       #: steady-state ceiling (d2h + h2d)
+    max_host_bytes: int      #: steady-state ceiling
+    warmup: int              #: warmup calls before the measured call
+    qualname: str            #: decorated function, for attribution
+    path: str                #: decoration site (suppression lookup)
+    line: int
+
+
+#: contract name -> Contract, populated at decoration (import) time
+REGISTRY: Dict[str, Contract] = {}
+
+
+def dispatch_contract(name: str, *, max_compiles: int,
+                      max_dispatches: int, max_transfers: int = 8,
+                      max_host_bytes: int = 1 << 22, warmup: int = 1):
+    """Register a dispatch budget for a hot public entrypoint.
+
+    Returns the function unchanged — zero call-time cost.  The audit
+    drives the entrypoint through its driver in this module (a contract
+    without a driver is itself reported, so budgets cannot silently rot).
+    """
+    def deco(fn):
+        import inspect
+
+        try:
+            path = inspect.getsourcefile(fn) or "<unknown>"
+        except TypeError:
+            path = "<unknown>"
+        line = getattr(getattr(fn, "__code__", None), "co_firstlineno", 0)
+        REGISTRY[name] = Contract(
+            name, int(max_compiles), int(max_dispatches),
+            int(max_transfers), int(max_host_bytes), int(warmup),
+            getattr(fn, "__qualname__", str(fn)), path, line)
+        fn.__dispatch_contract__ = name
+        return fn
+
+    return deco
+
+
+class ContractReport(NamedTuple):
+    """Measured warmup/steady counters + findings for one contract."""
+
+    name: str
+    warmup: TraceCounters
+    steady: TraceCounters
+    findings: tuple          # tuple[Finding, ...] (before suppression)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def _ensure_registered() -> None:
+    """Import every module that declares contracts (registration is a
+    decoration side effect)."""
+    import pint_tpu.fitter        # noqa: F401
+    import pint_tpu.gridutils     # noqa: F401
+    import pint_tpu.mcmc          # noqa: F401
+    import pint_tpu.parallel      # noqa: F401
+    import pint_tpu.residuals     # noqa: F401
+    import pint_tpu.runtime       # noqa: F401
+
+
+# --- the synthetic fixture ----------------------------------------------------
+
+# Isolated pulsar with an FD block so the linear/nonlinear design-matrix
+# partition is non-trivial (FD1/FD2 are declared-linear columns); two
+# observing frequencies make them determinable.  Small enough that the
+# whole 10-entrypoint audit compiles in seconds on XLA:CPU.
+_CONTRACT_PAR = """
+PSR CONTRACTAUDIT
+RAJ 05:00:00.0 1
+DECJ 20:00:00.0 1
+F0 300.0 1
+F1 -1.0e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 15.0 1
+FD1 1e-5 1
+FD2 -2e-6 1
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+_NTOAS = 12
+
+
+class ContractFixture:
+    """Lazily-built shared fixture: one tiny narrowband set, a wideband
+    variant, and a frozen-DM grid variant.  Build it OUTSIDE the
+    instrumented region (fixture construction is not part of any
+    budget)."""
+
+    def __init__(self, ntoas: int = _NTOAS):
+        import warnings
+
+        import numpy as np
+
+        from pint_tpu.models import get_model
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.toa import get_TOAs_array
+
+        self.np = np
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            self.model = get_model(_CONTRACT_PAR.strip().splitlines())
+            t = 55000.0 + np.linspace(0.0, 30.0, ntoas)
+            freqs = np.tile([1400.0, 800.0], (ntoas + 1) // 2)[:ntoas]
+            self.toas = get_TOAs_array(
+                t, obs="gbt", errors_us=1.0, freqs_mhz=freqs,
+                ephem="DE421")
+            self.resid = Residuals(self.toas, self.model)
+        self.batch = self.resid.batch
+        self.pdict = self.resid.pdict
+        self.names = list(self.model.free_params)
+        self._cache: dict = {}
+        import tempfile
+
+        self._tmp = tempfile.TemporaryDirectory(prefix="pint_tpu_contract_")
+
+    def tmpfile(self, name: str) -> str:
+        return os.path.join(self._tmp.name, name)
+
+    def wideband(self):
+        """(model, toas, fitter) for the wideband contract."""
+        if "wideband" not in self._cache:
+            import copy
+            import warnings
+
+            from pint_tpu.fitter import WidebandTOAFitter
+            from pint_tpu.simulation import add_wideband_dm_data
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                model = copy.deepcopy(self.model)
+                toas = add_wideband_dm_data(
+                    copy.deepcopy(self.toas), model, dm_error=2e-4)
+                f = WidebandTOAFitter(toas, model)
+            self._cache["wideband"] = (model, toas, f)
+        return self._cache["wideband"]
+
+    def grid_fitter(self):
+        """A WLSFitter with DM frozen, for the grid contracts."""
+        key = "grid_fitter"
+        if key not in self._cache:
+            import copy
+            import warnings
+
+            from pint_tpu.fitter import WLSFitter
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                model = copy.deepcopy(self.model)
+                model.DM.frozen = True
+                self._cache[key] = WLSFitter(self.toas, model)
+        return self._cache[key]
+
+
+# --- per-contract drivers -----------------------------------------------------
+# A driver builds (outside the instrumented region) and returns either
+#   {"call": fn}                      — warmup = fn()*warmup; steady = fn()
+#   {"base": fnA, "extended": fnB}    — marginal mode: steady = B - A
+# All array allocation is hoisted out of the returned callables so the
+# measured counts are the entrypoint's own.
+
+def _drv_residuals(fix: ContractFixture):
+    from pint_tpu.residuals import build_resid_fn
+
+    fn = build_resid_fn(fix.model, fix.batch, fix.resid.track_mode,
+                        True, True)
+    p = fix.pdict
+    return {"call": lambda: fn(p)}
+
+
+def _drv_split_assembly(fix: ContractFixture):
+    from pint_tpu.fitter import build_whitened_assembly
+
+    a = build_whitened_assembly(fix.model, fix.batch, fix.names,
+                                fix.resid.track_mode,
+                                include_offset=True,
+                                design_matrix="split")
+    x0 = fix.np.zeros(len(fix.names))
+    p = fix.pdict
+    return {"call": lambda: a(x0, p)}
+
+
+def _drv_wls_step(fix: ContractFixture):
+    from pint_tpu.fitter import build_wls_step
+
+    step = build_wls_step(fix.model, fix.batch, fix.names,
+                          fix.resid.track_mode)
+    x0 = fix.np.zeros(len(fix.names))
+    p = fix.pdict
+    return {"call": lambda: step(x0, p)}
+
+
+def _drv_gls_step(fix: ContractFixture):
+    from pint_tpu.fitter import build_gls_step
+
+    step = build_gls_step(fix.model, fix.batch, fix.names,
+                          fix.resid.track_mode)
+    x0 = fix.np.zeros(len(fix.names))
+    p = fix.pdict
+    return {"call": lambda: step(x0, p)}
+
+
+def _drv_wideband_step(fix: ContractFixture):
+    _, _, f = fix.wideband()
+    names = f.fit_params
+    step = f._cached_step(names, None, True)
+    x0 = fix.np.zeros(len(names))
+    p = f.resids.pdict
+    return {"call": lambda: step(x0, p)}
+
+
+def _drv_fused_fit(fix: ContractFixture):
+    from pint_tpu.fitter import build_fused_fit
+
+    fit = build_fused_fit(fix.model, fix.batch, fix.names,
+                          fix.resid.track_mode, maxiter=3,
+                          exact_floor=0.0)
+    p = fix.pdict
+    return {"call": lambda: fit(p, p)}
+
+
+def _drv_grid_chunk(fix: ContractFixture):
+    from pint_tpu.gridutils import grid_chisq_flat
+
+    f = fix.grid_fitter()
+    grid = {"DM": fix.np.asarray([14.9, 14.95, 15.0, 15.05])}
+    return {"call": lambda: grid_chisq_flat(f, grid, maxiter=1,
+                                            chunk_size=2)}
+
+
+def _drv_sharded_chunk(fix: ContractFixture):
+    from pint_tpu.parallel import make_mesh, sharded_grid_chisq
+
+    f = fix.grid_fitter()
+    mesh = make_mesh()
+    nb = mesh.devices.shape[0]
+    grid = {"DM": fix.np.asarray([14.9, 14.95, 15.0, 15.05])}
+    return {"call": lambda: sharded_grid_chisq(
+        f, grid, mesh=mesh, maxiter=1, chunk_size=2 * nb)}
+
+
+def _drv_checkpointed_chunk(fix: ContractFixture):
+    from pint_tpu.gridutils import grid_chisq_flat
+
+    f = fix.grid_fitter()
+    # 5 points / chunks of 2: the ragged last chunk exercises the
+    # pad-to-one-compiled-shape path run_checkpointed_scan promises
+    grid = {"DM": fix.np.asarray([14.9, 14.95, 15.0, 15.05, 15.1])}
+    ck = fix.tmpfile("contract_scan.npz")
+    return {"call": lambda: grid_chisq_flat(
+        f, grid, maxiter=1, chunk_size=2, checkpoint=ck)}
+
+
+def _drv_mcmc_step(fix: ContractFixture):
+    import jax.numpy as jnp
+
+    from pint_tpu.mcmc import ensemble_sample
+
+    def lnpost(x):
+        return -0.5 * jnp.sum(x * x)
+
+    x0 = fix.np.asarray([[0.1, 0.0], [0.0, 0.1],
+                         [-0.1, 0.0], [0.0, -0.1]])
+    ck1, ck2 = fix.tmpfile("mcmc_a.npz"), fix.tmpfile("mcmc_b.npz")
+    # marginal mode: the 6-step run re-dispatches the SAME compiled
+    # 2-step chunk two extra times — per-chunk marginal compiles must
+    # be zero (the one-compiled-chunk-shape property)
+    return {
+        "base": lambda: ensemble_sample(lnpost, x0, nsteps=2, seed=1,
+                                        checkpoint=ck1,
+                                        checkpoint_every=2),
+        "extended": lambda: ensemble_sample(lnpost, x0, nsteps=6, seed=1,
+                                            checkpoint=ck2,
+                                            checkpoint_every=2),
+    }
+
+
+_DRIVERS: Dict[str, Callable[[ContractFixture], dict]] = {
+    "residuals": _drv_residuals,
+    "split_assembly": _drv_split_assembly,
+    "wls_step": _drv_wls_step,
+    "gls_step": _drv_gls_step,
+    "wideband_step": _drv_wideband_step,
+    "fused_fit": _drv_fused_fit,
+    "grid_chunk": _drv_grid_chunk,
+    "sharded_chunk": _drv_sharded_chunk,
+    "checkpointed_chunk": _drv_checkpointed_chunk,
+    "mcmc_step": _drv_mcmc_step,
+}
+
+
+# --- measurement + judgment ---------------------------------------------------
+
+def steady_state_counters(call: Callable[[], object], *,
+                          warmup: int = 1):
+    """(warmup, steady) :class:`TraceCounters` for ``call`` — the shared
+    measurement primitive tests use directly (single source of truth for
+    "N dispatches per step" style assertions)."""
+    with instrument() as th:
+        m0 = th.mark()
+        for _ in range(max(1, warmup)):
+            call()
+        m1 = th.mark()
+        call()
+        m2 = th.mark()
+    return (m1 - m0), (m2 - m1)
+
+
+def _measure(driver: dict, warmup: int):
+    if "call" in driver:
+        return steady_state_counters(driver["call"], warmup=warmup)
+    with instrument() as th:
+        m0 = th.mark()
+        driver["base"]()
+        m1 = th.mark()
+        driver["extended"]()
+        m2 = th.mark()
+    base, ext = (m1 - m0), (m2 - m1)
+    # marginal steady state: what the extra chunks cost beyond the base
+    # run (both runs rebuild their programs, so identical one-time work
+    # cancels; only per-chunk costs survive the subtraction)
+    return base, ext - base
+
+
+def _judge(c: Contract, warm: TraceCounters,
+           steady: TraceCounters) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def f(code: str, msg: str):
+        findings.append(Finding(
+            code, c.path, c.line, 1,
+            f"contract '{c.name}' ({c.qualname}): {msg}",
+            source=f"@dispatch_contract('{c.name}')", origin="contract"))
+
+    n_re = len(steady.retraces)
+    if n_re or steady.compiles > 0:
+        parts = []
+        for ev in steady.retraces[:3]:
+            parts.append(f"{ev.fn_name}: {ev.component}")
+        attribution = "; ".join(parts) if parts else \
+            "recompile without a visible tracing-cache miss " \
+            "(executable-cache key changed)"
+        f("CONTRACT002",
+          f"steady-state retrace/recompile ({n_re} retrace(s), "
+          f"{steady.compiles} compile(s)) — unstable cache-key "
+          f"component: {attribution}")
+    for axis, got, limit in (
+            ("dispatches", steady.dispatches, c.max_dispatches),
+            ("transfers", steady.transfers, c.max_transfers),
+            ("host_bytes", steady.host_bytes, c.max_host_bytes)):
+        if got > limit:
+            f("CONTRACT001",
+              f"steady-state {axis} = {got} exceeds budget {limit}")
+    if warm.compiles > c.max_compiles:
+        f("CONTRACT001",
+          f"warmup compiles = {warm.compiles} exceeds budget "
+          f"{c.max_compiles}")
+    return findings
+
+
+def check(name: str,
+          fixture: Optional[ContractFixture] = None) -> ContractReport:
+    """Measure one contract and judge it against its declared budget."""
+    _ensure_registered()
+    c = REGISTRY.get(name)
+    if c is None:
+        raise KeyError(f"no dispatch contract named {name!r} "
+                       f"(registered: {sorted(REGISTRY)})")
+    builder = _DRIVERS.get(name)
+    if builder is None:
+        return ContractReport(name, TraceCounters(), TraceCounters(), (
+            Finding("CONTRACT001", c.path, c.line, 1,
+                    f"contract '{name}' has no audit driver — add one to "
+                    "pint_tpu/lint/contracts.py so the budget is "
+                    "enforced", source=f"@dispatch_contract('{name}')",
+                    origin="contract"),))
+    fix = fixture if fixture is not None else ContractFixture()
+    driver = builder(fix)
+    warm, steady = _measure(driver, c.warmup)
+    return ContractReport(name, warm, steady,
+                          tuple(_judge(c, warm, steady)))
+
+
+_SUPPRESS_CACHE: dict = {}
+
+
+def _suppressed(c: Contract, code: str) -> bool:
+    """Shared ``# ddlint: disable=`` suppression at (or within 2 lines
+    of) the decoration site sanctions a breach."""
+    sup = _SUPPRESS_CACHE.get(c.path)
+    if sup is None:
+        try:
+            with open(c.path, encoding="utf-8") as fh:
+                sup = scan_suppressions(fh.read())
+        except OSError:
+            sup = scan_suppressions("")
+        _SUPPRESS_CACHE[c.path] = sup
+    return any(sup.is_suppressed(code, ln)
+               for ln in range(max(1, c.line - 2), c.line + 3))
+
+
+def audit_contracts(names: Optional[Sequence[str]] = None,
+                    fixture: Optional[ContractFixture] = None
+                    ) -> List[Finding]:
+    """Drive every registered contract (or the named subset) and return
+    the unsanctioned findings — the ``--contracts`` CLI mode and the
+    tier-1 gate (tests/test_contracts.py)."""
+    _ensure_registered()
+    targets = sorted(REGISTRY) if names is None else list(names)
+    unknown = [n for n in targets if n not in REGISTRY]
+    if unknown:
+        raise KeyError(f"unknown contract(s) {unknown}; registered: "
+                       f"{sorted(REGISTRY)}")
+    fix = fixture if fixture is not None else ContractFixture()
+    findings: List[Finding] = []
+    for name in targets:
+        rep = check(name, fixture=fix)
+        for f in rep.findings:
+            if not _suppressed(REGISTRY[name], f.code):
+                findings.append(f)
+    return findings

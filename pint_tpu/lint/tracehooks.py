@@ -1,0 +1,303 @@
+"""XLA-boundary instrumentation: compiles, dispatches, transfers, retraces.
+
+The AST rules and the jaxpr audit see *programs*; what they cannot see
+is what the runtime actually DOES — how many times XLA compiled, how
+many executables were dispatched, how many device<->host crossings
+happened, and whether a jit cache key quietly changed between two calls
+that "should" have been identical.  Those are exactly the quantities
+the package's performance claims are made of (fused fit = 1 dispatch +
+1 fetch; checkpointed scans compile ONE chunk shape), so this module
+gives the linter eyes at that boundary:
+
+* **compiles** — :func:`jax._src.compiler.backend_compile` wrapped (the
+  single funnel every XLA compilation goes through, cached or not).
+* **dispatches** — ``pxla.ExecuteReplicated.__call__`` wrapped.  The
+  C++ pjit fastpath normally bypasses Python dispatch entirely, so for
+  the duration of the instrumentation ``pjit._get_fastpath_data`` is
+  forced to ``None`` and the two C++ ``PjitFunctionCache``\\ s are
+  cleared on entry: already-compiled programs then route through the
+  Python dispatch path (their tracing/executable caches stay warm — no
+  recompilation is induced; each call just pays Python-call overhead,
+  which is why this is an audit harness and not an always-on profiler).
+* **transfers** — device->host materializations via the
+  ``ArrayImpl._value`` property (``float()``/``.item()``/``.tolist()``/
+  ``jax.device_get``/``__array__``) with byte accounting, and
+  host->device staging via ``jax.device_put``.  NOTE on the CPU
+  backend ``np.asarray(arr)`` is a zero-copy buffer-protocol view and
+  does not materialize — the counted transfers are therefore a
+  conservative floor (on a real accelerator every one of these is a
+  tunnel round trip).
+* **block_until_ready** — explicit synchronization points.
+* **retraces** — ``jax_explain_cache_misses`` is enabled and the
+  explanation log (``jax._src.pjit``) captured; each record is parsed
+  into a :class:`RetraceEvent` naming the traced function and the
+  unstable cache-key component (shapes / dtypes / weak_type / pytree
+  structure / function identity / tracing context).
+
+Patching follows the same save-patch-restore discipline as
+:mod:`pint_tpu.faultinject`; only one :func:`instrument` context may be
+active at a time, and counter updates are lock-guarded so concurrently
+dispatching threads cannot lose events.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import re
+import threading
+from typing import Iterator, List, NamedTuple, Optional
+
+__all__ = ["TraceCounters", "RetraceEvent", "Instrumentation",
+           "instrument", "is_active"]
+
+
+class RetraceEvent(NamedTuple):
+    """One steady-state-relevant tracing-cache miss."""
+
+    fn_name: str      #: the traced function ("f", "run", ...)
+    component: str    #: unstable cache-key component ("weak_type", ...)
+    message: str      #: the full explanation text (jax's own words)
+
+
+class TraceCounters(NamedTuple):
+    """A snapshot (or delta) of the instrumented quantities."""
+
+    compiles: int = 0
+    dispatches: int = 0
+    transfers_d2h: int = 0
+    transfers_h2d: int = 0
+    host_bytes: int = 0
+    block_until_ready: int = 0
+    retraces: tuple = ()          # tuple[RetraceEvent, ...]
+
+    def __sub__(self, other: "TraceCounters") -> "TraceCounters":
+        """Componentwise difference (marginal-cost measurements); the
+        retrace tuple keeps the events beyond ``other``'s count."""
+        return TraceCounters(
+            self.compiles - other.compiles,
+            self.dispatches - other.dispatches,
+            self.transfers_d2h - other.transfers_d2h,
+            self.transfers_h2d - other.transfers_h2d,
+            self.host_bytes - other.host_bytes,
+            self.block_until_ready - other.block_until_ready,
+            self.retraces[len(other.retraces):])
+
+    @property
+    def transfers(self) -> int:
+        return self.transfers_d2h + self.transfers_h2d
+
+    def as_dict(self) -> dict:
+        return {"compiles": self.compiles, "dispatches": self.dispatches,
+                "transfers": self.transfers,
+                "host_bytes": self.host_bytes,
+                "block_until_ready": self.block_until_ready,
+                "retraces": len(self.retraces)}
+
+
+# --- retrace-explanation parsing ---------------------------------------------
+
+_FN_FOR_RE = re.compile(r"^\s*for (\S+?)(?: defined at| id=|$)", re.M)
+_FN_NEVER_RE = re.compile(r"never seen function:\s*\n\s*(\S+?) id=")
+_TYPEPAIR_RE = re.compile(
+    r"seen ([a-z_]+[0-9]*)\[([0-9,]*)\][^,]*, but now given "
+    r"([a-z_]+[0-9]*)\[([0-9,]*)\]")
+
+
+def classify_retrace(message: str) -> RetraceEvent:
+    """Parse one ``TRACING CACHE MISS`` explanation into (fn, unstable
+    cache-key component).  The component vocabulary is what the contract
+    findings report: ``weak_type`` / ``dtypes`` / ``shapes`` /
+    ``input pytree structure`` / ``function identity`` /
+    ``tracing context`` / ``args-kwargs signature`` / ``cache key``."""
+    fn = "<unknown>"
+    m = _FN_NEVER_RE.search(message)
+    if m:
+        return RetraceEvent(m.group(1),
+                            "function identity (new function object per "
+                            "call — jit wrapper re-created instead of "
+                            "cached)", message)
+    m = _FN_FOR_RE.search(message)
+    if m:
+        fn = m.group(1)
+    if "weak_type=" in message:
+        return RetraceEvent(fn, "weak_type (Python scalar vs jax.Array "
+                                "spelling of the same value)", message)
+    if "never seen input type signature" in message:
+        pairs = _TYPEPAIR_RE.findall(message)
+        if any(a != b for a, _, b, _ in pairs):
+            return RetraceEvent(fn, "dtypes", message)
+        if any(sa != sb for _, sa, _, sb in pairs):
+            return RetraceEvent(fn, "shapes", message)
+        return RetraceEvent(fn, "input types", message)
+    if "never seen input pytree" in message:
+        return RetraceEvent(fn, "input pytree structure", message)
+    if "tracing context" in message:
+        return RetraceEvent(fn, "tracing context (config/manager state)",
+                            message)
+    if "never seen passing" in message:
+        return RetraceEvent(fn, "args/kwargs signature", message)
+    return RetraceEvent(fn, "cache key (unclassified)", message)
+
+
+class _RetraceHandler(logging.Handler):
+    def __init__(self, inst: "Instrumentation"):
+        super().__init__(level=logging.WARNING)
+        self._inst = inst
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "TRACING CACHE MISS" not in msg:
+            return
+        ev = classify_retrace(msg)
+        with self._inst._lock:
+            self._inst._retraces.append(ev)
+
+
+# --- the instrumentation context ---------------------------------------------
+
+_ACTIVE: Optional["Instrumentation"] = None
+
+
+def is_active() -> bool:
+    return _ACTIVE is not None
+
+
+class Instrumentation:
+    """Live counters for one :func:`instrument` context.
+
+    ``mark()`` returns an opaque snapshot; ``since(mark)`` the
+    :class:`TraceCounters` delta from that snapshot to now — the
+    warmup/steady phase arithmetic the contract harness is built on.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._compiles = 0
+        self._dispatches = 0
+        self._d2h = 0
+        self._h2d = 0
+        self._host_bytes = 0
+        self._block = 0
+        self._retraces: List[RetraceEvent] = []
+
+    # -- reading -----------------------------------------------------------
+    def counters(self) -> TraceCounters:
+        with self._lock:
+            return TraceCounters(self._compiles, self._dispatches,
+                                 self._d2h, self._h2d, self._host_bytes,
+                                 self._block, tuple(self._retraces))
+
+    def mark(self) -> TraceCounters:
+        return self.counters()
+
+    def since(self, mark: TraceCounters) -> TraceCounters:
+        return self.counters() - mark
+
+
+@contextlib.contextmanager
+def instrument() -> Iterator[Instrumentation]:
+    """Install the XLA-boundary hooks; restores everything on exit.
+
+    Not reentrant (one audit at a time — the patched functions are
+    process-global, so nesting would double-count)."""
+    global _ACTIVE
+
+    if _ACTIVE is not None:
+        raise RuntimeError("tracehooks.instrument() is already active")
+
+    import jax
+    from jax._src import array as _array
+    from jax._src import compiler as _compiler
+    from jax._src import pjit as _pjit
+    from jax._src.interpreters import pxla as _pxla
+
+    inst = Instrumentation()
+
+    orig_backend_compile = _compiler.backend_compile
+    orig_exec_call = _pxla.ExecuteReplicated.__call__
+    orig_fastpath = _pjit._get_fastpath_data
+    orig_value = _array.ArrayImpl.__dict__["_value"]
+    orig_block = _array.ArrayImpl.__dict__.get("block_until_ready")
+    orig_device_put = jax.device_put
+    orig_explain = jax.config.jax_explain_cache_misses
+
+    def backend_compile(*a, **k):
+        with inst._lock:
+            inst._compiles += 1
+        return orig_backend_compile(*a, **k)
+
+    def exec_call(self, *args):
+        with inst._lock:
+            inst._dispatches += 1
+        return orig_exec_call(self, *args)
+
+    def value_getter(self):
+        out = orig_value.fget(self)
+        with inst._lock:
+            inst._d2h += 1
+            inst._host_bytes += int(getattr(out, "nbytes", 0))
+        return out
+
+    def block_until_ready(self, *a, **k):
+        with inst._lock:
+            inst._block += 1
+        return orig_block(self, *a, **k)
+
+    def device_put(x, *a, **k):
+        with inst._lock:
+            inst._h2d += 1
+            inst._host_bytes += sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(x))
+        return orig_device_put(x, *a, **k)
+
+    handler = _RetraceHandler(inst)
+    pjit_logger = logging.getLogger("jax._src.pjit")
+    # explanations must reach OUR handler but not spam the user's
+    # stderr (explain_cache_misses also makes the persistent-cache
+    # layer chatty at WARNING); both restored on exit
+    orig_propagate = pjit_logger.propagate
+    compiler_logger = logging.getLogger("jax._src.compiler")
+    orig_compiler_level = compiler_logger.level
+    cache_logger = logging.getLogger("jax._src.compilation_cache")
+    orig_cache_level = cache_logger.level
+
+    _compiler.backend_compile = backend_compile
+    _pxla.ExecuteReplicated.__call__ = exec_call
+    _pjit._get_fastpath_data = lambda *a, **k: None
+    _array.ArrayImpl._value = property(value_getter)
+    if callable(orig_block):
+        _array.ArrayImpl.block_until_ready = block_until_ready
+    jax.device_put = device_put
+    pjit_logger.addHandler(handler)
+    pjit_logger.propagate = False
+    compiler_logger.setLevel(logging.ERROR)
+    cache_logger.setLevel(logging.ERROR)
+    jax.config.update("jax_explain_cache_misses", True)
+    # evict the C++ fastpath entries of ALREADY-warm programs so their
+    # dispatches route through the (counted) Python path; tracing and
+    # executable caches are untouched — no recompilation is induced
+    try:
+        _pjit._cpp_pjit_cache_fun_only.clear()
+        _pjit._cpp_pjit_cache_explicit_attributes.clear()
+    except Exception:   # cache layout differs on some jax versions
+        pass
+
+    _ACTIVE = inst
+    try:
+        yield inst
+    finally:
+        _ACTIVE = None
+        _compiler.backend_compile = orig_backend_compile
+        _pxla.ExecuteReplicated.__call__ = orig_exec_call
+        _pjit._get_fastpath_data = orig_fastpath
+        _array.ArrayImpl._value = orig_value
+        if callable(orig_block):
+            _array.ArrayImpl.block_until_ready = orig_block
+        jax.device_put = orig_device_put
+        pjit_logger.removeHandler(handler)
+        pjit_logger.propagate = orig_propagate
+        compiler_logger.setLevel(orig_compiler_level)
+        cache_logger.setLevel(orig_cache_level)
+        jax.config.update("jax_explain_cache_misses", orig_explain)

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu.lint.contracts import dispatch_contract
+
 __all__ = ["ensemble_sample", "hmc_sample", "MCMCFitter"]
 
 
@@ -46,6 +48,8 @@ class EnsembleResult(NamedTuple):
     acceptance: float
 
 
+@dispatch_contract("mcmc_step", max_compiles=30, max_dispatches=4,
+                   max_transfers=6)
 def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
                     a: float = 2.0, thin: int = 1,
                     checkpoint: str = None, checkpoint_every: int = 0,
@@ -111,15 +115,23 @@ def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
     # per-step keys indexed by ABSOLUTE step number (fold_in, not
     # split(key, nsteps): split hashes the total count into every key on
     # this jax version, so a 40-step and a 60-step run would draw
-    # unrelated sequences and resume could not be bitwise)
+    # unrelated sequences and resume could not be bitwise).  Fetched to
+    # host ONCE: the per-chunk loop below slices them with numpy —
+    # device-array slicing (`keys[k:k2]`, `chain[-1]`) eagerly
+    # dispatches several scalar index ops PER CHUNK (~15 extra tunnel
+    # round trips each on a networked TPU; found by the dispatch-
+    # contract audit, pint_tpu.lint.contracts "mcmc_step").
     _base_key = jax.random.PRNGKey(seed)
-    keys = jax.vmap(lambda i: jax.random.fold_in(_base_key, i))(
-        jnp.arange(nsteps))
+    keys = np.asarray(jax.vmap(
+        lambda i: jax.random.fold_in(_base_key, i))(jnp.arange(nsteps)))
 
     @jax.jit
     def run(x0, lnp0, keys):
-        (_, _), (chain, lnps, nacc) = jax.lax.scan(step, (x0, lnp0), keys)
-        return chain, lnps, jnp.sum(nacc)
+        # the final carry rides the same transfer as the chain so the
+        # chunk loop never indexes device arrays eagerly
+        (xf, lnpf), (chain, lnps, nacc) = jax.lax.scan(
+            step, (x0, lnp0), keys)
+        return xf, lnpf, chain, lnps, jnp.sum(nacc)
 
     chains, lnplist = [], []
     nacc_total = 0.0
@@ -165,11 +177,12 @@ def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
         else nsteps
     while k < nsteps:
         k2 = min(nsteps, k + chunk)
-        c, lp, nacc = run(x, lnp, keys[k:k2])
-        x, lnp = c[-1], lp[-1]
-        chains.append(np.asarray(c))
-        lnplist.append(np.asarray(lp))
-        nacc_total += float(nacc)
+        x, lnp, c, lp, nacc = run(x, lnp, jnp.asarray(keys[k:k2]))
+        # ONE fetch per checkpoint chunk (bounded by n_chunks, not
+        # nsteps) — the chain must live on host to be checkpointable
+        chains.append(np.asarray(c))           # ddlint: disable=TRACE002
+        lnplist.append(np.asarray(lp))         # ddlint: disable=TRACE002
+        nacc_total += float(nacc)              # ddlint: disable=TRACE002
         k = k2
         _save()
     chain = np.concatenate(chains)

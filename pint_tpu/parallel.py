@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pint_tpu.lint.contracts import dispatch_contract
+
 try:  # jax >= 0.8 public API; fall back for older jax
     from jax import shard_map as _shard_map
 
@@ -269,6 +271,8 @@ def _chunk_values(gvals: Dict[str, np.ndarray], lo: int, hi: int,
     return out
 
 
+@dispatch_contract("sharded_chunk", max_compiles=60, max_dispatches=12,
+                   max_transfers=4)
 def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
                        mesh: Optional[Mesh] = None,
                        maxiter: int = 2, *,

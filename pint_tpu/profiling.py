@@ -35,6 +35,11 @@ and stage ``assemble.jacfwd_nonlinear`` the per-step nonlinear-core
 block (primal + JVPs).  A split-path step is 1 ``jit_call`` (plus 1 per
 refresh) where the full-jacfwd path is 2 — asserted by
 ``tests/test_design_split.py``.
+* ``snapshot()/counters_since()/stages_since()`` — delta accounting
+  (ISSUE 5): counter updates are lock-guarded and harnesses measure
+  against a snapshot instead of calling ``reset()``, so a contract
+  audit and a checkpointed scan running in the same process cannot
+  cross-contaminate (a reset in one used to wipe the other's baseline).
 * ``enable()/disable()/report()/reset()`` — session control.  When
   enabled, stage exits ``block_until_ready`` on nothing — timing is
   attributed where the *wait* happens, which over an async runtime
@@ -55,16 +60,23 @@ Typical use::
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, NamedTuple, Optional
 
 __all__ = ["enable", "disable", "enabled", "reset", "report", "table",
-           "stage", "count", "counters", "session", "paused", "trace",
-           "Session", "device_peak_flops", "solve_flops", "mfu_report"]
+           "stage", "count", "counters", "snapshot", "counters_since",
+           "stages_since", "session", "paused", "trace",
+           "Session", "Snapshot", "device_peak_flops", "solve_flops",
+           "mfu_report"]
 
 _enabled = False
 _stages: Dict[str, list] = {}   # name -> [calls, wall_s]
 _counters: Dict[str, int] = {}
+#: guards the module-global stage/counter tables: contract audits,
+#: checkpointed scans and bench sessions may count from concurrent
+#: threads, and a torn read-modify-write would silently lose events
+_lock = threading.Lock()
 
 
 def enable() -> None:
@@ -82,8 +94,57 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    _stages.clear()
-    _counters.clear()
+    """Clear the module-global tables.  Prefer :func:`snapshot` +
+    :func:`counters_since` in harnesses: a reset() wipes every OTHER
+    observer's baseline (the cross-contamination bug between contract
+    audits and checkpointed scans), while snapshots compose."""
+    with _lock:
+        _stages.clear()
+        _counters.clear()
+
+
+class Snapshot(NamedTuple):
+    """An immutable copy of the tables at one instant (see
+    :func:`snapshot`)."""
+
+    stages: Dict[str, tuple]     # name -> (calls, wall_s)
+    counters: Dict[str, int]
+
+
+def snapshot() -> Snapshot:
+    """Capture the current tables; pair with :func:`counters_since` /
+    :func:`stages_since` for delta accounting that cannot be poisoned
+    by (or poison) a concurrent harness's reset()."""
+    with _lock:
+        return Snapshot({k: (v[0], v[1]) for k, v in _stages.items()},
+                        dict(_counters))
+
+
+def counters_since(snap: Snapshot) -> Dict[str, int]:
+    """Counter increments since ``snap`` (zero/negative deltas dropped;
+    a reset() between snapshots floors at zero rather than going
+    negative)."""
+    with _lock:
+        now = dict(_counters)
+    out = {}
+    for k, v in now.items():
+        d = v - snap.counters.get(k, 0)
+        if d > 0:
+            out[k] = d
+    return out
+
+
+def stages_since(snap: Snapshot) -> Dict[str, Dict[str, float]]:
+    """Stage (calls, wall_s) accumulated since ``snap``."""
+    with _lock:
+        now = {k: (v[0], v[1]) for k, v in _stages.items()}
+    out = {}
+    for k, (calls, wall) in now.items():
+        c0, w0 = snap.stages.get(k, (0, 0.0))
+        if calls - c0 > 0:
+            out[k] = {"calls": calls - c0,
+                      "wall_s": round(max(0.0, wall - w0), 4)}
+    return out
 
 
 @contextlib.contextmanager
@@ -97,39 +158,46 @@ def stage(name: str) -> Iterator[None]:
         yield
     finally:
         dt = time.perf_counter() - t0
-        s = _stages.setdefault(name, [0, 0.0])
-        s[0] += 1
-        s[1] += dt
+        with _lock:
+            s = _stages.setdefault(name, [0, 0.0])
+            s[0] += 1
+            s[1] += dt
 
 
 def count(name: str, n: int = 1) -> None:
     """Increment dispatch counter ``name`` (always on: integers are free,
     and the dispatch-budget tests must not require profiling mode)."""
-    _counters[name] = _counters.get(name, 0) + n
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
 
 
 def counters() -> Dict[str, int]:
-    return dict(_counters)
+    with _lock:
+        return dict(_counters)
 
 
 def report() -> Dict[str, Dict[str, float]]:
-    out = {k: {"calls": v[0], "wall_s": round(v[1], 4)}
-           for k, v in sorted(_stages.items())}
-    if _counters:
-        out["_dispatches"] = dict(_counters)
+    with _lock:
+        out = {k: {"calls": v[0], "wall_s": round(v[1], 4)}
+               for k, v in sorted(_stages.items())}
+        if _counters:
+            out["_dispatches"] = dict(_counters)
     return out
 
 
 def table() -> str:
     """The per-stage table, reference-style (prfparser's aligned rows)."""
+    with _lock:
+        stages = {k: (v[0], v[1]) for k, v in _stages.items()}
+        counts = dict(_counters)
     rows = [f"{'stage':<24s} {'calls':>7s} {'wall_s':>10s}"]
     total = 0.0
-    for k, (calls, wall) in sorted(_stages.items(),
+    for k, (calls, wall) in sorted(stages.items(),
                                    key=lambda kv: -kv[1][1]):
         rows.append(f"{k:<24s} {calls:>7d} {wall:>10.3f}")
         total += wall
     rows.append(f"{'TOTAL (attributed)':<24s} {'':>7s} {total:>10.3f}")
-    for k, v in sorted(_counters.items()):
+    for k, v in sorted(counts.items()):
         rows.append(f"  dispatches[{k}] = {v}")
     return "\n".join(rows)
 
@@ -177,16 +245,23 @@ def paused() -> Iterator[None]:
 
 @contextlib.contextmanager
 def session() -> Iterator[Session]:
-    """Enable profiling, reset counters, and capture a report on exit."""
+    """Enable profiling and capture this session's DELTAS on exit.
+
+    Snapshot-based (not reset-based) since ISSUE 5: two overlapping
+    harnesses — a contract audit inside a checkpointed scan, nested
+    bench sessions — each see only their own increments, instead of the
+    inner session wiping the outer one's baseline."""
     was = _enabled
-    reset()
+    snap = snapshot()
     enable()
     s = Session()
     try:
         yield s
     finally:
-        s.stages = report()
-        s.dispatches = counters()
+        s.stages = stages_since(snap)
+        s.dispatches = counters_since(snap)
+        if s.dispatches:
+            s.stages["_dispatches"] = dict(s.dispatches)
         if not was:
             disable()
 

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import qs
+from pint_tpu.lint.contracts import dispatch_contract
 from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.toabatch import TOABatch
 
@@ -72,10 +73,21 @@ def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
     return out
 
 
+@dispatch_contract("residuals", max_compiles=30, max_dispatches=1,
+                   max_transfers=1)
 def build_resid_fn(model: TimingModel, batch: TOABatch,
                    track_mode: str, subtract_mean: bool, use_weights: bool):
     """A jitted ``(pdict) -> phase residuals [cycles]`` closure over the
-    static model structure and TOA data."""
+    static model structure and TOA data.
+
+    Dispatch contract: a steady-state evaluation is ONE jitted call on a
+    resident pytree — audited by ``pint_tpu.lint.contracts``.  The
+    ``retrace_storm``/``chatty_transfer`` failpoints
+    (:mod:`pint_tpu.faultinject`) wrap the returned function so the
+    contract auditor can be proven to catch real cache-key churn and
+    per-call host chatter."""
+    from pint_tpu import faultinject
+
     calc = model.calc
     noise = bool(model.noise_components)
 
@@ -85,7 +97,8 @@ def build_resid_fn(model: TimingModel, batch: TOABatch,
         return raw_phase_resids(calc, p, batch, track_mode,
                                 subtract_mean, use_weights, sigma_us=sigma)
 
-    return fn
+    return faultinject.wrap(
+        "retrace_storm", faultinject.wrap("chatty_transfer", fn))
 
 
 class Residuals:

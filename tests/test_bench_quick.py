@@ -55,7 +55,8 @@ def _assert_schema(d):
     for key, typ in (("metric", str), ("unit", str), ("backend", str),
                      ("mode", str), ("design_matrix", str),
                      ("dataset", str), ("submetrics", dict),
-                     ("backend_rung", str), ("probe_attempts", int)):
+                     ("backend_rung", str), ("probe_attempts", int),
+                     ("dispatch_counters", dict)):
         assert isinstance(d.get(key), typ), (key, d.get(key))
     assert isinstance(d["probe_wait_s"], (int, float))
     assert d["unit"] == "s"
@@ -63,6 +64,23 @@ def _assert_schema(d):
     assert d["backend"] in ("cpu", "cpu_fallback")
     assert d["backend_rung"] in ("cpu", "accelerator", "cpu_fallback")
     assert d["design_matrix"] in ("split", "full")
+    # steady-state XLA-boundary counters (ISSUE 5): the regression axis
+    # beyond wall-clock, measured by pint_tpu.lint.tracehooks
+    dc = d["dispatch_counters"]
+    for key in ("compiles", "dispatches", "transfers", "host_bytes",
+                "retraces"):
+        assert isinstance(dc.get(key), int), (key, dc.get(key))
+    assert dc["dispatches"] >= 1          # the fit really ran
+
+
+def test_quick_steady_state_never_recompiles(quick_line):
+    """ISSUE 5 satellite: the counters give BENCH_r* a regression axis
+    beyond wall-clock — a warm quick fit must show ZERO steady-state
+    compiles and retraces (a stray retrace here is exactly the failure
+    the dispatch-contract gate exists to catch)."""
+    dc = quick_line["dispatch_counters"]
+    assert dc["compiles"] == 0, dc
+    assert dc["retraces"] == 0, dc
 
 
 def test_schema(quick_line):

@@ -1,0 +1,182 @@
+"""The dispatch-contract gate (ISSUE 5): every hot public entrypoint's
+declared compile/dispatch/transfer budget, audited at the XLA boundary.
+
+Three legs:
+
+* **clean** — ``audit_contracts()`` over every registered entrypoint
+  returns zero findings, and every entrypoint's steady-state call shows
+  ZERO recompiles and ZERO retraces (the acceptance invariant: the
+  package never pays per-step tracing in steady state).
+* **seeded regressions** — under the ``retrace_storm`` /
+  ``chatty_transfer`` failpoints the auditor FAILS, with per-entrypoint
+  attribution naming the unstable cache-key component (the proof the
+  gate catches the real failure modes, not a vacuous pass).
+* **machinery** — unknown contract names are rejected, a contract
+  without an audit driver is itself a finding, and the shared
+  measurement primitive exposes warmup/steady deltas.
+
+The console/JSON subprocess leg lives in ``tests/test_tooling.py``.
+Opt out on WIP branches with ``PINT_TPU_SKIP_CONTRACTS=1`` (also
+honored by conftest.py, which marks this module ``contracts``).
+"""
+
+import os
+
+import pytest
+
+from pint_tpu import faultinject
+from pint_tpu.lint import contracts
+from pint_tpu.lint.contracts import (
+    REGISTRY,
+    ContractFixture,
+    audit_contracts,
+    check,
+    dispatch_contract,
+    steady_state_counters,
+)
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PINT_TPU_SKIP_CONTRACTS") == "1",
+    reason="PINT_TPU_SKIP_CONTRACTS=1")
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    """One shared synthetic fixture for every audit in the module (the
+    expensive part is the model/TOA build, not the instrumented runs)."""
+    return ContractFixture()
+
+
+@pytest.fixture(scope="module")
+def reports(fixture):
+    """Every registered contract measured ONCE; the clean-leg tests
+    below each assert a different property of the same run."""
+    contracts._ensure_registered()
+    return {name: check(name, fixture=fixture) for name in sorted(REGISTRY)}
+
+
+class TestCleanLeg:
+    def test_registry_covers_the_hot_surface(self):
+        """The decorator adoption actually happened: every entrypoint
+        the tentpole names is registered (a dropped decorator would
+        silently shrink the audited surface)."""
+        contracts._ensure_registered()
+        assert {"residuals", "split_assembly", "wls_step", "gls_step",
+                "wideband_step", "fused_fit", "grid_chunk",
+                "sharded_chunk", "checkpointed_chunk",
+                "mcmc_step"} <= set(REGISTRY)
+
+    def test_every_contract_has_a_driver(self):
+        contracts._ensure_registered()
+        missing = set(REGISTRY) - set(contracts._DRIVERS)
+        assert not missing, f"contracts without audit drivers: {missing}"
+
+    def test_audit_passes_clean(self, fixture):
+        """THE tier-1 gate: zero unsanctioned findings over every
+        registered entrypoint."""
+        findings = audit_contracts(fixture=fixture)
+        assert findings == [], [f.format() for f in findings]
+
+    def test_zero_steady_state_recompiles_everywhere(self, reports):
+        """The acceptance invariant, asserted per entrypoint: the
+        steady-state call never recompiles and never retraces — a
+        stray ``float()`` or unstable cache key shows up HERE."""
+        for name, rep in reports.items():
+            assert rep.steady.compiles == 0, (
+                f"{name}: {rep.steady.compiles} steady-state compile(s)")
+            assert not rep.steady.retraces, (
+                f"{name}: steady-state retrace — "
+                + "; ".join(f"{e.fn_name}: {e.component}"
+                            for e in rep.steady.retraces))
+
+    def test_budgets_are_meaningfully_tight(self, reports):
+        """The headline invariants are measured, not just bounded: the
+        fused fit really is ONE dispatch, the split assembly really is
+        ONE device program on the cache-hit path."""
+        assert reports["fused_fit"].steady.dispatches == 1
+        assert reports["split_assembly"].steady.dispatches <= 2
+        assert reports["residuals"].steady.dispatches == 1
+
+
+class TestSeededRegressions:
+    def test_retrace_storm_fails_with_attribution(self, fixture):
+        """The jit-inside-the-loop regression: every steady-state call
+        re-jits a fresh wrapper.  The auditor must fail CONTRACT002 and
+        name the unstable cache-key component — function identity."""
+        with faultinject.retrace_storm():
+            rep = check("residuals", fixture=fixture)
+        codes = [f.code for f in rep.findings]
+        assert "CONTRACT002" in codes, codes
+        msg = next(f.message for f in rep.findings
+                   if f.code == "CONTRACT002")
+        assert "function identity" in msg, msg
+        assert "residuals" in msg
+
+    def test_chatty_transfer_fails_on_budget(self, fixture):
+        """The stray-float()-in-the-hot-loop regression: per-element
+        host pulls after every call.  The auditor must fail CONTRACT001
+        on the dispatch/transfer budget."""
+        with faultinject.chatty_transfer():
+            rep = check("residuals", fixture=fixture)
+        breaches = [f.message for f in rep.findings
+                    if f.code == "CONTRACT001"]
+        assert breaches, [f.format() for f in rep.findings]
+        assert any("dispatches" in m or "transfers" in m
+                   for m in breaches), breaches
+
+    def test_clean_after_failpoint_exit(self, fixture):
+        """Failpoints restore on exit: the same contract audited right
+        after the storm is clean again (no leaked wrapper state)."""
+        rep = check("residuals", fixture=fixture)
+        assert rep.ok, [f.format() for f in rep.findings]
+
+
+class TestMachinery:
+    def test_unknown_contract_rejected(self, fixture):
+        with pytest.raises(KeyError, match="no_such_contract"):
+            audit_contracts(["no_such_contract"], fixture=fixture)
+        with pytest.raises(KeyError, match="registered"):
+            check("no_such_contract", fixture=fixture)
+
+    def test_driverless_contract_is_a_finding(self, fixture):
+        """A budget nobody audits is worse than no budget: declaring a
+        contract without adding a driver is itself reported."""
+        @dispatch_contract("_test_orphan", max_compiles=1,
+                           max_dispatches=1)
+        def orphan():
+            pass
+
+        try:
+            rep = check("_test_orphan", fixture=fixture)
+            assert not rep.ok
+            assert "no audit driver" in rep.findings[0].message
+        finally:
+            del REGISTRY["_test_orphan"]
+
+    def test_steady_state_counters_primitive(self):
+        """The shared measurement primitive other tests build on: a
+        jitted function costs compiles+dispatch in warmup, exactly one
+        dispatch (no compiles, no retraces) in steady state."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = jnp.asarray(np.linspace(0.0, 1.0, 64))
+
+        @jax.jit
+        def f(v):
+            return jnp.sum(v * v)
+
+        warm, steady = steady_state_counters(lambda: f(x), warmup=1)
+        assert warm.dispatches >= 1
+        assert steady.dispatches == 1
+        assert steady.compiles == 0
+        assert not steady.retraces
+
+    def test_instrument_is_not_reentrant(self):
+        from pint_tpu.lint.tracehooks import instrument
+
+        with instrument():
+            with pytest.raises(RuntimeError, match="already active"):
+                with instrument():
+                    pass

@@ -109,23 +109,32 @@ class TestFusedSentinelAndChain:
                                            monkeypatch):
         """The guards are free on the happy path: an entire fused fit
         stays ONE jitted call + ONE fetch (status/iterations ride the
-        same flat transfer)."""
-        from pint_tpu import profiling
+        same flat transfer).  Measured on the SHARED contract harness
+        (ISSUE 5): real XLA dispatches at the runtime boundary, judged
+        against the declared ``fused_fit`` budget — the same instrument
+        the tier-1 ``--contracts`` gate runs, instead of a hand-rolled
+        counter diff.  (The single fetch is ``np.asarray`` of the flat
+        result vector; on the CPU backend that is a zero-copy view, so
+        the transfer axis is asserted through the contract budget
+        rather than an exact d2h count.)"""
+        from pint_tpu.lint.contracts import check
 
         monkeypatch.setenv("PINT_TPU_FUSED", "1")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            rep = check("fused_fit")
+        assert rep.ok, [f.format() for f in rep.findings]
+        assert rep.steady.dispatches == 1, rep.steady.as_dict()
+        assert rep.steady.compiles == 0 and not rep.steady.retraces
+        # the happy path still CONVERGES on the fixture it always used
         m, toas = small_sim
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
             f = WLSFitter(toas, m)
-            with profiling.session() as s:
-                f.fit_toas(maxiter=4)
-        assert s.dispatches.get("jit_call", 0) == 1, s.dispatches
-        assert s.dispatches.get("fetch", 0) == 1, s.dispatches
+            f.fit_toas(maxiter=4)
         assert f.fitresult.status in (FitStatus.CONVERGED,
                                       FitStatus.MAXITER)
         assert f.fitresult.rung == "fused"
-        assert not s.dispatches.get("guard.fused_diverged", 0)
-        assert not s.dispatches.get("guard.fused_nonfinite", 0)
 
 
 class TestDegenerateConfigChain:

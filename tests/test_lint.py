@@ -316,6 +316,179 @@ class TestJIT001:
         assert codes(src) == []
 
 
+# --- JIT002: weak-type scalars at jit call sites ------------------------------
+class TestJIT002:
+    def test_fires_on_float_literal_positional(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x, scale):
+            return x * scale
+
+        def caller(x):
+            return f(x, 2.5)
+        """
+        assert codes(src) == ["JIT002"]
+
+    def test_fires_on_float_literal_keyword(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x, scale):
+            return x * scale
+
+        def caller(x):
+            return f(x, scale=2.5)
+        """
+        assert codes(src) == ["JIT002"]
+
+    def test_clean_with_static_argnums(self):
+        src = """
+        import jax
+
+        def g(x, scale):
+            return x * scale
+
+        f = jax.jit(g, static_argnums=(1,))
+
+        def caller(x):
+            return f(x, 2.5)
+        """
+        assert codes(src) == []
+
+    def test_clean_with_static_argnames(self):
+        src = """
+        import jax
+
+        def g(x, scale):
+            return x * scale
+
+        f = jax.jit(g, static_argnames=("scale",))
+
+        def caller(x):
+            return f(x, scale=2.5)
+        """
+        assert codes(src) == []
+
+    def test_clean_on_array_argument(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, scale):
+            return x * scale
+
+        def caller(x):
+            return f(x, jnp.float64(2.5))
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x, scale):
+            return x * scale
+
+        def caller(x):
+            return f(x, 2.5)  # ddlint: disable=JIT002 — warmed once
+        """
+        assert codes(src) == []
+
+
+# --- TRACE002: per-iteration host conversions on contract paths ---------------
+class TestTRACE002:
+    def test_fires_on_float_in_loop(self):
+        src = """
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(vals):
+            out = []
+            for v in vals:
+                out.append(float(v))
+            return out
+        """
+        assert codes(src) == ["TRACE002"]
+
+    def test_fires_on_tolist_and_np_asarray_in_loop(self):
+        src = """
+        import numpy as np
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(chunks):
+            out = []
+            for c in chunks:
+                out.append(np.asarray(c))
+                out.append(c.tolist())
+            return out
+        """
+        assert codes(src) == ["TRACE002", "TRACE002"]
+
+    def test_fires_through_the_call_graph(self):
+        # contract-reachability propagates like jit-reachability
+        src = """
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        def drain(vals):
+            return [float(v) for v in vals]
+
+        def helper(vals):
+            total = 0.0
+            while vals:
+                total += float(vals.pop())
+            return total
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(vals):
+            return helper(vals)
+        """
+        assert "TRACE002" in codes(src)
+
+    def test_clean_outside_loop(self):
+        src = """
+        import numpy as np
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(result):
+            return np.asarray(result)     # one fetch, not per-iteration
+        """
+        assert codes(src) == []
+
+    def test_clean_without_contract(self):
+        src = """
+        def plain(vals):
+            return [float(v) for v in vals]
+
+        def loopy(vals):
+            out = []
+            for v in vals:
+                out.append(float(v))
+            return out
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        import numpy as np
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(chunks):
+            out = []
+            for c in chunks:
+                out.append(np.asarray(c))  # ddlint: disable=TRACE002 — per-chunk by design
+            return out
+        """
+        assert codes(src) == []
+
+
 # --- the jaxpr audit ----------------------------------------------------------
 class TestJaxprAudit:
     def test_fires_on_seeded_f32_demotion(self):
@@ -448,5 +621,87 @@ class TestGate:
 
         assert main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for code in ("DD001", "PREC001", "TRACE001", "JIT001", "JAXPR001"):
+        for code in ("DD001", "PREC001", "TRACE001", "TRACE002",
+                     "JIT001", "JIT002", "JAXPR001", "CONTRACT001",
+                     "CONTRACT002"):
             assert code in out
+
+
+class TestRuleFiltering:
+    """ISSUE 5 satellite: ``--select`` / ``--ignore`` rule filtering and
+    the recording-not-judging exit semantics of ``--update-baseline``."""
+
+    @pytest.fixture()
+    def two_violations(self, tmp_path):
+        # PREC001 (f32 demotion in a precision module name) + JIT001
+        # (float default in a jit signature) in one file
+        bad = tmp_path / "residuals.py"
+        bad.write_text(
+            "import jax\n"
+            "import jax.numpy as jnp\n\n\n"
+            "@jax.jit\n"
+            "def f(x, tol=1e-8):\n"
+            "    return x.astype(jnp.float32) * tol\n")
+        return str(bad)
+
+    def _codes(self, capsys):
+        out = json.loads(capsys.readouterr().out)
+        return sorted(f["code"] for f in out["findings"])
+
+    def test_select_keeps_only_named_codes(self, two_violations, capsys):
+        from pint_tpu.lint.cli import main
+
+        rc = main(["--no-jaxpr-audit", "--no-baseline", "--format=json",
+                   "--select", "PREC001", two_violations])
+        assert rc == 1
+        assert self._codes(capsys) == ["PREC001"]
+
+    def test_ignore_drops_named_codes(self, two_violations, capsys):
+        from pint_tpu.lint.cli import main
+
+        rc = main(["--no-jaxpr-audit", "--no-baseline", "--format=json",
+                   "--ignore", "PREC001", two_violations])
+        assert rc == 1
+        assert self._codes(capsys) == ["JIT001"]
+
+    def test_ignore_everything_is_clean(self, two_violations, capsys):
+        from pint_tpu.lint.cli import main
+
+        rc = main(["--no-jaxpr-audit", "--no-baseline", "--format=json",
+                   "--ignore", "PREC001,JIT001", two_violations])
+        assert rc == 0
+        assert self._codes(capsys) == []
+
+    def test_select_wins_over_ignore(self, two_violations, capsys):
+        from pint_tpu.lint.cli import main
+
+        rc = main(["--no-jaxpr-audit", "--no-baseline", "--format=json",
+                   "--select", "PREC001", "--ignore", "PREC001,JIT001",
+                   two_violations])
+        assert rc == 1
+        assert self._codes(capsys) == ["PREC001"]
+
+    def test_unknown_code_is_a_usage_error(self, two_violations, capsys):
+        from pint_tpu.lint.cli import main
+
+        assert main(["--select", "NOPE001", two_violations]) == 2
+        assert main(["--ignore", "NOPE001", two_violations]) == 2
+        err = capsys.readouterr().err
+        assert "NOPE001" in err and "--list-rules" in err
+
+    def test_update_baseline_exits_zero_with_findings(
+            self, two_violations, tmp_path, capsys):
+        """Recording, not judging: --update-baseline returns 0 even
+        though the run found violations — so a CI job regenerating the
+        baseline does not spuriously fail."""
+        from pint_tpu.lint.cli import main
+
+        bl = tmp_path / "bl.txt"
+        rc = main(["--no-jaxpr-audit", "--baseline", str(bl),
+                   "--update-baseline", "--format=json", two_violations])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["baseline_entries_written"] == 2
+        # and the recorded baseline absorbs them on the next plain run
+        assert main(["--no-jaxpr-audit", "--baseline", str(bl),
+                     two_violations]) == 0

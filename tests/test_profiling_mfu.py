@@ -1,6 +1,9 @@
 """Unit tests for the analytic FLOP / MFU accounting
-(:mod:`pint_tpu.profiling`; VERDICT r4 item 9).  Pure Python over fake
-device objects — no backend required."""
+(:mod:`pint_tpu.profiling`; VERDICT r4 item 9) and the snapshot/delta
+counter semantics (ISSUE 5 satellite).  Pure Python over fake device
+objects — no backend required."""
+
+import threading
 
 import numpy as np
 
@@ -37,6 +40,73 @@ class TestSolveFlops:
         base = profiling.solve_flops(1000, 20)
         assert np.isclose(profiling.solve_flops(1000, 20, niter=3), 3 * base)
         assert np.isclose(profiling.solve_flops(1000, 20, nbatch=7), 7 * base)
+
+
+class TestSnapshotSemantics:
+    """ISSUE 5 satellite regression: the module-global counters used to
+    be reset-only (one harness's ``reset()`` wiped every other
+    observer's baseline) and unlocked (a torn read-modify-write lost
+    events under threads).  Contract audits and checkpointed scans run
+    in the same process, so both properties are load-bearing."""
+
+    def test_counters_since_is_immune_to_concurrent_counts(self):
+        snap = profiling.snapshot()
+        profiling.count("snaptest.a", 2)
+        profiling.count("snaptest.b")
+        delta = profiling.counters_since(snap)
+        assert delta["snaptest.a"] == 2
+        assert delta["snaptest.b"] == 1
+        # a second observer starting NOW sees none of the above
+        snap2 = profiling.snapshot()
+        assert "snaptest.a" not in profiling.counters_since(snap2)
+
+    def test_reset_between_snapshots_floors_at_zero(self):
+        profiling.count("snaptest.reset", 5)
+        snap = profiling.snapshot()
+        profiling.reset()
+        profiling.count("snaptest.reset")
+        delta = profiling.counters_since(snap)
+        # never a negative delta out of a cross-harness reset
+        assert delta.get("snaptest.reset", 0) >= 0
+
+    def test_nested_sessions_do_not_cross_contaminate(self):
+        """The original bug: an inner harness's session() reset the
+        module globals, so the outer harness lost everything counted
+        before the inner one started."""
+        with profiling.session() as outer:
+            profiling.count("snaptest.outer")
+            with profiling.session() as inner:
+                profiling.count("snaptest.inner")
+            profiling.count("snaptest.outer")
+        assert outer.dispatches.get("snaptest.outer") == 2
+        assert outer.dispatches.get("snaptest.inner") == 1
+        assert inner.dispatches.get("snaptest.inner") == 1
+        assert "snaptest.outer" not in inner.dispatches
+
+    def test_threaded_counts_lose_no_events(self):
+        was_enabled = profiling.enabled()
+        profiling.enable()          # stage() records only when enabled
+        snap = profiling.snapshot()
+        n_threads, n_each = 8, 500
+
+        def hammer():
+            for _ in range(n_each):
+                profiling.count("snaptest.threads")
+                with profiling.stage("snaptest.stage"):
+                    pass
+
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        delta = profiling.counters_since(snap)
+        stages = profiling.stages_since(snap)
+        if not was_enabled:
+            profiling.disable()
+        assert delta["snaptest.threads"] == n_threads * n_each
+        assert stages["snaptest.stage"]["calls"] == n_threads * n_each
 
 
 class TestMfuReport:

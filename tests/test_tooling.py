@@ -368,6 +368,76 @@ class TestLintGate:
         assert doc["baselined"] >= 0 and doc["stale_baseline"] == 0
 
 
+class TestContractsGate:
+    """The ``--contracts`` console/JSON subprocess leg (ISSUE 5; the
+    in-process gate rides tier-1 in tests/test_contracts.py): the
+    dispatch-contract audit must exit 0 clean on the shipped package,
+    and exit 1 with per-entrypoint attribution when a seeded failpoint
+    (crossing the process boundary via ``PINT_TPU_FAULTS``) makes an
+    entrypoint retrace or chatter."""
+
+    pytestmark = pytest.mark.skipif(
+        __import__("os").environ.get("PINT_TPU_SKIP_CONTRACTS") == "1",
+        reason="PINT_TPU_SKIP_CONTRACTS=1")
+
+    @staticmethod
+    def _run(args, env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.lint", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_clean_subset_exits_zero_json(self):
+        import json
+
+        proc = self._run(["--contracts=residuals,split_assembly",
+                          "--format=json"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["findings"] == []
+
+    def test_retrace_storm_exits_one_with_attribution(self):
+        import json
+
+        proc = self._run(["--contracts=residuals", "--format=json"],
+                         {"PINT_TPU_FAULTS": "retrace_storm"})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        codes = [f["code"] for f in doc["findings"]]
+        assert "CONTRACT002" in codes, codes
+        msg = next(f["message"] for f in doc["findings"]
+                   if f["code"] == "CONTRACT002")
+        # per-entrypoint attribution names the unstable component
+        assert "residuals" in msg and "function identity" in msg, msg
+
+    def test_chatty_transfer_exits_one_on_budget(self):
+        import json
+
+        proc = self._run(["--contracts=residuals", "--format=json"],
+                         {"PINT_TPU_FAULTS": "chatty_transfer"})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert any(f["code"] == "CONTRACT001"
+                   for f in doc["findings"]), doc["findings"]
+
+    def test_unknown_contract_is_a_usage_error(self):
+        proc = self._run(["--contracts=not_a_contract"])
+        assert proc.returncode == 2
+        assert "not_a_contract" in proc.stderr
+
+    def test_list_contracts_names_the_hot_surface(self):
+        proc = self._run(["--list-contracts"])
+        assert proc.returncode == 0, proc.stderr
+        for name in ("fused_fit", "residuals", "split_assembly",
+                     "mcmc_step", "checkpointed_chunk"):
+            assert name in proc.stdout, proc.stdout
+
+
 class TestTupleChisq:
     def test_matches_grid(self):
         """tuple_chisq over an arbitrary point list equals grid_chisq_flat
