@@ -133,6 +133,11 @@ def pytest_configure(config):
         "contracts: the dispatch-contract audit gate "
         "(tests/test_contracts.py; rides tier-1 next to the lint gate, "
         "skip WIP branches with PINT_TPU_SKIP_CONTRACTS=1)")
+    config.addinivalue_line(
+        "markers",
+        "fleet: the bucketed many-pulsar fleet-fitting gate "
+        "(tests/test_fleet.py; rides tier-1, skip WIP branches with "
+        "PINT_TPU_SKIP_FLEET=1)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -142,8 +147,16 @@ def pytest_collection_modifyitems(config, items):
 
     skip_lint = os.environ.get("PINT_TPU_SKIP_LINT") == "1"
     skip_contracts = os.environ.get("PINT_TPU_SKIP_CONTRACTS") == "1"
+    skip_fleet = os.environ.get("PINT_TPU_SKIP_FLEET") == "1"
     for item in items:
         fname = os.path.basename(str(item.fspath))
+        if fname == "test_fleet.py":
+            # the many-pulsar fleet gate mirrors the contracts gate's
+            # opt-out contract (PINT_TPU_SKIP_FLEET=1 on WIP branches)
+            item.add_marker(_pytest.mark.fleet)
+            if skip_fleet:
+                item.add_marker(_pytest.mark.skip(
+                    reason="PINT_TPU_SKIP_FLEET=1"))
         if fname == "test_contracts.py":
             # the compiled-program contract gate rides tier-1 next to
             # the lint gate; WIP branches opt out with

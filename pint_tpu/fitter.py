@@ -67,7 +67,7 @@ __all__ = ["Fitter", "WLSFitter", "GLSFitter", "DownhillWLSFitter",
            "fit_wls_svd", "fit_wls_eigh", "wls_solve", "gls_solve",
            "build_wls_step", "build_gls_step", "build_gls_fullcov_step",
            "build_fused_fit", "FitStatus", "FitSummary",
-           "FitDegradedWarning"]
+           "FitDegradedWarning", "sentinel_advance"]
 
 
 class FitStatus(enum.IntEnum):
@@ -101,6 +101,36 @@ _RUNNING = -1
 class FitDegradedWarning(PintTpuWarning):
     """A fit rung failed (DIVERGED/NONFINITE) and the engine is falling
     back to the next rung of the degradation chain."""
+
+
+def sentinel_advance(x, chi2, prev, best_x, best_chi2, inc_streak,
+                     stall_streak, tol_chi2, diverge_streak, stall_iters):
+    """One iteration of the in-graph convergence sentinel (ISSUE 3): the
+    best-so-far / streak / :class:`FitStatus` bookkeeping shared by the
+    fused while_loop body and the fleet bucket programs
+    (:mod:`pint_tpu.fleet`), so the two sentinels cannot drift.  ``chi2``
+    is the objective at ``x`` BEFORE the step is applied; NaN compares
+    False everywhere below, so a non-finite chi2 can neither extend a
+    streak nor claim the best slot.  Returns ``(best_x, best_chi2,
+    inc_streak, stall_streak, status)`` with ``status`` one of the
+    FitStatus codes or ``_RUNNING``."""
+    nonfinite = jnp.logical_not(jnp.isfinite(chi2))
+    converged = jnp.abs(prev - chi2) < tol_chi2
+    inc_streak = jnp.where(chi2 > prev + tol_chi2,
+                           inc_streak + 1, jnp.int32(0))
+    stall_streak = jnp.where(chi2 < best_chi2 - tol_chi2,
+                             jnp.int32(0), stall_streak + 1)
+    better = chi2 < best_chi2
+    best_x = jnp.where(better, x, best_x)
+    best_chi2 = jnp.where(better, chi2, best_chi2)
+    diverged = jnp.logical_or(inc_streak >= diverge_streak,
+                              stall_streak >= stall_iters)
+    status = jnp.where(
+        nonfinite, jnp.int32(FitStatus.NONFINITE),
+        jnp.where(converged, jnp.int32(FitStatus.CONVERGED),
+                  jnp.where(diverged, jnp.int32(FitStatus.DIVERGED),
+                            jnp.int32(_RUNNING))))
+    return best_x, best_chi2, inc_streak, stall_streak, status
 
 
 def _whiten_normalize(M, r_sec, sigma_sec):
@@ -1350,25 +1380,10 @@ def build_fused_fit(model: TimingModel, batch: TOABatch,
                 chi2 = jnp.sum(((r - off * offc) / sigma) ** 2)
             else:
                 chi2 = jnp.sum((r / sigma) ** 2)
-            nonfinite = jnp.logical_not(jnp.isfinite(chi2))
-            converged = jnp.abs(prev - chi2) < tol_chi2
-            # NaN compares False everywhere below, so a non-finite chi2
-            # can neither extend a streak nor claim the best slot
-            inc_streak = jnp.where(chi2 > prev + tol_chi2,
-                                   inc_streak + 1, jnp.int32(0))
-            stall_streak = jnp.where(chi2 < best_chi2 - tol_chi2,
-                                     jnp.int32(0), stall_streak + 1)
-            better = chi2 < best_chi2
-            best_x = jnp.where(better, x, best_x)
-            best_chi2 = jnp.where(better, chi2, best_chi2)
-            diverged = jnp.logical_or(inc_streak >= diverge_streak,
-                                      stall_streak >= stall_iters)
-            status = jnp.where(
-                nonfinite, jnp.int32(FitStatus.NONFINITE),
-                jnp.where(converged, jnp.int32(FitStatus.CONVERGED),
-                          jnp.where(diverged,
-                                    jnp.int32(FitStatus.DIVERGED),
-                                    jnp.int32(_RUNNING))))
+            best_x, best_chi2, inc_streak, stall_streak, status = \
+                sentinel_advance(x, chi2, prev, best_x, best_chi2,
+                                 inc_streak, stall_streak, tol_chi2,
+                                 diverge_streak, stall_iters)
             return (x + dpars[:npar], chi2, best_x, best_chi2,
                     inc_streak, stall_streak, i + 1, status)
 

@@ -1,0 +1,845 @@
+"""Fleet fitting: N pulsars through a *bounded* set of compiled programs.
+
+BASELINE.json's fifth config ("Batched many-pulsar WLS: vmap over the
+full NANOGrav validation set") and ROADMAP item 1 describe the serving
+shape this module implements: a pulsar-timing array is a few hundred
+pulsars with *ragged* TOA counts and *heterogeneous* free-parameter
+sets, and the bench trajectory says compile time — not steady state —
+is the dominant wall-clock tax.  A naive per-pulsar jit pays one XLA
+compile per pulsar; a naive single vmap pays one compile per distinct
+shape, which for ragged data is the same thing.  The fleet answer
+(Vela.jl's per-pulsar compiled kernels amortized across an array,
+arXiv:2412.15858):
+
+* **Bucketing** — pulsars are grouped by model *structure* (component
+  set, params-pytree treedef, track mode), then their TOA counts are
+  split into a small number of geometric classes
+  (:func:`geometric_bucket_edges`; ``max_buckets`` per structure group,
+  growth factor widened until the budget holds).  Every pulsar in a
+  bucket is padded to the bucket's ``(n_toa, n_param)`` shape.  The
+  bucket count IS the compile budget: one compiled program per bucket,
+  enforced by the ``fleet_fit`` dispatch contract and
+  ``tests/test_fleet.py``.
+* **Mask-weighted padding** — padded TOA rows carry
+  ``DOWNWEIGHT_ERROR_US`` *and* an explicit row mask that zeroes their
+  residual and design-matrix rows, so they contribute exactly zero to
+  chi2 and the normal equations; padded parameter slots carry a zero
+  column, which the shared eigencutoff (`fit_wls_svd`/`fit_wls_eigh`)
+  drops, so their step is exactly zero.
+* **Heterogeneous free params in one program** — the fit vector maps to
+  the params pytree through per-pulsar *data* (an integer slot array +
+  mask) instead of trace-time names, so pulsars fitting different
+  parameter subsets of the same model structure share one compiled
+  program (`_build_bucket_fit`).
+* **Vmapped in-bucket fits** — within a bucket the whole guarded
+  Gauss-Newton fit (the `wls_solve` kernels and the PR 3 convergence
+  sentinel via :func:`pint_tpu.fitter.sentinel_advance`) is vmapped over
+  the pulsar axis; each pulsar carries its own in-graph
+  :class:`~pint_tpu.fitter.FitStatus`, so one oscillating pulsar cannot
+  mark its bucket-mates MAXITER.  An optional batch-axis
+  ``NamedSharding`` (``mesh=``, see :func:`pint_tpu.parallel.
+  make_batch_mesh`) spreads the pulsar axis across devices.
+* **Preemption-tolerant execution** — chunks of the (bucket-ordered)
+  pulsar list run through :func:`pint_tpu.runtime.run_checkpointed_scan`:
+  CRC-verified checkpoints + a fleet sidecar (per-pulsar x/status), a
+  SIGTERM mid-fleet flushes and raises ``ScanInterrupted``, resume is
+  bit-identical, and a chunk whose dispatch raises or returns
+  non-finite chi2 is retried then requeued onto the eager
+  single-pulsar path.  Pulsars whose *in-graph* sentinel ends
+  DIVERGED/NONFINITE are individually requeued onto the eager fitter
+  (PR 3's fused->eager->LM chain), with rung provenance in the result —
+  a fleet run returns a per-pulsar summary table, never an
+  all-or-nothing crash.
+
+Numerical honesty: correlated-noise (GLS) pulsars are routed to the
+eager lane *by design* — their normal matrices carry physical structure
+below the accelerator Gram noise (see ``GLSFitter._fused_ok``), so a
+vmapped device solve there would be garbage.  They still ride the same
+chunked/checkpointed scan and appear in the same result table.
+
+The batched path reports values + per-pulsar chi2/status, not
+per-parameter uncertainties (those need the host-exact final solve —
+refit the pulsars you need covariances for with the single-pulsar
+fitters, or call :meth:`FleetFitter.apply` and fit once more).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import warnings
+import zlib
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import profiling, runtime
+from pint_tpu.exceptions import ConvergenceFailure, PintTpuWarning
+from pint_tpu.fitter import (_RUNNING, FitStatus, FitSummary, GLSFitter,
+                             WLSFitter, _default_wls_kernel,
+                             sentinel_advance, wls_solve)
+from pint_tpu.lint.contracts import dispatch_contract
+from pint_tpu.logging import child as _logchild
+from pint_tpu.models.timing_model import TimingModel, pv
+from pint_tpu.residuals import Residuals, raw_phase_resids
+from pint_tpu.toabatch import pad_batch_to
+
+_log = _logchild("fleet")
+
+__all__ = ["FleetFitter", "FleetEntry", "FleetResult",
+           "FleetRequeueWarning", "geometric_bucket_edges"]
+
+
+class FleetRequeueWarning(PintTpuWarning):
+    """A pulsar's in-bucket fit ended DIVERGED/NONFINITE (or its chunk's
+    dispatch failed) and it was requeued onto the eager single-pulsar
+    path."""
+
+
+#: rung codes stored in the (integer) fleet sidecar; reverse-mapped for
+#: the result table.  "fleet" = the vmapped bucket program; everything
+#: else is the eager lane / requeue path reporting the winning rung of
+#: the PR 3 degradation machinery.
+_RUNGS = ("fleet", "eager", "lm", "downhill", "fused", "powell", "failed")
+_RUNG_CODE = {r: i for i, r in enumerate(_RUNGS)}
+
+
+def _rung_code(rung: str) -> int:
+    return _RUNG_CODE.get(rung, _RUNG_CODE["eager"])
+
+
+# --- bucketing ----------------------------------------------------------------
+
+def geometric_bucket_edges(sizes: Sequence[int], growth: float = 2.0,
+                           max_buckets: int = 4) -> Dict[int, int]:
+    """Map each size to a geometric class id such that at most
+    ``max_buckets`` distinct classes exist.  Classes are
+    ``ceil(log_g(size / min_size))``; the growth factor is widened (by
+    1.5x steps) until the class count fits the budget, so the budget is
+    a hard bound, not a hint.  The caller pads each class to its own
+    maximum member size (tighter than the analytic edge)."""
+    uniq = sorted(set(int(s) for s in sizes))
+    if not uniq:
+        return {}
+    if max_buckets < 1:
+        raise ValueError("max_buckets must be >= 1")
+    base, g = uniq[0], float(growth)
+    if g <= 1.0:
+        raise ValueError("growth must be > 1")
+    while True:
+        classes = {s: (0 if s <= base else
+                       int(math.ceil(math.log(s / base)
+                                     / math.log(g) - 1e-9)))
+                   for s in uniq}
+        if len(set(classes.values())) <= max_buckets:
+            return classes
+        g *= 1.5
+
+
+class _Bucket(NamedTuple):
+    """One padded-shape group of pulsars (or the eager lane)."""
+
+    skey_idx: int          #: structure-group index (-1 for eager lane)
+    n_toa: int             #: padded TOA count (0 for eager lane)
+    n_param: int           #: padded free-param count (0 for eager lane)
+    members: tuple         #: pulsar indices, unpadded
+    slots: tuple           #: pulsar index per slot (padded to cs multiple)
+    eager: bool
+
+
+class _Pulsar(NamedTuple):
+    """Prepared per-pulsar record (host side)."""
+
+    name: str
+    index: int
+    model: TimingModel
+    toas: object
+    resid: Residuals
+    names: tuple           #: fleet-fittable free params, model order
+    dof: int
+    eager: bool
+
+
+class FleetEntry(NamedTuple):
+    """One pulsar's row of a :class:`FleetResult`."""
+
+    name: str
+    index: int
+    chi2: float
+    dof: int
+    status: FitStatus
+    rung: str              #: "fleet" or the eager-lane winning rung
+    iterations: int
+    x: np.ndarray          #: fitted offsets (device units), len(fit_names)
+    fit_names: tuple
+
+    @property
+    def summary(self) -> FitSummary:
+        return FitSummary(self.chi2, self.dof, self.iterations,
+                          self.status in (FitStatus.CONVERGED,
+                                          FitStatus.MAXITER),
+                          status=self.status, rung=self.rung,
+                          guard_trips=None)
+
+
+class FleetResult(NamedTuple):
+    """Per-pulsar summary table of one fleet fit — never an
+    all-or-nothing result (the scan summary carries the chunk-level
+    retry/reroute provenance; each entry its pulsar's terminal
+    :class:`~pint_tpu.fitter.FitStatus` and winning rung)."""
+
+    entries: tuple          #: tuple[FleetEntry, ...] in pulsar order
+    scan: runtime.ScanSummary
+    n_buckets: int
+    n_programs: int
+
+    @property
+    def summaries(self) -> List[FitSummary]:
+        return [e.summary for e in self.entries]
+
+    @property
+    def statuses(self) -> List[FitStatus]:
+        return [e.status for e in self.entries]
+
+    @property
+    def chi2(self) -> np.ndarray:
+        return np.asarray([e.chi2 for e in self.entries])
+
+    @property
+    def ok(self) -> bool:
+        return self.scan.failures == 0 and all(
+            e.status in (FitStatus.CONVERGED, FitStatus.MAXITER)
+            for e in self.entries)
+
+    def table(self) -> str:
+        lines = [f"{'PSR':14s} {'NTOA-DOF':>9s} {'CHI2':>12s} "
+                 f"{'STATUS':>10s} {'RUNG':>9s} {'ITER':>5s}"]
+        for e in self.entries:
+            lines.append(
+                f"{e.name:14s} {e.dof:9d} {e.chi2:12.4f} "
+                f"{e.status.name:>10s} {e.rung:>9s} {e.iterations:5d}")
+        return "\n".join(lines)
+
+
+# --- the in-bucket compiled program -------------------------------------------
+
+def _build_bucket_fit(model: TimingModel, track_mode: str,
+                      delta_keys: Tuple[str, ...], n_param: int,
+                      include_offset: bool, maxiter: int, tol_chi2: float,
+                      kernel, threshold, diverge_streak: int,
+                      stall_iters: int):
+    """ONE jitted, vmapped program fitting every pulsar of a bucket:
+    ``prog(p, batch, slots, pmask, rowmask) -> (B, n_param + 5)`` rows of
+    ``[x..., chi2, status, iterations, best_chi2, n_bad]``.
+
+    The fit vector maps into the params pytree through *data*: ``slots``
+    (int32, per-pulsar) names which scalar delta leaf each fit position
+    moves, ``pmask`` zeroes padded positions (their column is exactly
+    zero, so the shared eigencutoff drops the direction and their step
+    is 0), and ``rowmask`` zeroes padded TOA rows out of the residual
+    and design matrix (exact mask-weighted padding, not just
+    downweighting).  Each pulsar runs ``maxiter`` Gauss-Newton steps in
+    a fixed-trip-count ``lax.scan`` carrying the PR 3 convergence
+    sentinel (:func:`pint_tpu.fitter.sentinel_advance`); finished
+    pulsars freeze (their carry stops updating) so a stalling
+    bucket-mate costs idle FLOPs, not correctness.  The fixed trip
+    count is deliberate: like the proven vmapped grid-fit program
+    (`gridutils.build_grid_fit_fn`) it avoids the XLA:CPU while_loop
+    miscompilation documented on `Fitter._fused_ok`, and the scan (vs
+    unrolling the steps) keeps ONE compiled step body — measured
+    92 s -> 25 s compile on the two-bucket audit shapes, numerics
+    bit-identical."""
+    calc = model.calc
+    keys = tuple(delta_keys)
+
+    def apply_x(p, x, slots, pmask):
+        # delta leaves are the *offsets* from the pytree's reference
+        # values; scatter-add so positions masked off (pmask 0, slot 0)
+        # contribute exactly nothing
+        d = jnp.stack([jnp.asarray(p["delta"][k], jnp.float64)
+                       for k in keys])
+        d = d.at[slots].add(x * pmask)
+        delta = dict(p["delta"])
+        for j, k in enumerate(keys):
+            delta[k] = d[j]
+        out = dict(p)
+        out["delta"] = delta
+        return out
+
+    def resid_sec(x, p, b, slots, pmask):
+        p2 = apply_x(p, x, slots, pmask)
+        r = raw_phase_resids(calc, p2, b, track_mode,
+                             subtract_mean=False, use_weights=False)
+        return r / pv(p2, "F0")
+
+    def fit_one(p, b, slots, pmask, rowmask):
+        sigma = model.scaled_toa_uncertainty(p, b) * 1e-6
+        sigma = jnp.where(rowmask > 0, sigma, 1.0)
+        offc = rowmask if include_offset else None
+
+        def step(x):
+            # primal + JVPs share one pass (same linearize idiom as the
+            # split assembly's nonlinear block)
+            r, jvp = jax.linearize(
+                lambda xx: resid_sec(xx, p, b, slots, pmask), x)
+            M = -jax.vmap(jvp, out_axes=1)(jnp.eye(n_param))
+            r = r * rowmask
+            M = M * rowmask[:, None]
+            if offc is not None:
+                M = jnp.concatenate([M, -offc[:, None]], axis=1)
+            return wls_solve(jnp, r, M, sigma, offc, kernel, n_param,
+                             threshold)
+
+        def body(carry, _):
+            x, prev, best_x, best_chi2, inc, stall, status, iters = carry
+            out = step(x)
+            chi2 = out["chi2"]
+            run = status == _RUNNING
+            bx, bc, ninc, nstall, nstatus = sentinel_advance(
+                x, chi2, prev, best_x, best_chi2, inc, stall,
+                tol_chi2, diverge_streak, stall_iters)
+            # freeze finished pulsars: the scan runs the full trip count
+            # for the whole bucket, so a converged carry must stop
+            # moving (the vmapped analogue of the fused loop's early
+            # exit — idle FLOPs, never corrupted state)
+            best_x = jnp.where(run, bx, best_x)
+            best_chi2 = jnp.where(run, bc, best_chi2)
+            inc = jnp.where(run, ninc, inc)
+            stall = jnp.where(run, nstall, stall)
+            status = jnp.where(run, nstatus, status)
+            x = jnp.where(run, x + out["dx"], x)
+            prev = jnp.where(run, chi2, prev)
+            iters = iters + run.astype(jnp.int32)
+            return (x, prev, best_x, best_chi2, inc, stall, status,
+                    iters), None
+
+        carry = (jnp.zeros(n_param), jnp.float64(jnp.inf),
+                 jnp.zeros(n_param), jnp.float64(jnp.inf), jnp.int32(0),
+                 jnp.int32(0), jnp.int32(_RUNNING), jnp.int32(0))
+        carry, _ = jax.lax.scan(body, carry, None, length=maxiter)
+        x, _, best_x, best_chi2, _, _, status, iters = carry
+        status = jnp.where(status == _RUNNING,
+                           jnp.int32(FitStatus.MAXITER), status)
+        # failed fits hand back the best finite iterate, like the fused
+        # sentinel — x then feeds the eager requeue as a diagnostic
+        ok = jnp.logical_or(status == FitStatus.CONVERGED,
+                            status == FitStatus.MAXITER)
+        x = jnp.where(ok, x, best_x)
+        final = step(x)
+        chi2 = jnp.where(ok, final["chi2"], best_chi2)
+        tail = jnp.stack([chi2, status.astype(jnp.float64),
+                          iters.astype(jnp.float64), best_chi2,
+                          jnp.asarray(final["n_bad"], jnp.float64)])
+        return jnp.concatenate([x, tail])
+
+    return jax.jit(jax.vmap(fit_one))
+
+
+#: columns appended after the x block in a bucket program's output row
+_TAIL = 5
+_COL_CHI2, _COL_STATUS, _COL_ITERS, _COL_BEST, _COL_NBAD = range(5)
+
+
+class _EagerOut(NamedTuple):
+    chi2: float
+    x: np.ndarray
+    status: FitStatus
+    iterations: int
+    rung: str
+
+
+# --- the fitter ---------------------------------------------------------------
+
+class FleetFitter:
+    """Fit N pulsars (ragged TOA counts, heterogeneous free-param sets)
+    through a bounded number of compiled programs.
+
+    ``pulsars``: sequence of ``(model, toas)`` or ``(name, model, toas)``
+    tuples.  Pulsars sharing a model *structure* (same component set /
+    params-pytree layout / track mode) share compiled programs; their
+    ragged TOA counts are split into at most ``max_buckets`` geometric
+    classes per structure group and padded (see module docstring for the
+    exact-masking semantics).  Correlated-noise (GLS) models route to
+    the eager single-pulsar lane — see the module docstring for why.
+
+    ``chunk_size`` pulsars dispatch per compiled call (the vmap width —
+    part of the program shape); ``mesh`` (a 1-D ``("batch",)`` mesh,
+    e.g. :func:`pint_tpu.parallel.make_batch_mesh`) shards the pulsar
+    axis of every chunk across devices with a ``NamedSharding``.
+
+    ``fit()`` is side-effect free (models untouched) and idempotent:
+    pulsar data is staged to device once and the compiled programs are
+    cached, so a steady-state fleet fit is 1 dispatch + 1 fetch per
+    chunk — the ``fleet_fit`` dispatch contract.  Use :meth:`apply` to
+    write a result's offsets back into the models."""
+
+    def __init__(self, pulsars, *, maxiter: int = 8,
+                 tol_chi2: float = 1e-10,
+                 threshold: Optional[float] = None, kernel=None,
+                 chunk_size: int = 8, growth: float = 2.0,
+                 max_buckets: int = 4, mesh=None,
+                 track_mode: Optional[str] = None,
+                 policy: Optional[str] = None,
+                 diverge_streak: Optional[int] = None,
+                 stall_iters: Optional[int] = None,
+                 eager_maxiter: int = 16, requeue: bool = True):
+        from pint_tpu.fitter import FUSED_DIVERGE_STREAK, FUSED_STALL_ITERS
+
+        self.maxiter = int(maxiter)
+        self.tol_chi2 = float(tol_chi2)
+        self.threshold = threshold
+        self.kernel = kernel
+        self.chunk_size = int(chunk_size)
+        self.growth = float(growth)
+        self.max_buckets = int(max_buckets)
+        self.policy = policy
+        self.diverge_streak = FUSED_DIVERGE_STREAK \
+            if diverge_streak is None else int(diverge_streak)
+        self.stall_iters = FUSED_STALL_ITERS \
+            if stall_iters is None else int(stall_iters)
+        self.eager_maxiter = int(eager_maxiter)
+        self.requeue = bool(requeue)
+        if self.chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            nshard = int(np.prod(mesh.devices.shape))
+            if self.chunk_size % nshard:
+                raise ValueError(
+                    f"chunk_size {self.chunk_size} does not split over "
+                    f"the mesh's {nshard} device(s)")
+            self._sharding = NamedSharding(
+                mesh, PartitionSpec(mesh.axis_names[0]))
+
+        self._pulsars: List[_Pulsar] = []
+        for i, spec in enumerate(pulsars):
+            if len(spec) == 3:
+                name, model, toas = spec
+            else:
+                model, toas = spec
+                name = getattr(getattr(model, "PSR", None), "value",
+                               None) or f"PSR{i:04d}"
+            resid = Residuals(toas, model, track_mode=track_mode,
+                              policy=policy)
+            names = self._fleet_fit_params(model, resid)
+            self._pulsars.append(_Pulsar(
+                str(name), i, model, toas, resid, tuple(names),
+                resid.dof, model.has_correlated_errors))
+        if not self._pulsars:
+            raise ValueError("FleetFitter needs at least one pulsar")
+        self._plan = None
+        self._programs: dict = {}
+        self._args_cache: dict = {}
+
+    # -- preparation -----------------------------------------------------------
+
+    @staticmethod
+    def _fleet_fit_params(model: TimingModel, resid: Residuals):
+        """Free params the batched linear step can move: scalar-delta,
+        non-noise parameters (same exclusion as ``Fitter.fit_params``;
+        noise params need the ML path)."""
+        noise = {type(c).__name__ for c in model.noise_components}
+        delta = resid.pdict["delta"]
+        out = []
+        for n in model.free_params:
+            if model.param_component(n) in noise:
+                continue
+            if n not in delta or np.ndim(delta[n]) != 0:
+                continue
+            out.append(n)
+        return out
+
+    @staticmethod
+    def _structure_key(pu: _Pulsar) -> tuple:
+        """Pulsars with equal keys share compiled programs: same pytree
+        layout, component set, track mode, planet set and offset
+        handling.  Per-TOA array shapes are NOT part of the key (they
+        are padded per bucket); const-leaf shapes are (they must stack),
+        so an exotic per-pulsar const leaf degrades to more buckets,
+        never to a wrong stack."""
+        p = pu.resid.pdict
+        const_shapes = tuple(sorted(
+            (k, tuple(np.shape(v))) for k, v in p["const"].items()))
+        return (str(jax.tree_util.tree_structure(
+                    {"const": p["const"], "delta": p["delta"],
+                     "mask": p["mask"]})),
+                tuple(pu.model.components.keys()),
+                pu.resid.track_mode,
+                tuple(sorted(pu.resid.batch.obs_planet_pos_ls)),
+                "PhaseOffset" not in pu.model.components,
+                const_shapes)
+
+    def _ensure_plan(self):
+        if self._plan is not None:
+            return self._plan
+        cs = self.chunk_size
+        skeys: Dict[tuple, int] = {}
+        groups: Dict[int, List[_Pulsar]] = {}
+        rep: Dict[int, _Pulsar] = {}
+        eager_members: List[int] = []
+        for pu in self._pulsars:
+            if pu.eager:
+                eager_members.append(pu.index)
+                continue
+            k = self._structure_key(pu)
+            si = skeys.setdefault(k, len(skeys))
+            groups.setdefault(si, []).append(pu)
+            rep.setdefault(si, pu)
+        buckets: List[_Bucket] = []
+        for si in sorted(groups):
+            members = groups[si]
+            classes = geometric_bucket_edges(
+                [pu.resid.batch.ntoas for pu in members],
+                self.growth, self.max_buckets)
+            by_class: Dict[int, List[_Pulsar]] = {}
+            for pu in members:
+                by_class.setdefault(
+                    classes[pu.resid.batch.ntoas], []).append(pu)
+            for ci in sorted(by_class):
+                mem = by_class[ci]
+                idx = tuple(pu.index for pu in mem)
+                pad = (-len(idx)) % cs
+                slots = idx + (idx[-1],) * pad
+                buckets.append(_Bucket(
+                    si,
+                    max(pu.resid.batch.ntoas for pu in mem),
+                    max(len(pu.names) for pu in mem),
+                    idx, slots, False))
+        if eager_members:
+            idx = tuple(eager_members)
+            pad = (-len(idx)) % cs
+            buckets.append(_Bucket(-1, 0, 0, idx,
+                                   idx + (idx[-1],) * pad, True))
+
+        slot_pulsar: List[int] = []
+        chunk_map: List[Tuple[int, int]] = []
+        for bi, b in enumerate(buckets):
+            for lo in range(0, len(b.slots), cs):
+                chunk_map.append((bi, lo))
+            slot_pulsar.extend(b.slots)
+        primary_slot = np.full(len(self._pulsars), -1, np.int64)
+        for s, pi in enumerate(slot_pulsar):
+            if primary_slot[pi] < 0:
+                primary_slot[pi] = s
+        p_max = max([b.n_param for b in buckets] +
+                    [len(pu.names) for pu in self._pulsars])
+        delta_keys = {
+            si: tuple(sorted(
+                k for k, v in rep[si].resid.pdict["delta"].items()
+                if np.ndim(v) == 0))
+            for si in rep}
+        self._plan = {
+            "buckets": buckets, "chunk_map": chunk_map,
+            "slot_pulsar": np.asarray(slot_pulsar, np.int64),
+            "primary_slot": primary_slot, "n_slots": len(slot_pulsar),
+            "p_max": int(p_max), "rep": rep, "delta_keys": delta_keys,
+        }
+        _log.info("fleet plan: %d pulsar(s) -> %d bucket(s), %d chunk(s) "
+                  "of %d", len(self._pulsars), len(buckets),
+                  len(chunk_map), cs)
+        return self._plan
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self._ensure_plan()["buckets"])
+
+    @property
+    def program_count(self) -> int:
+        """Compiled bucket programs built so far — after one fit this
+        equals the number of non-eager buckets (the compile budget)."""
+        return len(self._programs)
+
+    def _bucket_program(self, bucket: _Bucket):
+        plan = self._plan
+        key = (bucket.skey_idx, bucket.n_toa, bucket.n_param)
+        prog = self._programs.get(key)
+        if prog is None:
+            rep = plan["rep"][bucket.skey_idx]
+            kern = self.kernel if self.kernel is not None else \
+                _default_wls_kernel()
+            profiling.count("fleet.program_build")
+            prog = _build_bucket_fit(
+                rep.model, rep.resid.track_mode,
+                plan["delta_keys"][bucket.skey_idx], bucket.n_param,
+                "PhaseOffset" not in rep.model.components,
+                self.maxiter, self.tol_chi2, kern, self.threshold,
+                self.diverge_streak, self.stall_iters)
+            self._programs[key] = prog
+        return prog
+
+    def _chunk_args(self, ci: int):
+        """Device-resident stacked inputs for chunk ``ci`` — staged ONCE
+        and cached, so steady-state fleet fits pay zero host->device
+        traffic (the warm-program-cache serving property)."""
+        args = self._args_cache.get(ci)
+        if args is not None:
+            return args
+        plan = self._plan
+        bi, lo = plan["chunk_map"][ci]
+        b = plan["buckets"][bi]
+        ps = [self._pulsars[pi] for pi in b.slots[lo:lo + self.chunk_size]]
+        dkeys = plan["delta_keys"][b.skey_idx]
+        kidx = {k: j for j, k in enumerate(dkeys)}
+
+        def pad_pdict(pu):
+            p = pu.resid.pdict
+            npad = b.n_toa - pu.resid.batch.ntoas
+            mask = {k: (np.concatenate([np.asarray(v, np.float64),
+                                        np.zeros(npad)])
+                        if npad else np.asarray(v, np.float64))
+                    for k, v in p["mask"].items()}
+            return {"const": p["const"], "delta": p["delta"],
+                    "mask": mask}
+
+        pdicts = [pad_pdict(pu) for pu in ps]
+        stacked_p = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x, np.float64)
+                                  for x in xs]), *pdicts)
+        batches = [pad_batch_to(pu.resid.batch, b.n_toa) for pu in ps]
+        stacked_b = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches)
+        slots = np.zeros((len(ps), b.n_param), np.int32)
+        pmask = np.zeros((len(ps), b.n_param), np.float64)
+        rowmask = np.zeros((len(ps), b.n_toa), np.float64)
+        for j, pu in enumerate(ps):
+            for i, n in enumerate(pu.names):
+                slots[j, i] = kidx[n]
+                pmask[j, i] = 1.0
+            rowmask[j, :pu.resid.batch.ntoas] = 1.0
+        args = (stacked_p, stacked_b, jnp.asarray(slots),
+                jnp.asarray(pmask), jnp.asarray(rowmask))
+        if self._sharding is not None:
+            args = jax.device_put(args, self._sharding)
+        else:
+            args = jax.device_put(args)
+        self._args_cache[ci] = args
+        return args
+
+    # -- the eager lane --------------------------------------------------------
+
+    def _eager_fit_one(self, pi: int, plan) -> _EagerOut:
+        """One pulsar through the guarded single-pulsar engine (PR 3's
+        degradation chain and provenance), on a deepcopy so ``fit()``
+        stays side-effect free.  The fitted offsets are recovered from
+        the copy's written-back parameter values."""
+        pu = self._pulsars[pi]
+        model = copy.deepcopy(pu.model)
+        cls = GLSFitter if model.has_correlated_errors else WLSFitter
+        f = cls(pu.toas, model, track_mode=pu.resid.track_mode,
+                policy=self.policy)
+        try:
+            chi2 = float(f.fit_toas(maxiter=self.eager_maxiter,
+                                    tol_chi2=self.tol_chi2,
+                                    threshold=self.threshold))
+            fr = f.fitresult
+            status, rung, iters = fr.status, fr.rung or "eager", \
+                fr.iterations
+        except ConvergenceFailure as e:
+            chi2 = float("nan")
+            status = e.status if e.status is not None else \
+                FitStatus.NONFINITE
+            rung, iters = "failed", 0
+        x = np.zeros(plan["p_max"])
+        for i, n in enumerate(pu.names):
+            old = np.asarray(pu.model[n].device_value, np.float64)  # ddlint: disable=TRACE002 host parameter metadata, bounded by nfit
+            new = np.asarray(model[n].device_value, np.float64)     # ddlint: disable=TRACE002 host parameter metadata, bounded by nfit
+            x[i] = np.sum(new - old)
+        return _EagerOut(chi2, x, status, int(iters), rung)
+
+    def _run_eager_chunk(self, lo: int, hi: int, plan, side,
+                         why: str) -> np.ndarray:
+        """The eager lane / requeue path for slots [lo, hi): one guarded
+        single-pulsar fit per UNIQUE pulsar (pad duplicates copy their
+        original's row)."""
+        chi2 = np.empty(hi - lo, np.float64)
+        done: Dict[int, _EagerOut] = {}
+        for j, pi in enumerate(plan["slot_pulsar"][lo:hi]):
+            pi = int(pi)
+            if pi not in done:
+                profiling.count(f"fleet.eager_{why}")
+                done[pi] = self._eager_fit_one(pi, plan)
+            eo = done[pi]
+            chi2[j] = eo.chi2
+            s = lo + j
+            side["x"][s] = eo.x
+            side["status"][s] = int(eo.status)
+            side["iters"][s] = eo.iterations
+            side["best_chi2"][s] = eo.chi2
+            side["rung"][s] = _rung_code(eo.rung)
+        return chi2
+
+    # -- the fit ---------------------------------------------------------------
+
+    # warmup budget: one XLA program per bucket (2 on the audit fixture,
+    # measured exactly when the persistent compile cache is cold) plus
+    # the one-time tiny staging-op executables (pad/stack/device_put);
+    # steady state on the audit fixture is 2 chunk dispatches + 2
+    # result fetches, compiles == retraces == 0
+    @dispatch_contract("fleet_fit", max_compiles=24, max_dispatches=4,
+                       max_transfers=8)
+    def fit(self, *, checkpoint: Optional[str] = None,
+            resume: bool = False, max_retries: int = 1,
+            checkpoint_every: int = 1) -> FleetResult:
+        """Fit the whole fleet; returns a :class:`FleetResult` (models
+        are NOT mutated — see :meth:`apply`).
+
+        Dispatch contract ``fleet_fit``: the first call compiles one
+        program per bucket (the bucket count is the compile budget);
+        a steady-state call is 1 dispatch + 1 result fetch per chunk,
+        zero compiles, zero retraces — audited in tier-1 with the other
+        hot entrypoints.
+
+        ``checkpoint``/``resume`` ride
+        :func:`pint_tpu.runtime.run_checkpointed_scan` (plus a fleet
+        sidecar at ``<checkpoint>.fleet`` holding per-pulsar x/status),
+        so a SIGTERM mid-fleet flushes state and raises
+        ``ScanInterrupted``, and a resume restores completed chunks
+        bit-identically.  A chunk whose dispatch raises or returns
+        non-finite chi2 is retried ``max_retries`` times then requeued
+        onto the eager single-pulsar path; pulsars whose in-graph
+        sentinel ends DIVERGED/NONFINITE are requeued individually."""
+        plan = self._ensure_plan()
+        cs = self.chunk_size
+        n_slots = plan["n_slots"]
+        p_max = plan["p_max"]
+        side = {
+            "x": np.full((n_slots, p_max), np.nan, np.float64),
+            "status": np.full(n_slots, -1, np.int16),
+            "iters": np.zeros(n_slots, np.int32),
+            "best_chi2": np.full(n_slots, np.nan, np.float64),
+            "rung": np.zeros(n_slots, np.int16),
+        }
+        sig = self._signature(plan)
+        sidecar = (checkpoint + ".fleet") if checkpoint else None
+        if resume and sidecar:
+            import os as _os
+
+            if _os.path.exists(sidecar):
+                data = runtime.load_checkpoint(sidecar)
+                stored = bytes(np.asarray(
+                    data.get("signature", np.zeros(0, np.uint8)),
+                    np.uint8)).decode(errors="replace")
+                if stored != sig or data["x"].shape != (n_slots, p_max):
+                    raise ValueError(
+                        f"fleet sidecar {sidecar!r} does not match this "
+                        f"fleet (stored signature {stored!r})")
+                for k in side:
+                    # checkpoint payloads are host npz arrays; no
+                    # device sync hides in this conversion
+                    side[k] = np.asarray(data[k], side[k].dtype).copy()  # ddlint: disable=TRACE002 host checkpoint data
+            elif _os.path.exists(checkpoint):
+                raise ValueError(
+                    f"scan checkpoint {checkpoint!r} exists but its "
+                    f"fleet sidecar {sidecar!r} is missing; cannot "
+                    "resume per-pulsar state")
+
+        def flush_side():
+            if sidecar:
+                payload = dict(side)
+                payload["signature"] = np.frombuffer(sig.encode(),
+                                                     np.uint8)
+                runtime.write_checkpoint(sidecar, payload)
+
+        def run_chunk(ci, lo, hi):
+            bi, blo = plan["chunk_map"][ci]
+            b = plan["buckets"][bi]
+            if b.eager:
+                vals = self._run_eager_chunk(lo, hi, plan, side, "lane")
+                flush_side()
+                return vals
+            prog = self._bucket_program(b)
+            args = self._chunk_args(ci)
+            profiling.count("fleet.chunk_dispatch")
+            out = np.asarray(prog(*args))
+            P = b.n_param
+            side["x"][lo:hi, :P] = out[:, :P]
+            side["x"][lo:hi, P:] = 0.0
+            side["status"][lo:hi] = out[:, P + _COL_STATUS].astype(
+                np.int16)
+            side["iters"][lo:hi] = out[:, P + _COL_ITERS].astype(np.int32)
+            side["best_chi2"][lo:hi] = out[:, P + _COL_BEST]
+            side["rung"][lo:hi] = _RUNG_CODE["fleet"]
+            flush_side()
+            # the returned chi2 is what the scan engine judges: a chunk
+            # whose dispatch poisons every value (vs one sentinel-failed
+            # pulsar, which returns its best finite chi2) drives the
+            # retry/requeue machinery
+            return out[:, P + _COL_CHI2]
+
+        def fallback(ci, lo, hi):
+            vals = self._run_eager_chunk(lo, hi, plan, side, "requeue")
+            flush_side()
+            return vals
+
+        results, summary = runtime.run_checkpointed_scan(
+            n_slots, run_chunk, chunk_size=cs, fallback=fallback,
+            checkpoint=checkpoint, resume=resume,
+            max_retries=max_retries, checkpoint_every=checkpoint_every,
+            signature=sig)
+
+        # per-pulsar requeue: an in-graph sentinel failure (DIVERGED /
+        # NONFINITE) lands that one pulsar — not its bucket — on the
+        # guarded eager path, with the winning rung in the result
+        if self.requeue:
+            for pu in self._pulsars:
+                s = int(plan["primary_slot"][pu.index])
+                st = int(side["status"][s])
+                if side["rung"][s] != _RUNG_CODE["fleet"] or st in (
+                        int(FitStatus.CONVERGED), int(FitStatus.MAXITER)):
+                    continue
+                warnings.warn(
+                    f"fleet pulsar {pu.name} ended "
+                    f"{FitStatus(st).name} in its bucket; requeueing "
+                    "onto the eager single-pulsar path",
+                    FleetRequeueWarning)
+                profiling.count("fleet.pulsar_requeue")
+                eo = self._eager_fit_one(pu.index, plan)
+                results[s] = eo.chi2
+                side["x"][s] = eo.x
+                side["status"][s] = int(eo.status)
+                side["iters"][s] = eo.iterations
+                side["rung"][s] = _rung_code(eo.rung)
+
+        entries = []
+        # results/side are host np arrays by here (fetched once per
+        # chunk boundary inside the scan) — this loop never syncs
+        for pu in self._pulsars:
+            s = int(plan["primary_slot"][pu.index])
+            st = int(side["status"][s])  # ddlint: disable=TRACE002 host result table
+            entries.append(FleetEntry(
+                pu.name, pu.index, float(results[s]), pu.dof,  # ddlint: disable=TRACE002 host result table
+                FitStatus(st) if 0 <= st <= 3 else FitStatus.NONFINITE,
+                _RUNGS[int(side["rung"][s])], int(side["iters"][s]),
+                side["x"][s, :len(pu.names)].copy(), pu.names))
+        return FleetResult(tuple(entries), summary,
+                           len(plan["buckets"]), self.program_count)
+
+    def _signature(self, plan) -> str:
+        crc = 0
+        for pu in self._pulsars:
+            rec = f"{pu.name}:{pu.resid.batch.ntoas}:" \
+                  f"{','.join(pu.names)};"
+            crc = zlib.crc32(rec.encode(), crc)
+        return (f"fleet|cs={self.chunk_size}|maxiter={self.maxiter}"
+                f"|tol={self.tol_chi2:g}|nb={len(plan['buckets'])}"
+                f"|crc={crc & 0xFFFFFFFF:#010x}")
+
+    def apply(self, result: FleetResult) -> None:
+        """Write a result's fitted offsets back into each pulsar's model
+        (parameter VALUES only; the batched path computes no
+        uncertainties).  Non-finite entries are skipped.  Invalidates
+        the staged device data (models changed => pdicts stale)."""
+        for e in result.entries:
+            pu = self._pulsars[e.index]
+            if not np.all(np.isfinite(e.x)):
+                continue
+            p2 = pu.model.with_x(pu.resid.pdict, np.asarray(e.x),
+                                 list(e.fit_names))
+            pu.model.apply_deltas(p2)
+            pu.resid.update()
+        self._args_cache.clear()
+        self._plan = None

@@ -134,6 +134,7 @@ def _ensure_registered() -> None:
     """Import every module that declares contracts (registration is a
     decoration side effect)."""
     import pint_tpu.fitter        # noqa: F401
+    import pint_tpu.fleet         # noqa: F401
     import pint_tpu.gridutils     # noqa: F401
     import pint_tpu.mcmc          # noqa: F401
     import pint_tpu.parallel      # noqa: F401
@@ -220,6 +221,44 @@ class ContractFixture:
                 f = WidebandTOAFitter(toas, model)
             self._cache["wideband"] = (model, toas, f)
         return self._cache["wideband"]
+
+    def fleet_fitter(self):
+        """A tiny 4-pulsar / 2-bucket FleetFitter for the fleet_fit
+        contract: ragged TOA counts (8, 8, 16, 16) -> two padded shapes,
+        chunk width 2 -> 2 chunks, so steady state must be 2 dispatches
+        + 2 fetches.  TOAs are simulated FROM each model and the
+        ill-conditioned directions are frozen (RAJ/DECJ on a 30-day
+        span, DM vs the FD block) so every in-bucket fit ends
+        CONVERGED/MAXITER — a sentinel failure would requeue onto the
+        eager path mid-audit and blow the budget for the wrong
+        reason."""
+        if "fleet" not in self._cache:
+            import copy
+            import warnings
+
+            import numpy as np
+
+            from pint_tpu.fleet import FleetFitter
+            from pint_tpu.simulation import make_fake_toas_uniform
+
+            pulsars = []
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                for i, n in enumerate((8, 8, 16, 16)):
+                    model = copy.deepcopy(self.model)
+                    model.RAJ.frozen = True
+                    model.DECJ.frozen = True
+                    model.DM.frozen = True
+                    toas = make_fake_toas_uniform(
+                        55000.0, 55030.0, n, model, obs="gbt",
+                        error_us=300.0,
+                        freq_mhz=np.tile([1400.0, 800.0],
+                                         (n + 1) // 2)[:n],
+                        add_noise=True, seed=100 + i)
+                    pulsars.append((f"AUDIT{i}", model, toas))
+                self._cache["fleet"] = FleetFitter(
+                    pulsars, maxiter=3, chunk_size=2)
+        return self._cache["fleet"]
 
     def grid_fitter(self):
         """A WLSFitter with DM frozen, for the grid contracts."""
@@ -361,6 +400,11 @@ def _drv_mcmc_step(fix: ContractFixture):
     }
 
 
+def _drv_fleet_fit(fix: ContractFixture):
+    ff = fix.fleet_fitter()
+    return {"call": lambda: ff.fit()}
+
+
 _DRIVERS: Dict[str, Callable[[ContractFixture], dict]] = {
     "residuals": _drv_residuals,
     "split_assembly": _drv_split_assembly,
@@ -372,6 +416,7 @@ _DRIVERS: Dict[str, Callable[[ContractFixture], dict]] = {
     "sharded_chunk": _drv_sharded_chunk,
     "checkpointed_chunk": _drv_checkpointed_chunk,
     "mcmc_step": _drv_mcmc_step,
+    "fleet_fit": _drv_fleet_fit,
 }
 
 

@@ -221,6 +221,7 @@ def instrument() -> Iterator[Instrumentation]:
     orig_block = _array.ArrayImpl.__dict__.get("block_until_ready")
     orig_device_put = jax.device_put
     orig_explain = jax.config.jax_explain_cache_misses
+    orig_cache_min = jax.config.jax_persistent_cache_min_compile_time_secs
 
     def backend_compile(*a, **k):
         with inst._lock:
@@ -275,6 +276,13 @@ def instrument() -> Iterator[Instrumentation]:
     compiler_logger.setLevel(logging.ERROR)
     cache_logger.setLevel(logging.ERROR)
     jax.config.update("jax_explain_cache_misses", True)
+    # suspend persistent-compilation-cache WRITES while measuring:
+    # a borderline >min-compile-time program persisted between two
+    # measured calls makes the second call LOAD what the first
+    # COMPILED, skewing marginal-mode counters negative (loads are
+    # still served — measurement must observe the cache, not mutate it)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      1e9)
     # evict the C++ fastpath entries of ALREADY-warm programs so their
     # dispatches route through the (counted) Python path; tracing and
     # executable caches are untouched — no recompilation is induced
@@ -301,3 +309,5 @@ def instrument() -> Iterator[Instrumentation]:
         compiler_logger.setLevel(orig_compiler_level)
         cache_logger.setLevel(orig_cache_level)
         jax.config.update("jax_explain_cache_misses", orig_explain)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          orig_cache_min)

@@ -44,8 +44,8 @@ from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.residuals import raw_phase_resids
 from pint_tpu.toabatch import TOABatch
 
-__all__ = ["make_mesh", "build_sharded_grid_fit", "pad_batch",
-           "sharded_grid_chisq"]
+__all__ = ["make_mesh", "make_batch_mesh", "build_sharded_grid_fit",
+           "pad_batch", "sharded_grid_chisq"]
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -59,6 +59,17 @@ def make_mesh(n_devices: Optional[int] = None,
         raise ValueError(f"{n} devices do not split into batch={batch}")
     arr = np.array(devs[:n]).reshape(batch, n // batch)
     return Mesh(arr, ("batch", "toa"))
+
+
+def make_batch_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D ``("batch",)`` mesh over the first ``n_devices`` devices —
+    the purely data-parallel axis the fleet fitter
+    (:mod:`pint_tpu.fleet`) shards its pulsar-chunk dimension over with
+    a ``NamedSharding`` (each device fits its slice of the chunk; no
+    cross-device collectives in the program)."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), ("batch",))
 
 
 def pad_batch(batch: TOABatch, multiple: int) -> TOABatch:

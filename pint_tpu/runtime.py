@@ -63,7 +63,7 @@ from pint_tpu.logging import child as _logchild
 
 _log = _logchild("runtime")
 
-__all__ = ["BackendStatus", "acquire_backend",
+__all__ = ["BackendStatus", "acquire_backend", "configure_compile_cache",
            "write_checkpoint", "load_checkpoint", "scan_signature",
            "ChunkStatus", "ScanSummary", "run_checkpointed_scan",
            "call_with_deadline"]
@@ -88,6 +88,9 @@ class BackendStatus(NamedTuple):
     wait_s: float                 #: total backoff sleep between attempts
     probe_timeout_s: float        #: per-attempt probe deadline
     failures: Tuple[str, ...]     #: one failure description per failed probe
+    #: persistent-compilation-cache directory wired for this process
+    #: (None = caching disabled) — see :func:`configure_compile_cache`
+    compile_cache_dir: Optional[str] = None
 
     @property
     def degraded(self) -> bool:
@@ -96,7 +99,8 @@ class BackendStatus(NamedTuple):
     def as_dict(self) -> dict:
         return {"backend_rung": self.rung,
                 "probe_attempts": self.attempts,
-                "probe_wait_s": round(self.wait_s, 3)}
+                "probe_wait_s": round(self.wait_s, 3),
+                "compile_cache_dir": self.compile_cache_dir}
 
 
 def probe_backend(timeout_s: float = 120.0) -> Optional[str]:
@@ -140,6 +144,48 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def configure_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Wire jax's persistent compilation cache and return the directory
+    in use (None = caching disabled) — the cheap half of ROADMAP item 2:
+    the heavyweight fit programs are identical across processes, so a
+    serving/bench process should pay each compile once per machine, not
+    once per process; ``cold_start_s`` in bench JSON tracks the payoff.
+
+    Resolution order: explicit ``path`` argument, then the
+    ``PINT_TPU_COMPILE_CACHE_DIR`` env var, then whatever is already
+    configured (the package's ``PINT_TPU_XLA_CACHE`` import-time wiring
+    or an explicit ``JAX_COMPILATION_CACHE_DIR``), then
+    ``bench_cache/compile_cache`` under the current directory.  A
+    ``PINT_TPU_XLA_CACHE=0`` opt-out is respected unless an explicit
+    path/env override asks for caching anyway.  Entries land in a
+    host-fingerprint subdirectory (XLA:CPU executables are
+    AOT-specialized to the build host's CPU features — see
+    ``pint_tpu.__init__``).  Call BEFORE the first compile: jax
+    initializes its cache object lazily at first use, and an
+    already-initialized cache keeps its original directory (tests that
+    re-point mid-process must also ``compilation_cache.reset_cache()``,
+    see tests/test_fleet.py)."""
+    target = path or os.environ.get("PINT_TPU_COMPILE_CACHE_DIR")
+    import jax  # deferred: acquire_backend may redirect platforms first
+
+    current = jax.config.jax_compilation_cache_dir
+    if target is None:
+        if current is not None:
+            return current
+        if os.environ.get("PINT_TPU_XLA_CACHE", "1") == "0":
+            return None  # explicit opt-out and nothing overrode it
+        target = os.path.join(os.getcwd(), "bench_cache",
+                              "compile_cache")
+    from pint_tpu import _host_key
+
+    full = os.path.join(os.path.expanduser(target), _host_key())
+    jax.config.update("jax_compilation_cache_dir", full)
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    return full
 
 
 def acquire_backend(max_attempts: Optional[int] = None,
@@ -193,7 +239,8 @@ def acquire_backend(max_attempts: Optional[int] = None,
         fail = probe(timeout_s=budget)
         if fail is None:
             return BackendStatus(True, primary, attempts, waited,
-                                 probe_timeout_s, tuple(failures))
+                                 probe_timeout_s, tuple(failures),
+                                 configure_compile_cache())
         failures.append(fail)
         profiling.count("runtime.probe_failure")
         _log.warning("backend probe attempt %d/%d failed: %s",
@@ -212,7 +259,8 @@ def acquire_backend(max_attempts: Optional[int] = None,
                  "%d attempt(s), %.1f s of backoff", attempts, waited)
     _force_cpu()
     return BackendStatus(True, "cpu_fallback", attempts, waited,
-                         probe_timeout_s, tuple(failures))
+                         probe_timeout_s, tuple(failures),
+                         configure_compile_cache())
 
 
 # --- verified atomic checkpoints ----------------------------------------------

@@ -261,6 +261,27 @@ def make_batch(
     )
 
 
+def pad_batch_to(batch: TOABatch, n: int) -> TOABatch:
+    """Pad the TOA axis to EXACTLY ``n`` rows by repeating the last row
+    with ``DOWNWEIGHT_ERROR_US`` uncertainty (chi2/fit-neutral, same
+    sentinel as the validation policy's downweight and
+    ``parallel.pad_batch``'s mesh padding).  The fleet bucket programs
+    (:mod:`pint_tpu.fleet`) additionally carry an explicit row mask that
+    zeroes padded rows out of the residuals and normal equations, so
+    padding there is exact, not just strongly downweighted."""
+    if batch.ntoas > n:
+        raise ValueError(
+            f"cannot pad a {batch.ntoas}-row batch down to {n} rows")
+    if batch.ntoas == n:
+        return batch
+    idx = np.concatenate([np.arange(batch.ntoas),
+                          np.full(n - batch.ntoas, batch.ntoas - 1)])
+    out = batch.select(idx)
+    err = np.asarray(out.error_us).copy()
+    err[batch.ntoas:] = DOWNWEIGHT_ERROR_US
+    return out._replace(error_us=jnp.asarray(err))
+
+
 def concatenate(batches) -> TOABatch:
     """Concatenate batches along the TOA axis (planet dicts must agree)."""
     batches = list(batches)

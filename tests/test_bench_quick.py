@@ -71,6 +71,14 @@ def _assert_schema(d):
                 "retraces"):
         assert isinstance(dc.get(key), int), (key, dc.get(key))
     assert dc["dispatches"] >= 1          # the fit really ran
+    # compile-tax + fleet axes (ISSUE 6): cold_start_s tracks process
+    # start -> first fitted number (shrinks when the persistent
+    # compilation cache is warm); fleet_fits_per_sec supersedes the
+    # old ensemble_32 single-shape submetric
+    assert isinstance(d.get("cold_start_s"), (int, float))
+    assert d["cold_start_s"] > 0
+    assert isinstance(d.get("fleet_fits_per_sec"), (int, float))
+    assert d["fleet_fits_per_sec"] > 0
 
 
 def test_quick_steady_state_never_recompiles(quick_line):
@@ -114,6 +122,19 @@ def test_value_is_a_real_number(quick_line):
     assert isinstance(d["chi2"], (int, float))
     assert int(d["ntoas"]) > 0 and int(d["nfit"]) > 0
     assert isinstance(d["compile_s"], (int, float))
+
+
+def test_fleet_submetric(quick_line):
+    """ISSUE 6: the quick line carries the many-pulsar fleet shape —
+    ragged pulsars through a bounded program set, every fit usable."""
+    fl = quick_line["submetrics"].get("fleet")
+    assert isinstance(fl, dict), quick_line["submetrics"]
+    assert fl["n_pulsars"] == 4
+    assert 1 <= fl["n_buckets"] <= 4
+    assert fl["n_programs"] == fl["n_buckets"]
+    assert fl["n_ok"] == fl["n_pulsars"]
+    assert fl["fleet_fits_per_sec"] > 0
+    assert quick_line["fleet_fits_per_sec"] == fl["fleet_fits_per_sec"]
 
 
 def test_wedged_probe_yields_tagged_cpu_fallback(wedged_line):

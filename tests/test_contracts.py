@@ -64,7 +64,7 @@ class TestCleanLeg:
         assert {"residuals", "split_assembly", "wls_step", "gls_step",
                 "wideband_step", "fused_fit", "grid_chunk",
                 "sharded_chunk", "checkpointed_chunk",
-                "mcmc_step"} <= set(REGISTRY)
+                "mcmc_step", "fleet_fit"} <= set(REGISTRY)
 
     def test_every_contract_has_a_driver(self):
         contracts._ensure_registered()
@@ -96,6 +96,9 @@ class TestCleanLeg:
         assert reports["fused_fit"].steady.dispatches == 1
         assert reports["split_assembly"].steady.dispatches <= 2
         assert reports["residuals"].steady.dispatches == 1
+        # a steady-state fleet fit really is one dispatch per chunk
+        # (the audit fixture is 2 buckets x 1 chunk each)
+        assert reports["fleet_fit"].steady.dispatches == 2
 
 
 class TestSeededRegressions:

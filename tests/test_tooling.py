@@ -434,7 +434,7 @@ class TestContractsGate:
         proc = self._run(["--list-contracts"])
         assert proc.returncode == 0, proc.stderr
         for name in ("fused_fit", "residuals", "split_assembly",
-                     "mcmc_step", "checkpointed_chunk"):
+                     "mcmc_step", "checkpointed_chunk", "fleet_fit"):
             assert name in proc.stdout, proc.stdout
 
 
