@@ -81,6 +81,14 @@ _SLOW_TESTS = {
     ("test_binary_ell1.py", "TestFitRoundtrip"),
     ("test_aux_components.py", "TestPLFlavors"),
     ("test_design_split.py", "TestSpeed"),
+    # export round-trip parity on the B1855/fleet fixtures compiles the
+    # full serving programs three times, and the in-process quick-
+    # fixture zero-compile leg builds its serving set twice — depth
+    # coverage.  Tier-1 keeps the REAL two-subprocess proof (the bench
+    # --quick AOT legs assert warm_compiles == 0) plus the CONTRACT003
+    # clean/poisoned legs and serve()'s write-time round-trip verify.
+    ("test_aot.py", "TestRoundTripParity"),
+    ("test_aot.py", "test_quick_fixture_rebuild"),
 }
 
 
@@ -138,6 +146,11 @@ def pytest_configure(config):
         "fleet: the bucketed many-pulsar fleet-fitting gate "
         "(tests/test_fleet.py; rides tier-1, skip WIP branches with "
         "PINT_TPU_SKIP_FLEET=1)")
+    config.addinivalue_line(
+        "markers",
+        "aot: the AOT serving-program store gate (tests/test_aot.py "
+        "+ the two-process leg in test_tooling.py; rides tier-1, skip "
+        "WIP branches with PINT_TPU_SKIP_AOT=1)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -148,8 +161,18 @@ def pytest_collection_modifyitems(config, items):
     skip_lint = os.environ.get("PINT_TPU_SKIP_LINT") == "1"
     skip_contracts = os.environ.get("PINT_TPU_SKIP_CONTRACTS") == "1"
     skip_fleet = os.environ.get("PINT_TPU_SKIP_FLEET") == "1"
+    skip_aot = os.environ.get("PINT_TPU_SKIP_AOT") == "1"
     for item in items:
         fname = os.path.basename(str(item.fspath))
+        if fname == "test_aot.py" or (
+                fname == "test_tooling.py" and getattr(
+                    item, "cls", None) is not None
+                and item.cls.__name__ == "TestAotColdStart"):
+            # the AOT store gate mirrors the contracts/fleet opt-outs
+            item.add_marker(_pytest.mark.aot)
+            if skip_aot:
+                item.add_marker(_pytest.mark.skip(
+                    reason="PINT_TPU_SKIP_AOT=1"))
         if fname == "test_fleet.py":
             # the many-pulsar fleet gate mirrors the contracts gate's
             # opt-out contract (PINT_TPU_SKIP_FLEET=1 on WIP branches)

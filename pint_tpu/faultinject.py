@@ -51,7 +51,7 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "nonfinite_noise_grad", "corrupt_toa_errors", "corrupt_mjds",
            "wedged_probe", "chunk_nonfinite", "chunk_raise",
            "sigterm_midscan", "corrupt_checkpoint", "retrace_storm",
-           "chatty_transfer"]
+           "chatty_transfer", "corrupt_aot_blob", "stale_aot_version"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -326,6 +326,62 @@ def corrupt_checkpoint(path: str, mode: str = "truncate") -> Iterator[None]:
             fh.write(orig)
 
 
+# --- AOT-store failpoints (drive pint_tpu.aot, ISSUE 7) -----------------------
+
+@contextlib.contextmanager
+def corrupt_aot_blob(path: str, mode: str = "truncate") -> Iterator[None]:
+    """Corrupt the AOT store blob at ``path`` in place (mirroring
+    :func:`corrupt_checkpoint`): ``"truncate"`` cuts the file in half
+    (a crash mid-copy), ``"flip"`` flips one byte in the middle of the
+    PAYLOAD (bit rot the header still parses through, so only the
+    CRC32 catches it).  Loading must warn (AotStoreWarning), fall back
+    to live tracing, and OVERWRITE the slot with a fresh blob — so
+    unlike ``corrupt_checkpoint`` the original bytes are restored on
+    exit only if the store did NOT already self-heal."""
+    with open(path, "rb") as fh:
+        orig = fh.read()
+    if mode == "truncate":
+        bad = orig[: max(1, len(orig) // 2)]
+    elif mode == "flip":
+        pos = (len(orig) + orig.index(b"\n", 8)) // 2  # inside payload
+        bad = orig[:pos] + bytes([orig[pos] ^ 0xFF]) + orig[pos + 1:]
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "wb") as fh:
+        fh.write(bad)
+    try:
+        yield
+    finally:
+        try:
+            with open(path, "rb") as fh:
+                cur = fh.read()
+        except OSError:
+            cur = None
+        if cur == bad:   # store did not self-heal inside the context
+            with open(path, "wb") as fh:
+                fh.write(orig)
+
+
+def _stale_aot_version_factory(fn):
+    """Every blob-header version check reports a mismatch — the
+    jax-upgrade shape: a deployment's store outlives its jax wheel, and
+    every load must fall back to live tracing (with a warning) and
+    overwrite with a fresh blob instead of crashing or silently serving
+    a stale program."""
+    def stale(header):
+        return "stale jax/XLA version (stale_aot_version failpoint)"
+    return stale
+
+
+@contextlib.contextmanager
+def stale_aot_version() -> Iterator[None]:
+    """Failpoint ``"stale_aot_version"``: :mod:`pint_tpu.aot` treats
+    every store blob as version-mismatched.  Also env-activatable
+    (``PINT_TPU_FAULTS=stale_aot_version``) for subprocess legs."""
+    with _registered("stale_aot_version", _stale_aot_version_factory):
+        yield
+
+
 # --- contract-auditor failpoints (drive pint_tpu.lint.contracts, ISSUE 5) ----
 
 def _retrace_storm_factory(fn):
@@ -385,6 +441,7 @@ _ENV_FACTORIES = {
     "wedged_probe": _wedged_probe_factory,
     "retrace_storm": _retrace_storm_factory,
     "chatty_transfer": _chatty_transfer_factory,
+    "stale_aot_version": _stale_aot_version_factory,
 }
 
 

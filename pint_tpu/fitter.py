@@ -313,7 +313,8 @@ SPLIT_REFRESH_DRIFT_SEC = 0.05
 
 
 def _make_assembly(model: TimingModel, names: Sequence[str], combined,
-                   sigma_fn, offc_np, design_matrix: Optional[str]):
+                   sigma_fn, offc_np, design_matrix: Optional[str],
+                   aot_fingerprint: str = ""):
     """Shared two-block construction of an ``(x, p) -> (r, M, sigma,
     offc)`` assembly from a residual-rows function ``combined(x, p)``, a
     row-uncertainty function ``sigma_fn(p)`` and a host offset-regressor
@@ -357,6 +358,7 @@ def _make_assembly(model: TimingModel, names: Sequence[str], combined,
     assembly from pre-computed columns), ``.split`` (bool),
     ``.lin_names``/``.nl_names``, and ``.design_matrix``.
     """
+    from pint_tpu import aot
     from pint_tpu.utils import effective_platform
 
     names = list(names)
@@ -364,6 +366,11 @@ def _make_assembly(model: TimingModel, names: Sequence[str], combined,
     design_matrix = _resolve_design_matrix(design_matrix)
     lin_names, nl_names = model.partition_linear_params(names)
     offc_j = None if offc_np is None else jnp.asarray(offc_np)
+    # AOT store key for the jitted assembly programs: the caller's
+    # model/batch fingerprint (the batch rides these closures as baked
+    # constants) + the free-param slots and design-matrix mode
+    aot_fp = (f"{aot_fingerprint}|names={','.join(names)}"
+              f"|dm={design_matrix}|offc={offc_np is not None}")
 
     def _append_offset(M):
         if offc_j is None:
@@ -374,8 +381,10 @@ def _make_assembly(model: TimingModel, names: Sequence[str], combined,
         def primal(x, p):
             return combined(x, p), sigma_fn(p)
 
-        primal_j = jax.jit(primal)
-        jac_j = jax.jit(jax.jacfwd(combined))
+        primal_j = aot.serve("assembly_full_primal", jax.jit(primal),
+                             aot_fp)
+        jac_j = aot.serve("assembly_full_jac",
+                          jax.jit(jax.jacfwd(combined)), aot_fp)
 
         def assemble_inline(x, p):
             r, sigma = primal_j(x, p)
@@ -434,14 +443,17 @@ def _make_assembly(model: TimingModel, names: Sequence[str], combined,
                 x[nl_idx], x[lin_idx], p)
             return cols, jnp.max(jnp.abs(Jnl), axis=0)
 
-        refresh_j = jax.jit(refresh_fn)
+        refresh_j = aot.serve("assembly_refresh", jax.jit(refresh_fn),
+                              aot_fp)
         nl_jit_calls = 1
     else:
         def prim(x, p):
             return combined(x, p), sigma_fn(p)
 
-        prim_j = jax.jit(prim)
-        nl_jac_j = jax.jit(jax.jacfwd(resid_parts, argnums=0)) \
+        prim_j = aot.serve("assembly_primal", jax.jit(prim), aot_fp)
+        nl_jac_j = aot.serve(
+            "assembly_nljac",
+            jax.jit(jax.jacfwd(resid_parts, argnums=0)), aot_fp) \
             if n_nl else None
 
         def nl_block(x, p):
@@ -450,7 +462,8 @@ def _make_assembly(model: TimingModel, names: Sequence[str], combined,
                 jnp.zeros((r.shape[0], 0))
             return r, Jnl, sigma
 
-        lin_cols_j = jax.jit(lin_cols)
+        lin_cols_j = aot.serve("assembly_lincols", jax.jit(lin_cols),
+                               aot_fp)
 
         def refresh_j(x, p):
             cols = lin_cols_j(x, p)
@@ -472,7 +485,8 @@ def _make_assembly(model: TimingModel, names: Sequence[str], combined,
 
     # eager path: one jitted program per call (primal + nonlinear JVPs +
     # column scatter) when fused, plus a column refresh only when needed
-    asm_cols_j = jax.jit(inline_with_cols) if share else inline_with_cols
+    asm_cols_j = aot.serve("assembly_cols", jax.jit(inline_with_cols),
+                           aot_fp) if share else inline_with_cols
 
     state: dict = {}
 
@@ -541,6 +555,8 @@ def build_whitened_assembly(model: TimingModel, batch: TOABatch,
     steps.  ``design_matrix``: "split" (default; cached linear-block
     columns + nonlinear-core jacfwd) or "full" — see
     :func:`_make_assembly` for the split-path design."""
+    from pint_tpu import aot
+
     resid_sec = build_resid_sec_fn(model, batch, list(fit_params),
                                    track_mode)
 
@@ -549,7 +565,9 @@ def build_whitened_assembly(model: TimingModel, batch: TOABatch,
 
     offc_np = np.ones(batch.ntoas) if include_offset else None
     return _make_assembly(model, list(fit_params), resid_sec, sigma_fn,
-                          offc_np, design_matrix)
+                          offc_np, design_matrix,
+                          aot_fingerprint=aot.model_fingerprint(
+                              model, batch, track_mode, "nb"))
 
 
 def build_chi2_fn(model: TimingModel, batch: TOABatch,
@@ -646,8 +664,13 @@ def build_wideband_assembly(model: TimingModel, batch: TOABatch,
     offc_np = np.concatenate(
         [np.ones(nt), np.zeros(int(idx.shape[0]))]) if include_offset \
         else None
+    from pint_tpu import aot
+
     return _make_assembly(model, names, combined, sigma_fn, offc_np,
-                          design_matrix)
+                          design_matrix,
+                          aot_fingerprint=aot.model_fingerprint(
+                              model, batch, track_mode, "wb",
+                              "dm=" + aot.data_crc(dmv, dme, idx)))
 
 
 @dispatch_contract("gls_step", max_compiles=40, max_dispatches=3,
@@ -1095,7 +1118,7 @@ def _exact_assemble_factory(batch, default_builder):
 
 
 @dispatch_contract("wls_step", max_compiles=40, max_dispatches=3,
-                   max_transfers=3)
+                   max_transfers=3, warm_from_store=True)
 def build_wls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
@@ -1164,6 +1187,13 @@ def build_wls_step(model: TimingModel, batch: TOABatch,
     @jax.jit
     def solve(r, M, sigma, offc):
         return _solve(jnp, r, M, sigma, offc, kern)
+
+    from pint_tpu import aot
+
+    solve = aot.serve(
+        "wls_solve", solve,
+        f"npar={len(names)}|thr={threshold}"
+        f"|kern={getattr(kern, '__name__', str(kern))}")
 
     def step(x, p, exact=False, p_host=None):
         r, M, sigma, offc = assemble(x, p)
@@ -1273,7 +1303,7 @@ def _host_noise_basis(model: TimingModel, p_host: dict):
 
 
 @dispatch_contract("fused_fit", max_compiles=40, max_dispatches=1,
-                   max_transfers=2)
+                   max_transfers=2, warm_from_store=True)
 def build_fused_fit(model: TimingModel, batch: TOABatch,
                     fit_params: Sequence[str], track_mode: str, *,
                     threshold: Optional[float] = None,
@@ -1404,6 +1434,21 @@ def build_fused_fit(model: TimingModel, batch: TOABatch,
         tail = jnp.stack([status.astype(jnp.float64),
                           i.astype(jnp.float64), best_chi2])
         return jnp.concatenate([x, r, sigma, jnp.ravel(M), tail])
+
+    # AOT store (ISSUE 7): the whole-fit program is the single most
+    # expensive trace+compile in the package — a warm serving process
+    # deserializes it from disk instead (the batch rides the closure,
+    # so its data CRC is in the key)
+    from pint_tpu import aot
+
+    run = aot.serve(
+        "fused_fit", run,
+        aot.model_fingerprint(
+            model, batch, track_mode, f"names={','.join(names)}",
+            f"maxiter={maxiter}", f"tol={tol_chi2:g}",
+            f"thr={threshold}", f"offc={include_offset}",
+            f"dm={assemble.design_matrix}",
+            f"streak={diverge_streak}", f"stall={stall_iters}"))
 
     assemble_exact = _exact_assemble_factory(
         batch, lambda b: build_whitened_assembly(

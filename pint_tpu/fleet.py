@@ -537,6 +537,7 @@ class FleetFitter:
             "slot_pulsar": np.asarray(slot_pulsar, np.int64),
             "primary_slot": primary_slot, "n_slots": len(slot_pulsar),
             "p_max": int(p_max), "rep": rep, "delta_keys": delta_keys,
+            "skey_repr": {si: repr(k) for k, si in skeys.items()},
         }
         _log.info("fleet plan: %d pulsar(s) -> %d bucket(s), %d chunk(s) "
                   "of %d", len(self._pulsars), len(buckets),
@@ -568,6 +569,26 @@ class FleetFitter:
                 "PhaseOffset" not in rep.model.components,
                 self.maxiter, self.tol_chi2, kern, self.threshold,
                 self.diverge_streak, self.stall_iters)
+            if self._sharding is None:
+                # AOT store (ISSUE 7): bucket programs are the serving
+                # hot set — all inputs (params, padded batch, slots,
+                # masks) ride the call, so the key is pure structure:
+                # the bucket's structure-group key + padded shape +
+                # loop/solver configuration.  Deterministic bucket
+                # edges make these prebuildable (python -m pint_tpu.aot
+                # warm).  Explicitly-sharded programs are not served
+                # (an exported module pins its input shardings).
+                from pint_tpu import aot
+
+                prog = aot.serve(
+                    "fleet_bucket", prog,
+                    f"{plan['skey_repr'][bucket.skey_idx]}"
+                    f"|ntoa={bucket.n_toa}|nparam={bucket.n_param}"
+                    f"|maxiter={self.maxiter}|tol={self.tol_chi2:g}"
+                    f"|thr={self.threshold}"
+                    f"|kern={getattr(kern, '__name__', str(kern))}"
+                    f"|streak={self.diverge_streak}"
+                    f"|stall={self.stall_iters}")
             self._programs[key] = prog
         return prog
 
@@ -680,7 +701,7 @@ class FleetFitter:
     # steady state on the audit fixture is 2 chunk dispatches + 2
     # result fetches, compiles == retraces == 0
     @dispatch_contract("fleet_fit", max_compiles=24, max_dispatches=4,
-                       max_transfers=8)
+                       max_transfers=8, warm_from_store=True)
     def fit(self, *, checkpoint: Optional[str] = None,
             resume: bool = False, max_retries: int = 1,
             checkpoint_every: int = 1) -> FleetResult:

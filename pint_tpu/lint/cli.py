@@ -44,8 +44,9 @@ def _build_parser() -> argparse.ArgumentParser:
         description="Precision & trace-safety static analyzer for pint_tpu "
                     "(AST rules DD001/PREC001/TRACE001/TRACE002/JIT001/"
                     "JIT002, the JAXPR001 runtime jaxpr audit, and the "
-                    "CONTRACT001/CONTRACT002 dispatch-contract audit). "
-                    "Exit codes: 0 clean (always 0 with "
+                    "CONTRACT001/CONTRACT002/CONTRACT003 dispatch-"
+                    "contract audit incl. the warm-from-store cold-start "
+                    "axis). Exit codes: 0 clean (always 0 with "
                     "--update-baseline), 1 new findings, 2 usage error.")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the installed "
@@ -103,7 +104,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{name:20s} {c.qualname:30s} "
                   f"compiles<={c.max_compiles} "
                   f"dispatches<={c.max_dispatches} "
-                  f"transfers<={c.max_transfers}")
+                  f"transfers<={c.max_transfers}"
+                  + (" warm-from-store" if c.warm_from_store else ""))
         return 0
 
     select = ignore = None

@@ -39,6 +39,11 @@ call — and emits findings through the shared
   finding carries jax's own cache-miss attribution naming the unstable
   cache-key component (shapes / dtypes / weak_type / pytree structure /
   function identity / tracing context).
+* **CONTRACT003** — the cold-start axis (ISSUE 7): a
+  ``warm_from_store=True`` entrypoint, rebuilt against an AOT program
+  store its first build populated (:func:`check_warm`), compiled or
+  missed the store — the finding carries the ProgramKey-miss
+  attribution (which entry, which key digest, why it missed).
 
 Scan-shaped entrypoints whose programs are rebuilt per call
 (``mcmc_step``) are measured in *marginal* mode: a short run and a
@@ -84,6 +89,10 @@ class Contract(NamedTuple):
     qualname: str            #: decorated function, for attribution
     path: str                #: decoration site (suppression lookup)
     line: int
+    #: cold-start axis (ISSUE 7): the entrypoint consults the AOT
+    #: program store, and a warm-store rebuild of it must show ZERO
+    #: compiles (CONTRACT003 with ProgramKey-miss attribution)
+    warm_from_store: bool = False
 
 
 #: contract name -> Contract, populated at decoration (import) time
@@ -92,12 +101,19 @@ REGISTRY: Dict[str, Contract] = {}
 
 def dispatch_contract(name: str, *, max_compiles: int,
                       max_dispatches: int, max_transfers: int = 8,
-                      max_host_bytes: int = 1 << 22, warmup: int = 1):
+                      max_host_bytes: int = 1 << 22, warmup: int = 1,
+                      warm_from_store: bool = False):
     """Register a dispatch budget for a hot public entrypoint.
 
     Returns the function unchanged — zero call-time cost.  The audit
     drives the entrypoint through its driver in this module (a contract
     without a driver is itself reported, so budgets cannot silently rot).
+
+    ``warm_from_store=True`` adds the cold-start axis: the entrypoint's
+    programs are served by the AOT store (:mod:`pint_tpu.aot`), and
+    the audit's warm leg — rebuild the entrypoint against a store its
+    first build just populated — must show ZERO compiles (CONTRACT003,
+    attributed to the ProgramKey misses when it fails).
     """
     def deco(fn):
         import inspect
@@ -110,7 +126,8 @@ def dispatch_contract(name: str, *, max_compiles: int,
         REGISTRY[name] = Contract(
             name, int(max_compiles), int(max_dispatches),
             int(max_transfers), int(max_host_bytes), int(warmup),
-            getattr(fn, "__qualname__", str(fn)), path, line)
+            getattr(fn, "__qualname__", str(fn)), path, line,
+            bool(warm_from_store))
         fn.__dispatch_contract__ = name
         return fn
 
@@ -512,6 +529,107 @@ def check(name: str,
                           tuple(_judge(c, warm, steady)))
 
 
+def check_warm(name: str,
+               fixture: Optional[ContractFixture] = None
+               ) -> ContractReport:
+    """The cold-start axis (ISSUE 7) for a ``warm_from_store=True``
+    contract: build the entrypoint against a FRESH AOT store (leg A —
+    populates the store and, via the round-trip verify call, lands the
+    thin exported-call wrapper in the persistent compilation cache),
+    then REBUILD it (leg B: new function objects, empty tracing cache)
+    and measure the rebuilt call under instrumentation.  The warm leg
+    must show ZERO compiles — CONTRACT003 otherwise, attributed to the
+    ProgramKey misses the store recorded (or to a cold persistent
+    cache when the store itself hit)."""
+    import tempfile
+
+    import jax
+
+    from pint_tpu import aot
+
+    _ensure_registered()
+    c = REGISTRY.get(name)
+    if c is None:
+        raise KeyError(f"no dispatch contract named {name!r} "
+                       f"(registered: {sorted(REGISTRY)})")
+    if not c.warm_from_store:
+        raise ValueError(f"contract {name!r} is not warm_from_store")
+    builder = _DRIVERS.get(name)
+    if builder is None or not callable(builder):
+        return ContractReport(name, TraceCounters(), TraceCounters(), ())
+    fix = fixture if fixture is not None else ContractFixture()
+
+    findings: List[Finding] = []
+
+    def f(msg: str):
+        findings.append(Finding(
+            "CONTRACT003", c.path, c.line, 1,
+            f"contract '{c.name}' ({c.qualname}): {msg}",
+            source=f"@dispatch_contract('{c.name}')", origin="contract"))
+
+    # the warm leg needs a live persistent compilation cache for the
+    # exported-call wrappers; point one at the scratch dir if the
+    # process runs cacheless (PINT_TPU_XLA_CACHE=0)
+    with tempfile.TemporaryDirectory(prefix="pint_tpu_warm_") as td:
+        prev_cc = jax.config.jax_compilation_cache_dir
+        if prev_cc is None:
+            from jax._src import compilation_cache as _cc
+
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(td, "cc"))
+            _cc.reset_cache()
+        try:
+            with aot.temporary_store(os.path.join(td, "store")):
+                driver = builder(fix)
+                if "call" not in driver:
+                    raise ValueError(
+                        f"warm_from_store contract {name!r} needs a "
+                        "'call'-mode driver")
+                driver["call"]()          # leg A: populate the store
+                driver2 = builder(fix)    # leg B: fresh programs
+                mmark = aot.miss_mark()
+                cmark = aot.counters()
+                with instrument() as th:
+                    m0 = th.mark()
+                    driver2["call"]()     # the cold-start call
+                    m1 = th.mark()
+                    driver2["call"]()     # steady state on the warm path
+                    m2 = th.mark()
+                warm = m1 - m0
+                steady = m2 - m1
+                misses = aot.misses_since(mmark)
+                delta = aot.counters_since(cmark)
+        finally:
+            if prev_cc is None:
+                from jax._src import compilation_cache as _cc
+
+                jax.config.update("jax_compilation_cache_dir", prev_cc)
+                _cc.reset_cache()
+    n_compiles = warm.compiles + steady.compiles
+    # a ProgramKey miss on the warm leg means the store fell back to
+    # LIVE TRACING — the cost the store exists to kill — even when a
+    # warm persistent compilation cache absorbs the recompile itself
+    if n_compiles > 0 or steady.retraces or misses:
+        if misses:
+            attribution = "; ".join(
+                f"ProgramKey miss: entry '{m.entry}' key {m.digest} "
+                f"({m.reason})" for m in misses[:4])
+        elif delta.get("hits", 0) > 0 and n_compiles:
+            attribution = (
+                f"store HIT ({delta['hits']} program(s) served) but the "
+                "exported-call wrapper recompiled — persistent "
+                "compilation cache cold or lowering nondeterministic")
+        elif n_compiles or steady.retraces:
+            attribution = "no store traffic (serve() wrapper dropped?)"
+        else:
+            attribution = "unattributed"
+        f(f"warm-from-store leg failed the zero-compile start "
+          f"({n_compiles} compile(s), {len(steady.retraces)} steady "
+          f"retrace(s), {len(misses)} ProgramKey miss(es)) — "
+          f"{attribution}")
+    return ContractReport(name, warm, steady, tuple(findings))
+
+
 _SUPPRESS_CACHE: dict = {}
 
 
@@ -531,22 +649,34 @@ def _suppressed(c: Contract, code: str) -> bool:
 
 
 def audit_contracts(names: Optional[Sequence[str]] = None,
-                    fixture: Optional[ContractFixture] = None
-                    ) -> List[Finding]:
+                    fixture: Optional[ContractFixture] = None,
+                    warm_legs: Optional[bool] = None) -> List[Finding]:
     """Drive every registered contract (or the named subset) and return
     the unsanctioned findings — the ``--contracts`` CLI mode and the
-    tier-1 gate (tests/test_contracts.py)."""
+    tier-1 gate (tests/test_contracts.py).
+
+    ``warm_legs`` (default on; ``PINT_TPU_CONTRACT_WARM=0`` opts out)
+    adds the cold-start axis: every audited ``warm_from_store=True``
+    contract also runs :func:`check_warm` and must show zero compiles
+    against a store its own first build populated (CONTRACT003)."""
     _ensure_registered()
     targets = sorted(REGISTRY) if names is None else list(names)
     unknown = [n for n in targets if n not in REGISTRY]
     if unknown:
         raise KeyError(f"unknown contract(s) {unknown}; registered: "
                        f"{sorted(REGISTRY)}")
+    if warm_legs is None:
+        warm_legs = os.environ.get("PINT_TPU_CONTRACT_WARM", "1") != "0"
     fix = fixture if fixture is not None else ContractFixture()
     findings: List[Finding] = []
     for name in targets:
         rep = check(name, fixture=fix)
-        for f in rep.findings:
-            if not _suppressed(REGISTRY[name], f.code):
-                findings.append(f)
+        reps = [rep]
+        if warm_legs and REGISTRY[name].warm_from_store and \
+                name in _DRIVERS:
+            reps.append(check_warm(name, fixture=fix))
+        for r in reps:
+            for f in r.findings:
+                if not _suppressed(REGISTRY[name], f.code):
+                    findings.append(f)
     return findings

@@ -28,6 +28,14 @@ gives the linter eyes at that boundary:
   conservative floor (on a real accelerator every one of these is a
   tunnel round trip).
 * **block_until_ready** — explicit synchronization points.
+* **cache_hits / aot_hits** — persistent-compilation-cache executable
+  loads (the ``jax._src.compiler._cache_read`` funnel) and AOT
+  program-store loads (:mod:`pint_tpu.aot` reports via
+  :func:`note_aot_hit`).  Before these, a cache-served warm start was
+  indistinguishable from "nothing needed compiling"; entering
+  :func:`instrument` also SUSPENDS AOT store writes (like the
+  persistent-cache write suspension) so marginal-mode measurements
+  cannot load what their own base run traced.
 * **retraces** — ``jax_explain_cache_misses`` is enabled and the
   explanation log (``jax._src.pjit``) captured; each record is parsed
   into a :class:`RetraceEvent` naming the traced function and the
@@ -61,7 +69,13 @@ class RetraceEvent(NamedTuple):
 
 
 class TraceCounters(NamedTuple):
-    """A snapshot (or delta) of the instrumented quantities."""
+    """A snapshot (or delta) of the instrumented quantities.
+
+    ``cache_hits`` counts persistent-compilation-cache executable
+    loads and ``aot_hits`` AOT-store program loads — before these
+    existed, a cache-served program was indistinguishable from "no
+    compile happened", so a warm start could not be *attributed* (did
+    the store serve, or did nothing need compiling?)."""
 
     compiles: int = 0
     dispatches: int = 0
@@ -69,6 +83,8 @@ class TraceCounters(NamedTuple):
     transfers_h2d: int = 0
     host_bytes: int = 0
     block_until_ready: int = 0
+    cache_hits: int = 0           #: persistent compilation cache loads
+    aot_hits: int = 0             #: AOT program-store loads
     retraces: tuple = ()          # tuple[RetraceEvent, ...]
 
     def __sub__(self, other: "TraceCounters") -> "TraceCounters":
@@ -81,6 +97,8 @@ class TraceCounters(NamedTuple):
             self.transfers_h2d - other.transfers_h2d,
             self.host_bytes - other.host_bytes,
             self.block_until_ready - other.block_until_ready,
+            self.cache_hits - other.cache_hits,
+            self.aot_hits - other.aot_hits,
             self.retraces[len(other.retraces):])
 
     @property
@@ -92,6 +110,8 @@ class TraceCounters(NamedTuple):
                 "transfers": self.transfers,
                 "host_bytes": self.host_bytes,
                 "block_until_ready": self.block_until_ready,
+                "cache_hits": self.cache_hits,
+                "aot_hits": self.aot_hits,
                 "retraces": len(self.retraces)}
 
 
@@ -163,6 +183,16 @@ def is_active() -> bool:
     return _ACTIVE is not None
 
 
+def note_aot_hit() -> None:
+    """Called by :mod:`pint_tpu.aot` when a store load succeeds, so an
+    active instrumentation can attribute a zero-compile warm start to
+    the store rather than to "nothing needed compiling"."""
+    inst = _ACTIVE
+    if inst is not None:
+        with inst._lock:
+            inst._aot_hits += 1
+
+
 class Instrumentation:
     """Live counters for one :func:`instrument` context.
 
@@ -179,6 +209,8 @@ class Instrumentation:
         self._h2d = 0
         self._host_bytes = 0
         self._block = 0
+        self._cache_hits = 0
+        self._aot_hits = 0
         self._retraces: List[RetraceEvent] = []
 
     # -- reading -----------------------------------------------------------
@@ -186,7 +218,8 @@ class Instrumentation:
         with self._lock:
             return TraceCounters(self._compiles, self._dispatches,
                                  self._d2h, self._h2d, self._host_bytes,
-                                 self._block, tuple(self._retraces))
+                                 self._block, self._cache_hits,
+                                 self._aot_hits, tuple(self._retraces))
 
     def mark(self) -> TraceCounters:
         return self.counters()
@@ -220,6 +253,7 @@ def instrument() -> Iterator[Instrumentation]:
     orig_value = _array.ArrayImpl.__dict__["_value"]
     orig_block = _array.ArrayImpl.__dict__.get("block_until_ready")
     orig_device_put = jax.device_put
+    orig_cache_read = _compiler._cache_read
     orig_explain = jax.config.jax_explain_cache_misses
     orig_cache_min = jax.config.jax_persistent_cache_min_compile_time_secs
 
@@ -227,6 +261,16 @@ def instrument() -> Iterator[Instrumentation]:
         with inst._lock:
             inst._compiles += 1
         return orig_backend_compile(*a, **k)
+
+    def cache_read(*a, **k):
+        # the persistent-compilation-cache read funnel: a non-None
+        # executable is a cache HIT (the load that replaces a compile —
+        # distinguishable, now, from "no compile happened")
+        out = orig_cache_read(*a, **k)
+        if out and out[0] is not None:
+            with inst._lock:
+                inst._cache_hits += 1
+        return out
 
     def exec_call(self, *args):
         with inst._lock:
@@ -265,6 +309,7 @@ def instrument() -> Iterator[Instrumentation]:
     orig_cache_level = cache_logger.level
 
     _compiler.backend_compile = backend_compile
+    _compiler._cache_read = cache_read
     _pxla.ExecuteReplicated.__call__ = exec_call
     _pjit._get_fastpath_data = lambda *a, **k: None
     _array.ArrayImpl._value = property(value_getter)
@@ -283,6 +328,14 @@ def instrument() -> Iterator[Instrumentation]:
     # still served — measurement must observe the cache, not mutate it)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       1e9)
+    # ... and the same discipline for the AOT program store: a blob
+    # written between a marginal-mode base and extended run would make
+    # the extended run LOAD what the base run TRACED (reads stay
+    # served, so warm-start measurement still sees hits)
+    from pint_tpu import aot as _aot
+
+    aot_suspension = _aot.suspend_writes()
+    aot_suspension.__enter__()
     # evict the C++ fastpath entries of ALREADY-warm programs so their
     # dispatches route through the (counted) Python path; tracing and
     # executable caches are untouched — no recompilation is induced
@@ -297,7 +350,9 @@ def instrument() -> Iterator[Instrumentation]:
         yield inst
     finally:
         _ACTIVE = None
+        aot_suspension.__exit__(None, None, None)
         _compiler.backend_compile = orig_backend_compile
+        _compiler._cache_read = orig_cache_read
         _pxla.ExecuteReplicated.__call__ = orig_exec_call
         _pjit._get_fastpath_data = orig_fastpath
         _array.ArrayImpl._value = orig_value

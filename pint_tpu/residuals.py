@@ -74,7 +74,7 @@ def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
 
 
 @dispatch_contract("residuals", max_compiles=30, max_dispatches=1,
-                   max_transfers=1)
+                   max_transfers=1, warm_from_store=True)
 def build_resid_fn(model: TimingModel, batch: TOABatch,
                    track_mode: str, subtract_mean: bool, use_weights: bool):
     """A jitted ``(pdict) -> phase residuals [cycles]`` closure over the
@@ -85,8 +85,13 @@ def build_resid_fn(model: TimingModel, batch: TOABatch,
     ``retrace_storm``/``chatty_transfer`` failpoints
     (:mod:`pint_tpu.faultinject`) wrap the returned function so the
     contract auditor can be proven to catch real cache-key churn and
-    per-call host chatter."""
-    from pint_tpu import faultinject
+    per-call host chatter.
+
+    When an AOT program store is enabled (:mod:`pint_tpu.aot`), the
+    compiled program is served from disk instead of traced — the batch
+    data is a closure constant baked into the exported module, so the
+    ProgramKey fingerprint carries its CRC."""
+    from pint_tpu import aot, faultinject
 
     calc = model.calc
     noise = bool(model.noise_components)
@@ -97,8 +102,12 @@ def build_resid_fn(model: TimingModel, batch: TOABatch,
         return raw_phase_resids(calc, p, batch, track_mode,
                                 subtract_mean, use_weights, sigma_us=sigma)
 
+    served = aot.serve(
+        "residuals", fn,
+        aot.model_fingerprint(model, batch, track_mode, subtract_mean,
+                              use_weights, f"noise={noise}"))
     return faultinject.wrap(
-        "retrace_storm", faultinject.wrap("chatty_transfer", fn))
+        "retrace_storm", faultinject.wrap("chatty_transfer", served))
 
 
 class Residuals:

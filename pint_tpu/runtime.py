@@ -91,6 +91,9 @@ class BackendStatus(NamedTuple):
     #: persistent-compilation-cache directory wired for this process
     #: (None = caching disabled) — see :func:`configure_compile_cache`
     compile_cache_dir: Optional[str] = None
+    #: AOT program-store directory wired for this process (None =
+    #: disabled) — see :mod:`pint_tpu.aot` and ``warm_start=``
+    aot_store_dir: Optional[str] = None
 
     @property
     def degraded(self) -> bool:
@@ -100,7 +103,8 @@ class BackendStatus(NamedTuple):
         return {"backend_rung": self.rung,
                 "probe_attempts": self.attempts,
                 "probe_wait_s": round(self.wait_s, 3),
-                "compile_cache_dir": self.compile_cache_dir}
+                "compile_cache_dir": self.compile_cache_dir,
+                "aot_store_dir": self.aot_store_dir}
 
 
 def probe_backend(timeout_s: float = 120.0) -> Optional[str]:
@@ -188,11 +192,20 @@ def configure_compile_cache(path: Optional[str] = None) -> Optional[str]:
     return full
 
 
+def _configure_aot(warm_start: bool) -> Optional[str]:
+    """Wire the AOT program store for a warm-start process (or honor an
+    explicit ``PINT_TPU_AOT_STORE`` even without ``warm_start``)."""
+    from pint_tpu import aot
+
+    return aot.configure_store(enable=True if warm_start else None)
+
+
 def acquire_backend(max_attempts: Optional[int] = None,
                     probe_timeout_s: Optional[float] = None,
                     backoff_s: Optional[float] = None,
                     deadline_s: Optional[float] = None,
-                    probe: Optional[Callable] = None) -> BackendStatus:
+                    probe: Optional[Callable] = None,
+                    warm_start: Optional[bool] = None) -> BackendStatus:
     """Acquire a usable jax backend with bounded retries, then degrade.
 
     Probes the CURRENTLY CONFIGURED backend (whatever ``JAX_PLATFORMS``
@@ -203,12 +216,22 @@ def acquire_backend(max_attempts: Optional[int] = None,
     indefinitely, never returns "no backend": the CPU rung is in-process
     and cannot wedge, so it is trusted without a probe.
 
+    ``warm_start=True`` (or ``PINT_TPU_WARM_START=1``) additionally
+    loads the AOT program-store manifest (:mod:`pint_tpu.aot`,
+    default ``~/.cache/pint_tpu/aot`` or ``PINT_TPU_AOT_STORE``): hot
+    entrypoints then deserialize their compiled programs from disk
+    instead of tracing, and — with the persistent compilation cache
+    warm — a serving process starts with ZERO ``backend_compile``
+    calls.  Prebuild the store with ``python -m pint_tpu.aot warm``.
+
     Env-tunable defaults: ``PINT_TPU_PROBE_ATTEMPTS`` (3),
     ``PINT_TPU_PROBE_TIMEOUT_S`` (120), ``PINT_TPU_PROBE_BACKOFF_S``
     (2), ``PINT_TPU_PROBE_DEADLINE_S`` (420).  The probe is routed
     through the ``wedged_probe`` failpoint so the whole chain is
     drivable from tests and from a bench subprocess
     (``PINT_TPU_FAULTS=wedged_probe``)."""
+    if warm_start is None:
+        warm_start = os.environ.get("PINT_TPU_WARM_START") == "1"
     if max_attempts is None:
         max_attempts = int(_env_float("PINT_TPU_PROBE_ATTEMPTS", 3))
     if probe_timeout_s is None:
@@ -240,7 +263,8 @@ def acquire_backend(max_attempts: Optional[int] = None,
         if fail is None:
             return BackendStatus(True, primary, attempts, waited,
                                  probe_timeout_s, tuple(failures),
-                                 configure_compile_cache())
+                                 configure_compile_cache(),
+                                 _configure_aot(warm_start))
         failures.append(fail)
         profiling.count("runtime.probe_failure")
         _log.warning("backend probe attempt %d/%d failed: %s",
@@ -260,7 +284,8 @@ def acquire_backend(max_attempts: Optional[int] = None,
     _force_cpu()
     return BackendStatus(True, "cpu_fallback", attempts, waited,
                          probe_timeout_s, tuple(failures),
-                         configure_compile_cache())
+                         configure_compile_cache(),
+                         _configure_aot(warm_start))
 
 
 # --- verified atomic checkpoints ----------------------------------------------

@@ -1,0 +1,398 @@
+"""The AOT serving-program store (ISSUE 7): exported, disk-resident
+entrypoint programs and the zero-compile warm start.
+
+Four legs:
+
+* **store machinery** — atomic CRC-checksummed writes, LRU bounds,
+  manifest self-repair, and loud-but-safe invalidation: a corrupt
+  (``corrupt_aot_blob`` truncate/flip) or version-stale
+  (``stale_aot_version``) blob warns, falls back to live tracing, and
+  is OVERWRITTEN with a fresh blob — never a crash.
+* **serve()** — passthrough without a store, miss -> export +
+  round-trip verify + write, hit -> deserialized program, write
+  suspension under measurement (the tracehooks discipline), tracer
+  passthrough inside outer jits.
+* **round-trip parity** (satellite 3) — deserialized vs freshly traced
+  programs agree to chi2 <= 1e-10 on the B1855 fused fit and a
+  heterogeneous-slot (pmask) fleet bucket.
+* **zero-compile warm start** — a fresh rebuild of the quick serving
+  fixture against a warm store + warm persistent compilation cache
+  makes ZERO ``backend_compile`` calls (tracehooks-asserted; the
+  two-PROCESS version lives in tests/test_tooling.py, slow tier), and
+  CONTRACT003 fires with ProgramKey attribution when the store is
+  poisoned.
+
+Marker ``aot``; opt out on WIP branches with ``PINT_TPU_SKIP_AOT=1``
+(mirroring the contracts/fleet gates).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import aot, faultinject
+from pint_tpu.aot import (AotStoreWarning, ProgramStore, program_key,
+                          serve, temporary_store)
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PINT_TPU_SKIP_AOT") == "1",
+    reason="PINT_TPU_SKIP_AOT=1")
+
+
+@pytest.fixture()
+def store_dir(tmp_path):
+    return str(tmp_path / "store")
+
+
+@pytest.fixture(scope="module")
+def warm_cache(tmp_path_factory):
+    """A live persistent compilation cache for the zero-compile legs
+    (re-pointed at a module tmp dir so the suite never mutates the
+    user's cache), with min-compile-time 0 so the thin exported-call
+    wrappers persist."""
+    from jax._src import compilation_cache as _cc
+
+    d = str(tmp_path_factory.mktemp("cc"))
+    prev = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _cc.reset_cache()
+    yield d
+    jax.config.update("jax_compilation_cache_dir", prev)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      prev_min)
+    _cc.reset_cache()
+
+
+def _tiny_fn():
+    """A fresh tiny jitted program (new function identity per call, so
+    each serve() wrapper resolves independently)."""
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x) * 2.0 + jnp.sum(x)
+
+    return f
+
+
+X = np.linspace(0.0, 1.0, 17)
+
+
+# --- store machinery ----------------------------------------------------------
+
+class TestStoreMachinery:
+    def test_miss_writes_then_fresh_wrapper_hits(self, store_dir):
+        with temporary_store(store_dir) as store:
+            mark = aot.counters()
+            s1 = serve("tiny", _tiny_fn(), "fp")
+            out1 = np.asarray(s1(X))
+            d = aot.counters_since(mark)
+            assert d["misses"] == 1 and d["writes"] == 1
+            assert len(store.entries()) == 1
+            # a NEW wrapper (fresh process stand-in) must hit
+            s2 = serve("tiny", _tiny_fn(), "fp")
+            out2 = np.asarray(s2(X))
+            d = aot.counters_since(mark)
+            assert d["hits"] == 1 and d["writes"] == 1
+            # round-trip output is bit-identical here
+            np.testing.assert_array_equal(out1, out2)
+
+    def test_atomic_write_no_tmp_droppings(self, store_dir):
+        with temporary_store(store_dir) as store:
+            serve("tiny", _tiny_fn(), "fp")(X)
+            files = os.listdir(store.path)
+            assert not [f for f in files if ".tmp" in f], files
+
+    def test_key_separates_shapes_and_fingerprints(self, store_dir):
+        with temporary_store(store_dir) as store:
+            serve("tiny", _tiny_fn(), "fpA")(X)
+            serve("tiny", _tiny_fn(), "fpB")(X)        # fingerprint
+            serve("tiny", _tiny_fn(), "fpA")(X[:5])    # shape
+            assert len(store.entries()) == 3
+
+    def test_corrupt_truncate_falls_back_and_self_heals(self, store_dir):
+        with temporary_store(store_dir) as store:
+            serve("tiny", _tiny_fn(), "fp")(X)
+            (blob,) = store.entries()
+            path = os.path.join(store.path, blob)
+            mark = aot.counters()
+            with faultinject.corrupt_aot_blob(path, "truncate"):
+                with pytest.warns(AotStoreWarning, match="unusable"):
+                    out = np.asarray(serve("tiny", _tiny_fn(), "fp")(X))
+                # fallback produced the right numbers AND a fresh blob
+                np.testing.assert_allclose(
+                    out, np.asarray(_tiny_fn()(X)), rtol=0, atol=0)
+                assert os.path.exists(path)
+                with open(path, "rb") as fh:
+                    assert fh.read().startswith(b"PTAOT1\n")
+            d = aot.counters_since(mark)
+            assert d["invalidations"] == 1 and d["writes"] == 1
+
+    def test_corrupt_flip_caught_by_crc(self, store_dir):
+        with temporary_store(store_dir) as store:
+            serve("tiny", _tiny_fn(), "fp")(X)
+            (blob,) = store.entries()
+            path = os.path.join(store.path, blob)
+            mark = aot.counters()
+            with faultinject.corrupt_aot_blob(path, "flip"):
+                with pytest.warns(AotStoreWarning, match="CRC32"):
+                    serve("tiny", _tiny_fn(), "fp")(X)
+            d = aot.counters_since(mark)
+            assert d["invalidations"] == 1 and d["writes"] == 1
+
+    def test_stale_version_falls_back_and_overwrites(self, store_dir):
+        with temporary_store(store_dir) as store:
+            serve("tiny", _tiny_fn(), "fp")(X)
+            (blob,) = store.entries()
+            before = os.path.getmtime(os.path.join(store.path, blob))
+            mark = aot.counters()
+            with faultinject.stale_aot_version():
+                with pytest.warns(AotStoreWarning, match="stale"):
+                    serve("tiny", _tiny_fn(), "fp")(X)
+            d = aot.counters_since(mark)
+            assert d["invalidations"] == 1 and d["writes"] == 1
+            assert os.path.getmtime(
+                os.path.join(store.path, blob)) >= before
+
+    def test_lru_eviction_bounds_the_store(self, tmp_path):
+        with temporary_store(str(tmp_path / "lru"),
+                             max_entries=2) as store:
+            mark = aot.counters()
+            serve("tiny", _tiny_fn(), "fp0")(X)
+            serve("tiny", _tiny_fn(), "fp1")(X)
+            serve("tiny", _tiny_fn(), "fp2")(X)
+            assert len(store.entries()) == 2
+            assert aot.counters_since(mark)["evictions"] == 1
+
+    def test_manifest_rebuilt_from_directory(self, store_dir):
+        with temporary_store(store_dir) as store:
+            serve("tiny", _tiny_fn(), "fp")(X)
+            with open(os.path.join(store.path, store.MANIFEST),
+                      "w") as fh:
+                fh.write("{ not json")
+        # a new store object over the same dir reconciles from blobs
+        rebuilt = ProgramStore(store_dir)
+        assert len(rebuilt.entries()) == 1
+
+    def test_digest_mismatch_invalidates(self, store_dir):
+        with temporary_store(store_dir) as store:
+            serve("tiny", _tiny_fn(), "fpA")(X)
+            (blob,) = store.entries()
+            # masquerade the blob under a DIFFERENT key's filename
+            k2 = program_key("tiny", "fpB", (X,))
+            os.replace(os.path.join(store.path, blob),
+                       os.path.join(store.path, k2.filename))
+            with pytest.warns(AotStoreWarning, match="digest"):
+                assert store.load(k2) is None
+
+
+# --- the serve wrapper --------------------------------------------------------
+
+class TestServe:
+    def test_passthrough_without_store(self):
+        mark = aot.counters()
+        s = serve("tiny", _tiny_fn(), "fp")
+        np.testing.assert_allclose(np.asarray(s(X)),
+                                   np.asarray(_tiny_fn()(X)))
+        assert aot.counters_since(mark) == {k: 0 for k in mark}
+
+    def test_suspend_writes_blocks_population(self, store_dir):
+        with temporary_store(store_dir) as store:
+            with aot.suspend_writes():
+                serve("tiny", _tiny_fn(), "fp")(X)
+            assert store.entries() == {}
+            # reads stay served: populate, then hit under suspension
+            serve("tiny", _tiny_fn(), "fp")(X)
+            mark = aot.counters()
+            with aot.suspend_writes():
+                serve("tiny", _tiny_fn(), "fp")(X)
+            assert aot.counters_since(mark)["hits"] == 1
+
+    def test_instrument_suspends_store_writes(self, store_dir):
+        from pint_tpu.lint.tracehooks import instrument
+
+        with temporary_store(store_dir) as store:
+            with instrument():
+                serve("tiny", _tiny_fn(), "fp")(X)
+            assert store.entries() == {}
+
+    def test_tracer_passthrough_inside_outer_jit(self, store_dir):
+        with temporary_store(store_dir) as store:
+            s = serve("tiny", _tiny_fn(), "fp")
+
+            @jax.jit
+            def outer(x):
+                return s(x) + 1.0
+
+            outer(X)   # must not raise / touch the store
+            assert store.entries() == {}
+
+    def test_kwargs_not_supported_by_wrapper(self, store_dir):
+        # the serving surface is positional-arg jit programs
+        with temporary_store(store_dir):
+            s = serve("tiny", _tiny_fn(), "fp")
+            with pytest.raises(TypeError):
+                s(x=X)
+
+
+# --- round-trip parity (satellite 3) ------------------------------------------
+
+class TestRoundTripParity:
+    def test_b1855_fused_fit_parity(self, tmp_path, warm_cache):
+        """Deserialized vs freshly traced B1855 fused-fit program:
+        chi2 agreement <= 1e-10 (bit-identical on this fixture)."""
+        build, _ = aot._b1855_fixture()
+        live: dict = {}
+        build(live)     # store disabled: the freshly traced reference
+        assert live["b1855"]["status"] in ("CONVERGED", "MAXITER")
+        with temporary_store(str(tmp_path / "store")):
+            build2, _ = aot._b1855_fixture()
+            mark = aot.counters()
+            miss_out: dict = {}
+            build2(miss_out)     # miss path: export + verify + write
+            assert aot.counters_since(mark)["writes"] >= 3
+            build3, _ = aot._b1855_fixture()
+            warm_out: dict = {}
+            build3(warm_out)     # hit path: deserialized programs
+            assert aot.counters_since(mark)["hits"] >= 3
+        for out in (miss_out, warm_out):
+            assert abs(out["b1855"]["chi2"] - live["b1855"]["chi2"]) <= \
+                1e-10 * max(1.0, abs(live["b1855"]["chi2"]))
+            assert abs(out["b1855"]["step_chi2"]
+                       - live["b1855"]["step_chi2"]) <= 1e-10 * max(
+                           1.0, abs(live["b1855"]["step_chi2"]))
+            assert out["b1855"]["status"] == live["b1855"]["status"]
+
+    def test_fleet_bucket_parity_heterogeneous_slots(self, tmp_path,
+                                                     warm_cache):
+        """One fleet bucket program (mixed pmask: FD block free for one
+        member, frozen for its bucket-mate — the PR 6 heterogeneous
+        case) round-trips through the store to <= 1e-10 chi2."""
+        ff = _fleet_fixture_ff()
+        plan = ff._ensure_plan()
+        b = plan["buckets"][0]
+        assert not b.eager and len(set(
+            len(ff._pulsars[i].names) for i in b.members)) > 1, \
+            "bucket 0 must mix free-param widths (pmask case)"
+        prog_live = ff._bucket_program(b)       # store disabled: live
+        args = ff._chunk_args(0)
+        ref = np.asarray(prog_live(*args))
+        with temporary_store(str(tmp_path / "store")):
+            ff2 = _fleet_fixture_ff()
+            ff2._ensure_plan()
+            out_miss = np.asarray(ff2._bucket_program(b)(
+                *ff2._chunk_args(0)))
+            ff3 = _fleet_fixture_ff()
+            ff3._ensure_plan()
+            mark = aot.counters()
+            out_warm = np.asarray(ff3._bucket_program(b)(
+                *ff3._chunk_args(0)))
+            assert aot.counters_since(mark)["hits"] == 1
+        P = b.n_param
+        for out in (out_miss, out_warm):
+            assert out.shape == ref.shape
+            # chi2 column parity (padded members included)
+            np.testing.assert_allclose(out[:, P], ref[:, P], rtol=1e-10,
+                                       atol=1e-12)
+            np.testing.assert_allclose(out[:, :P], ref[:, :P],
+                                       rtol=1e-9, atol=1e-12)
+
+
+def _fleet_fixture_ff():
+    """The aot fleet4 FleetFitter itself (not its runner thunks)."""
+    from pint_tpu.fleet import FleetFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    pulsars = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i, n in enumerate((8, 8, 16, 16)):
+            par = aot._B1855_PAR.replace("B1855+09SIM", f"FLEET{i}")
+            model = get_model(par.strip().splitlines())
+            model.A1.frozen = True
+            model.TASC.frozen = True
+            if i % 2:
+                model.FD1.frozen = True
+                model.FD2.frozen = True
+            toas = make_fake_toas_uniform(
+                55000.0, 55060.0, n, model, obs="gbt", error_us=300.0,
+                freq_mhz=np.tile([1400.0, 800.0], (n + 1) // 2)[:n],
+                add_noise=True, seed=100 + i)
+            pulsars.append((f"FLEET{i}", model, toas))
+        return FleetFitter(pulsars, maxiter=3, chunk_size=2)
+
+
+# --- the zero-compile warm start ----------------------------------------------
+
+class TestZeroCompileWarmStart:
+    def test_quick_fixture_rebuild_is_zero_compile(self, tmp_path,
+                                                   warm_cache):
+        """The in-process acceptance leg: rebuild the quick serving
+        fixture against a store its first build populated — the
+        instrumented first calls must make ZERO backend_compile calls
+        and the steady calls ZERO retraces (the two-process version
+        rides tests/test_tooling.py)."""
+        from pint_tpu.lint.tracehooks import instrument
+
+        with temporary_store(str(tmp_path / "store")):
+            cold, _ = aot._quick_fixture()
+            cold({})                      # populate store + wrapper cache
+            cold2, steady2 = aot._quick_fixture()
+            with instrument() as th:
+                m0 = th.mark()
+                cold2({})
+                m1 = th.mark()
+                steady2({})
+                m2 = th.mark()
+            first = m1 - m0
+            steady = m2 - m1
+        assert first.compiles == 0, (
+            f"warm rebuild compiled {first.compiles}x")
+        assert first.aot_hits >= 4, first.as_dict()
+        assert first.cache_hits >= 1, first.as_dict()
+        assert steady.compiles == 0
+        assert not steady.retraces, [
+            f"{e.fn_name}: {e.component}" for e in steady.retraces]
+
+    def test_contract003_fires_on_poisoned_store(self, warm_cache):
+        """CONTRACT003 with ProgramKey-miss attribution: a version-
+        stale store makes the residuals warm leg recompile, and the
+        finding names the missed key."""
+        from pint_tpu.lint.contracts import ContractFixture, check_warm
+
+        fix = ContractFixture()
+        with faultinject.stale_aot_version(), \
+                warnings.catch_warnings():
+            warnings.simplefilter("ignore", AotStoreWarning)
+            rep = check_warm("residuals", fixture=fix)
+        assert rep.findings, "poisoned store must fail the warm leg"
+        (finding,) = rep.findings
+        assert finding.code == "CONTRACT003"
+        assert "ProgramKey miss" in finding.message
+        assert "stale" in finding.message
+        # and the clean leg on the same fixture passes
+        rep2 = check_warm("residuals", fixture=fix)
+        assert rep2.findings == (), [f.format() for f in rep2.findings]
+
+    def test_acquire_backend_warm_start_wires_the_store(self, tmp_path,
+                                                        monkeypatch):
+        from pint_tpu import runtime
+
+        monkeypatch.setenv("PINT_TPU_AOT_STORE",
+                           str(tmp_path / "store"))
+        prev = aot.get_store()
+        try:
+            status = runtime.acquire_backend(warm_start=True)
+            assert status.aot_store_dir == str(tmp_path / "store")
+            assert aot.get_store() is not None
+            assert aot.get_store().path == str(tmp_path / "store")
+            assert status.as_dict()["aot_store_dir"] == \
+                str(tmp_path / "store")
+        finally:
+            aot._set_store(prev)

@@ -44,13 +44,17 @@ def quick_line():
 def wedged_line():
     """--quick with the backend probe wedged from OUTSIDE the process
     (PINT_TPU_FAULTS crosses the subprocess boundary) and fast backoff
-    so the bounded retries do not slow the suite."""
+    so the bounded retries do not slow the suite.  PINT_TPU_BENCH_FAST
+    skips the fleet submetric and the AOT cold/warm subprocess legs:
+    this fixture exercises the acquisition chain, and those legs would
+    re-pay a full fleet run + cold compile per fixture."""
     return _run_quick({"PINT_TPU_FAULTS": "wedged_probe",
                        "PINT_TPU_PROBE_ATTEMPTS": "2",
-                       "PINT_TPU_PROBE_BACKOFF_S": "0.05"})
+                       "PINT_TPU_PROBE_BACKOFF_S": "0.05",
+                       "PINT_TPU_BENCH_FAST": "1"})
 
 
-def _assert_schema(d):
+def _assert_schema(d, fast=False):
     # required keys shared with the headline bench line
     for key, typ in (("metric", str), ("unit", str), ("backend", str),
                      ("mode", str), ("design_matrix", str),
@@ -71,14 +75,22 @@ def _assert_schema(d):
                 "retraces"):
         assert isinstance(dc.get(key), int), (key, dc.get(key))
     assert dc["dispatches"] >= 1          # the fit really ran
-    # compile-tax + fleet axes (ISSUE 6): cold_start_s tracks process
-    # start -> first fitted number (shrinks when the persistent
-    # compilation cache is warm); fleet_fits_per_sec supersedes the
-    # old ensemble_32 single-shape submetric
-    assert isinstance(d.get("cold_start_s"), (int, float))
-    assert d["cold_start_s"] > 0
+    # cold-start axis (ISSUE 7, supersedes cold_start_s — MIGRATION.md):
+    # the two-process AOT legs' walls + store counters
+    assert "cold_start_cold_s" in d and "cold_start_warm_s" in d
+    assert isinstance(d.get("aot_store"), dict)
+    if fast:
+        return
+    # fleet axis (ISSUE 6): supersedes the old ensemble_32 submetric
     assert isinstance(d.get("fleet_fits_per_sec"), (int, float))
     assert d["fleet_fits_per_sec"] > 0
+    assert isinstance(d["cold_start_cold_s"], (int, float))
+    assert isinstance(d["cold_start_warm_s"], (int, float))
+    assert d["cold_start_cold_s"] > 0 and d["cold_start_warm_s"] > 0
+    st = d["aot_store"]
+    for key in ("store_writes", "aot_hits", "cache_hits",
+                "warm_compiles", "warm_retraces", "warm_misses"):
+        assert isinstance(st.get(key), int), (key, st.get(key))
 
 
 def test_quick_steady_state_never_recompiles(quick_line):
@@ -137,13 +149,33 @@ def test_fleet_submetric(quick_line):
     assert quick_line["fleet_fits_per_sec"] == fl["fleet_fits_per_sec"]
 
 
+def test_aot_cold_start_split(quick_line):
+    """ISSUE 7 acceptance: the quick line reports the AOT cold/warm
+    split — a warm process (store prebuilt by the cold leg) must start
+    MUCH faster than the cold one and make zero backend_compile
+    calls.  The bench-facing bar is >= 3x; the test asserts >= 2x so a
+    loaded CI core cannot flake tier-1 on timing noise alone."""
+    d = quick_line
+    sub = d["submetrics"].get("aot_cold_start")
+    assert isinstance(sub, dict) and "error" not in sub, sub
+    assert d["cold_start_cold_s"] == sub["cold_start_cold_s"]
+    assert d["cold_start_warm_s"] == sub["cold_start_warm_s"]
+    assert sub["cold_start_warm_s"] * 2 < sub["cold_start_cold_s"], sub
+    # the warm leg's zero-compile proof, carried in the line itself
+    assert d["aot_store"]["warm_compiles"] == 0, d["aot_store"]
+    assert d["aot_store"]["warm_retraces"] == 0, d["aot_store"]
+    assert d["aot_store"]["warm_misses"] == 0, d["aot_store"]
+    assert d["aot_store"]["aot_hits"] > 0, d["aot_store"]
+    assert d["aot_store"]["store_writes"] > 0, d["aot_store"]
+
+
 def test_wedged_probe_yields_tagged_cpu_fallback(wedged_line):
     """ISSUE 4 acceptance: the BENCH r05 regression driven end-to-end —
     a wedged backend probe yields a schema-valid, TAGGED cpu_fallback
     result after bounded retries, with the acquisition provenance in
     the line, never a null metric."""
     d = wedged_line
-    _assert_schema(d)
+    _assert_schema(d, fast=True)
     assert d["backend"] == "cpu_fallback"
     assert d["backend_rung"] == "cpu_fallback"
     assert d["probe_attempts"] == 2            # bounded, as configured

@@ -73,8 +73,12 @@ class TestCleanLeg:
 
     def test_audit_passes_clean(self, fixture):
         """THE tier-1 gate: zero unsanctioned findings over every
-        registered entrypoint."""
-        findings = audit_contracts(fixture=fixture)
+        registered entrypoint.  The warm-from-store legs (CONTRACT003)
+        are skipped HERE for tier-1 budget — they re-build and
+        re-export four entrypoints — and enforced instead by
+        tests/test_aot.py (clean + poisoned-store legs) and the
+        ``--contracts`` CLI, which runs them by default."""
+        findings = audit_contracts(fixture=fixture, warm_legs=False)
         assert findings == [], [f.format() for f in findings]
 
     def test_zero_steady_state_recompiles_everywhere(self, reports):

@@ -438,6 +438,68 @@ class TestContractsGate:
             assert name in proc.stdout, proc.stdout
 
 
+class TestAotColdStart:
+    """The REAL two-process cold-start proof (ISSUE 7 acceptance):
+    process A prebuilds the AOT store (``python -m pint_tpu.aot warm``
+    — traces, compiles, exports the B1855 fused fit / WLS step /
+    residuals and the 4-pulsar ragged fleet's bucket programs);
+    process B (``python -m pint_tpu.aot check``) deserializes them and
+    must fit with ZERO ``backend_compile`` calls and zero steady-state
+    retraces, tracehooks-asserted.  Marker ``aot``; opt out with
+    ``PINT_TPU_SKIP_AOT=1`` (conftest.py)."""
+
+    @staticmethod
+    def _run(args, env_extra):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra)
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.aot", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_warm_process_fits_with_zero_compiles(self, tmp_path):
+        import json
+
+        env = {"PINT_TPU_AOT_STORE": str(tmp_path / "store"),
+               "PINT_TPU_XLA_CACHE": str(tmp_path / "cc")}
+        # process A: trace + compile + export + write
+        pa = self._run(["warm", "--fixtures", "b1855,fleet4"], env)
+        assert pa.returncode == 0, pa.stderr[-800:]
+        doc_a = json.loads(pa.stdout.splitlines()[-1])
+        assert doc_a["counters"]["writes"] > 0
+        assert doc_a["counters"]["verify_failures"] == 0
+        assert doc_a["results"]["b1855"]["status"] in ("CONVERGED",
+                                                       "MAXITER")
+        assert doc_a["results"]["fleet4"]["n_ok"] == 4
+        # process B: deserialize + fit, instrumented — ZERO compiles
+        pb = self._run(["check", "--fixtures", "b1855,fleet4"], env)
+        assert pb.returncode == 0, pb.stdout + pb.stderr[-800:]
+        doc_b = json.loads(pb.stdout.splitlines()[-1])
+        assert doc_b["compiles"] == 0, doc_b
+        assert doc_b["retraces"] == 0, doc_b
+        assert doc_b["misses"] == [], doc_b["misses"]
+        assert doc_b["aot_hits"] >= 7          # resid/wls/fused x2 + buckets
+        # the warm process produced the SAME physics
+        assert doc_b["results"]["b1855"]["chi2"] == pytest.approx(
+            doc_a["results"]["b1855"]["chi2"], abs=1e-10)
+        assert doc_b["results"]["fleet4"]["chi2"] == pytest.approx(
+            doc_a["results"]["fleet4"]["chi2"], abs=1e-10)
+
+    def test_check_against_cold_store_fails_loud(self, tmp_path):
+        import json
+
+        env = {"PINT_TPU_AOT_STORE": str(tmp_path / "store"),
+               "PINT_TPU_XLA_CACHE": str(tmp_path / "cc")}
+        p = self._run(["check", "--fixtures", "quick"], env)
+        assert p.returncode == 1, (p.returncode, p.stdout[-400:])
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["misses"], "a cold store must report ProgramKey misses"
+        assert all(m["reason"] == "absent" for m in doc["misses"])
+
+
 class TestTupleChisq:
     def test_matches_grid(self):
         """tuple_chisq over an arbitrary point list equals grid_chisq_flat
