@@ -546,12 +546,72 @@ def bench_sharded_scaling():
     rel = float(np.max(np.abs(chi2_sh - chi2_1) /
                        np.maximum(np.abs(chi2_1), 1.0)))
     assert rel < 1e-6, f"sharded path diverged from single-device: {rel}"
+
+    # communication profile of the program that just ran: lower the same
+    # cached shard_map program (identical cache key to the fast path
+    # above) and read the collectives off the compiled HLO.  The batch
+    # axis carries whole grid points, so a correctly sharded program
+    # moves reductions over "toa" only — any all-gather here would mean
+    # XLA resolved an output replicated, i.e. the scaling story is
+    # broken even though chi2 still agrees.
+    from pint_tpu.lint.hlo_audit import analyze_compiled
+    from pint_tpu.parallel import prep_sharded_grid
+    fit, stacked, batch, _ = prep_sharded_grid(
+        f, grid, mesh, mesh.devices.shape[0], 2, "sharded")
+    prof = analyze_compiled(fit.lower(stacked, batch).compile(), mesh)
+
     return {"chi2_rel_err_vs_1dev": float(f"{rel:.2e}"),
             "wall_s_8dev": round(t_sh, 3), "wall_s_1dev": round(t_1, 3),
             "host_cpu_cores": len(os.sched_getaffinity(0)),
             "note": ("single-core host: virtual-device wall-clock is "
                      "emulation overhead, not scaling; see docstring"),
+            "collectives": dict(sorted(prof.counts.items())),
+            "comm_bytes": int(prof.comm_bytes),
+            "all_gather_bytes": int(
+                prof.bytes_by_category.get("all-gather", 0)),
+            "device_peak_bytes": int(prof.peak_bytes),
             "ntoas": toas.ntoas, "nfit": len(f.fit_params), "ngrid": 8}
+
+
+def bench_comm_profile():
+    """Compiled-HLO communication profile of the batch-sharded grid
+    program (ISSUE 10): lower the same shard_map program the
+    CONTRACT004 audit drives, under the 8-virtual-device CPU mesh, and
+    read collective op counts + moved bytes off the compiled HLO.  The
+    headline invariant is ``all_gather_bytes == 0``: the batch axis
+    carries whole grid points, so an all-gather would mean XLA resolved
+    an output replicated and the scaling story is broken — even though
+    chi2 still agrees bitwise.  Schema-checked (quick mode) in
+    tests/test_bench_quick.py; must run in a fresh process (the device
+    count is fixed at jax init)."""
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    assert jax.default_backend() == "cpu" and len(jax.devices()) >= 8, \
+        "need an 8-virtual-device CPU backend (call before jax init)"
+    from pint_tpu.lint import hlo_audit
+    from pint_tpu.lint.contracts import ContractFixture
+
+    prog = hlo_audit.HLO_DRIVERS["sharded_chunk"](ContractFixture())
+    prof = hlo_audit.analyze_compiled(prog.compiled, prog.mesh)
+    return {"collectives": dict(sorted(prof.counts.items())),
+            "comm_bytes": int(prof.comm_bytes),
+            "all_gather_bytes": int(
+                prof.bytes_by_category.get("all-gather", 0)),
+            "device_peak_bytes": int(prof.peak_bytes),
+            "n_devices": len(jax.devices()),
+            "mesh_shape": list(prog.mesh.devices.shape)}
 
 
 def _run_in_subprocess(func_name: str, timeout_s: float = 900):
@@ -652,6 +712,19 @@ def bench_quick(backend_status=None):
             aot_cold = bench_cold_start()
         except Exception as e:  # keep the quick line alive
             aot_cold = {"error": f"{type(e).__name__}: {e}"}
+    # SPMD communication profile (ISSUE 10): the batch-sharded grid
+    # program's collectives off the compiled HLO, in a fresh process
+    # (8 virtual devices must be forced before jax init — this process
+    # already initialized on 1).  all_gather_bytes == 0 is the
+    # no-implicit-gather invariant tests/test_bench_quick.py asserts.
+    if fast:
+        comm = {"skipped": "PINT_TPU_BENCH_FAST=1"}
+    else:
+        try:
+            comm = _run_in_subprocess("bench_comm_profile",
+                                      timeout_s=600)
+        except Exception as e:  # keep the quick line alive
+            comm = {"error": f"{type(e).__name__}: {e}"}
     # supervised-acquisition provenance (ISSUE 4): how the backend was
     # obtained — a wedged-probe run shows up as backend_rung
     # "cpu_fallback" with attempts > 1 instead of a null metric
@@ -690,7 +763,14 @@ def bench_quick(backend_status=None):
         # retraces must stay 0 on a warm fit — the regression axis
         # beyond wall-clock, schema-checked in tests/test_bench_quick.py
         "dispatch_counters": counters,
-        "submetrics": {"fleet": fleet, "aot_cold_start": aot_cold},
+        # SPMD comm profile (ISSUE 10): collective op counts / moved
+        # bytes of the batch-sharded grid program; all_gather_bytes
+        # must stay 0 (no implicit replication of sharded outputs)
+        "collectives": comm.get("collectives"),
+        "comm_bytes": comm.get("comm_bytes"),
+        "all_gather_bytes": comm.get("all_gather_bytes"),
+        "submetrics": {"fleet": fleet, "aot_cold_start": aot_cold,
+                       "comm_profile": comm},
     }
 
 
@@ -847,6 +927,16 @@ def main(argv=None):
         # steady-state XLA-boundary counters (ISSUE 5): the regression
         # axis beyond wall-clock — compiles/retraces must stay 0
         "dispatch_counters": headline_counters,
+        # SPMD comm profile (ISSUE 10): collective op counts / moved
+        # bytes of the batch-sharded grid program, read off the
+        # compiled HLO by the sharded_8dev_cpu leg; all_gather_bytes
+        # must stay 0 (the no-implicit-gather invariant)
+        "collectives": (submetrics.get("sharded_8dev_cpu") or {}).get(
+            "collectives"),
+        "comm_bytes": (submetrics.get("sharded_8dev_cpu") or {}).get(
+            "comm_bytes"),
+        "all_gather_bytes": (submetrics.get("sharded_8dev_cpu") or {})
+        .get("all_gather_bytes"),
         # >0: compile_s figures are cache-LOAD cost (~10 s/program over
         # the tunnel), not recompiles
         "xla_cache_entries_at_start": n_cached,

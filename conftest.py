@@ -81,6 +81,29 @@ _SLOW_TESTS = {
     ("test_binary_ell1.py", "TestFitRoundtrip"),
     ("test_aux_components.py", "TestPLFlavors"),
     ("test_design_split.py", "TestSpeed"),
+    # tier-1 re-tune (2026-08, suite at 957 s of the 870 s budget after
+    # the comm-audit gate landed): the measured top-10 depth legs whose
+    # headline property stays covered by a cheaper tier-1 neighbour —
+    # grid split-vs-full parity (22.6 s; the grid_chunk contract and
+    # TestParity matrix legs remain), the 3-iter program-count fit
+    # (22.3 s; one_device_program + the split_assembly contract's
+    # dispatches<=2 remain), the end-to-end split fit parity (12.7 s;
+    # the 1e-12 matrix parity remains), and the sigterm resume leg
+    # (13.4 s; still selected by ``-m preempt``)
+    ("test_design_split.py", "TestGridConsistency"),
+    ("test_design_split.py", "test_split_fit_launches_fewer_programs"),
+    ("test_design_split.py", "test_fit_parity"),
+    ("test_design_split.py", "TestCheckpointResume"),
+    # bucket-poisoning recovery depth (22.3 s): the chunk_raise reroute
+    # leg keeps the requeue path tier-1; ``-m fleet`` still runs this
+    ("test_fleet.py", "test_degenerate_pulsar_does_not_poison"),
+    # integrated-ephemeris analytic parity depth (19.7 s): the rest of
+    # TestIntegratedEphemeris plus test_ephemcal_units stay tier-1
+    ("test_astronomy.py", "test_matches_analytic_and_is_smooth"),
+    # degenerate-oscillator chain recovery depth (41.1 s): the chain
+    # still provably fires tier-1 via the nan-solver LM-rung recovery
+    # and typed whole-chain-failure legs; ``-m faults`` still runs this
+    ("test_faults.py", "test_oscillator_diverges_fused_and_recovers"),
     # export round-trip parity on the B1855/fleet fixtures compiles the
     # full serving programs three times, and the in-process quick-
     # fixture zero-compile leg builds its serving set twice — depth
@@ -89,6 +112,12 @@ _SLOW_TESTS = {
     # clean/poisoned legs and serve()'s write-time round-trip verify.
     ("test_aot.py", "TestRoundTripParity"),
     ("test_aot.py", "test_quick_fixture_rebuild"),
+    # the in-process chatty_collective leg rebuilds the whole contract
+    # fixture under the failpoint (~8 s); tier-1 keeps the clean
+    # CONTRACT004 gate (TestCommContractsClean) and the subprocess
+    # chatty leg rides test_tooling.py — this is the redundant depth
+    # copy
+    ("test_hlo_audit.py", "test_chatty_collective_fails"),
 }
 
 
@@ -153,6 +182,27 @@ def pytest_configure(config):
         "WIP branches with PINT_TPU_SKIP_AOT=1)")
 
 
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Top-10 slowest tests on every run: tier-1 lives inside a hard
+    870 s budget (currently ~90% spent), so the worst offenders stay
+    visible without anyone remembering to pass ``--durations`` — the
+    tier assignments above are re-tuned from this table."""
+    durations = []
+    for reports in terminalreporter.stats.values():
+        for rep in reports:
+            if getattr(rep, "when", None) == "call":
+                durations.append((rep.duration, rep.nodeid))
+    if not durations:
+        return
+    durations.sort(reverse=True, key=lambda t: t[0])
+    total = sum(d for d, _ in durations)
+    terminalreporter.write_sep(
+        "=", f"slowest 10 of {len(durations)} tests "
+             f"({total:.0f}s in test calls)")
+    for d, nodeid in durations[:10]:
+        terminalreporter.write_line(f"{d:7.2f}s {nodeid}")
+
+
 def pytest_collection_modifyitems(config, items):
     import os
 
@@ -180,8 +230,9 @@ def pytest_collection_modifyitems(config, items):
             if skip_fleet:
                 item.add_marker(_pytest.mark.skip(
                     reason="PINT_TPU_SKIP_FLEET=1"))
-        if fname == "test_contracts.py":
-            # the compiled-program contract gate rides tier-1 next to
+        if fname in ("test_contracts.py", "test_hlo_audit.py"):
+            # the compiled-program contract gate (dispatch budgets +
+            # the CONTRACT004 SPMD comm audit) rides tier-1 next to
             # the lint gate; WIP branches opt out with
             # PINT_TPU_SKIP_CONTRACTS=1
             item.add_marker(_pytest.mark.contracts)
@@ -189,8 +240,9 @@ def pytest_collection_modifyitems(config, items):
                 item.add_marker(_pytest.mark.skip(
                     reason="PINT_TPU_SKIP_CONTRACTS=1"))
         if fname == "test_faults.py":
-            # deliberately NOT slow-marked: the guards are tier-1
-            # robustness evidence
+            # deliberately NOT a slow FILE: the guards are tier-1
+            # robustness evidence (one measured depth leg rides
+            # _SLOW_TESTS; ``-m faults`` still selects it)
             item.add_marker(_pytest.mark.faults)
         if fname == "test_lint.py":
             # the static-analysis gate rides in the smoke tier so every

@@ -853,16 +853,22 @@ EPHEM DE421
 
 
 def _single_pulsar_fixture(tag: str, par: str, ntoas: int, span: float,
-                           seed: int):
+                           seed: int, with_gls: bool = False):
     """Two-phase single-pulsar serving fixture: the returned builder
     does everything EXCEPT entrypoint calls (data simulation, model
     build, program construction), so the check harness can instrument
     the calls alone; it returns ``(cold, steady)`` thunks — ``cold``
     makes every first call (where store resolution happens), ``steady``
-    repeats them on the already-resolved programs."""
+    repeats them on the already-resolved programs.
+
+    ``with_gls`` adds the host-solve serving shapes ROADMAP item 2 left
+    open: one GLS step (the served ``gls_solve`` program on the CPU
+    backend) and one wideband GLS step (same solve program at the
+    stacked TOA+DM row count, through the served wideband assembly)."""
     import warnings as _w
 
-    from pint_tpu.fitter import build_fused_fit, build_wls_step
+    from pint_tpu.fitter import (build_fused_fit, build_gls_step,
+                                 build_wideband_assembly, build_wls_step)
     from pint_tpu.models import get_model
     from pint_tpu.residuals import Residuals
     from pint_tpu.simulation import make_fake_toas_uniform
@@ -883,6 +889,21 @@ def _single_pulsar_fixture(tag: str, par: str, ntoas: int, span: float,
         fit = build_fused_fit(model, resid.batch, names,
                               resid.track_mode, maxiter=3,
                               exact_floor=0.0)
+        gls = wb = None
+        if with_gls:
+            gls = build_gls_step(model, resid.batch, names,
+                                 resid.track_mode)
+            # deterministic wideband DM rows: the model's DM value plus
+            # a seeded perturbation, one measurement per TOA
+            rng = np.random.default_rng(seed)
+            dm0 = float(np.asarray(model.DM.value))
+            dm_data = dm0 + rng.normal(0.0, 1e-4, toas.ntoas)
+            wb_assemble = build_wideband_assembly(
+                model, resid.batch, np.arange(toas.ntoas),
+                dm_data, np.full(toas.ntoas, 1e-3), names,
+                resid.track_mode, True)
+            wb = build_gls_step(model, resid.batch, names,
+                                resid.track_mode, assemble=wb_assemble)
     x0 = np.zeros(len(names))
     p = resid.pdict
 
@@ -895,6 +916,9 @@ def _single_pulsar_fixture(tag: str, par: str, ntoas: int, span: float,
                     "status": info["status"].name,
                     "rms_cycles": float(np.std(r)),
                     "step_chi2": float(s["chi2"])}
+        if gls is not None:
+            out[tag]["gls_chi2"] = float(gls(x0, p)["chi2"])
+            out[tag]["wb_chi2"] = float(wb(x0, p)["chi2"])
 
     return run, run
 
@@ -906,8 +930,12 @@ def _quick_fixture():
 
 
 def _b1855_fixture():
-    """B1855-class (ELL1 binary + FD block) serving fixture."""
-    return _single_pulsar_fixture("b1855", _B1855_PAR, 64, 60.0, 1855)
+    """B1855-class (ELL1 binary + FD block) serving fixture, including
+    the GLS and wideband host-solve serving shapes (ROADMAP item 2's
+    leftover — the ``gls_solve`` program at both the narrowband and the
+    stacked TOA+DM row counts)."""
+    return _single_pulsar_fixture("b1855", _B1855_PAR, 64, 60.0, 1855,
+                                  with_gls=True)
 
 
 def _fleet4_fixture():

@@ -51,7 +51,8 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "nonfinite_noise_grad", "corrupt_toa_errors", "corrupt_mjds",
            "wedged_probe", "chunk_nonfinite", "chunk_raise",
            "sigterm_midscan", "corrupt_checkpoint", "retrace_storm",
-           "chatty_transfer", "corrupt_aot_blob", "stale_aot_version"]
+           "chatty_transfer", "chatty_collective", "corrupt_aot_blob",
+           "stale_aot_version"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -434,6 +435,42 @@ def chatty_transfer() -> Iterator[None]:
         yield
 
 
+def _chatty_collective_factory(fn):
+    """Wrap the sharded grid's per-shard fit body with one extra
+    cross-batch all-reduce per chunk — the "gratuitous collective"
+    regression an innocent-looking global reduction (progress metric,
+    convergence check) smuggles into a sharded program.  The wrap is
+    VALUE-PRESERVING: ``min(chi2, pmax(chi2, "batch"))`` is ``chi2``
+    elementwise (the cross-shard max is >= every shard's value), so
+    results and dispatch counters stay clean and only the compiled-HLO
+    comm audit can see it — XLA cannot fold the op away (the result
+    feeds the output) nor merge it with the steady "toa"-axis
+    collectives (different replica groups, different reduction).  The
+    auditor must fail CONTRACT004 on the all-reduce count."""
+    def chatty(p, b):
+        import jax
+        import jax.numpy as jnp
+
+        chi2, x = fn(p, b)
+        chi2 = jnp.minimum(chi2, jax.lax.pmax(chi2, "batch"))
+        return chi2, x
+    return chatty
+
+
+@contextlib.contextmanager
+def chatty_collective() -> Iterator[None]:
+    """Failpoint ``"chatty_collective"``: sharded grid programs built
+    inside the context carry one extra cross-batch all-reduce per chunk
+    (see :func:`pint_tpu.parallel.build_sharded_grid_fit`, which
+    consults this failpoint at build time).  Build the program INSIDE
+    the context with a FRESH fitter — the compiled-program caches on an
+    existing fitter would serve the clean program.  Env-activatable
+    (``PINT_TPU_FAULTS=chatty_collective``) for the
+    ``python -m pint_tpu.lint --contracts`` subprocess leg."""
+    with _registered("chatty_collective", _chatty_collective_factory):
+        yield
+
+
 #: failpoints activatable across a process boundary via the
 #: PINT_TPU_FAULTS env var (comma-separated names; process-lifetime,
 #: no context manager to exit) — the bench/CLI-subprocess test leg
@@ -441,6 +478,7 @@ _ENV_FACTORIES = {
     "wedged_probe": _wedged_probe_factory,
     "retrace_storm": _retrace_storm_factory,
     "chatty_transfer": _chatty_transfer_factory,
+    "chatty_collective": _chatty_collective_factory,
     "stale_aot_version": _stale_aot_version_factory,
 }
 

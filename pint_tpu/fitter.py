@@ -724,7 +724,20 @@ def build_gls_step(model: TimingModel, batch: TOABatch,
                              model.noise_basis(p), model.noise_weights(p),
                              esl)
 
-            return solve
+            from pint_tpu import aot
+
+            # the ROADMAP item 2 leftover: on the CPU backend the GLS
+            # solve is a jitted program (the wideband step rides this
+            # same path through its combined assembly), so a warm store
+            # serves it instead of re-tracing.  esl is structural (the
+            # ECORR column range drives the Schur elimination shape);
+            # the noise basis/weights enter via p's avals + model
+            # structure, both already in the fingerprint.
+            return aot.serve(
+                "gls_solve", solve,
+                aot.model_fingerprint(
+                    model, batch, track_mode, "gls",
+                    f"npar={npar}|thr={threshold}|esl={esl!r}"))
 
         cache: dict = {}
 

@@ -701,7 +701,18 @@ class FleetFitter:
     # steady state on the audit fixture is 2 chunk dispatches + 2
     # result fetches, compiles == retraces == 0
     @dispatch_contract("fleet_fit", max_compiles=24, max_dispatches=4,
-                       max_transfers=8, warm_from_store=True)
+                       max_transfers=8, warm_from_store=True,
+                       # compiled-HLO comm contract (ISSUE 10), measured
+                       # with the bucket program lowered on batch-mesh
+                       # NamedSharding avals: XLA replicates the
+                       # unconstrained vmap outputs via exactly two
+                       # all-gathers — a SANCTIONED replication (every
+                       # host reads the full result); anything else
+                       # (e.g. an input all-gather undoing the batch
+                       # sharding) is unbudgeted and always-fail
+                       max_collectives={"all-gather": 2},
+                       max_comm_bytes=8192,
+                       max_device_peak_bytes=1 << 20)
     def fit(self, *, checkpoint: Optional[str] = None,
             resume: bool = False, max_retries: int = 1,
             checkpoint_every: int = 1) -> FleetResult:

@@ -11,7 +11,17 @@ both).  This package makes those conventions *checked properties*:
   **DD001** raw ``+/-`` on DD/QS words outside ``dd.py``/``qs.py``;
   **PREC001** dtype demotion in precision-critical modules;
   **TRACE001** host syncs inside jit-reachable code;
-  **JIT001** retrace hazards on jit-wrapped functions.
+  **JIT001** retrace hazards on jit-wrapped functions;
+  **SHARD001/SHARD002** sharding hygiene in mesh-reachable code
+  (bare ``device_put`` without a sharding; batch-sharded
+  ``shard_map``/``pjit`` without declared output specs).
+* Dispatch-contract audit (:mod:`pint_tpu.lint.contracts` +
+  :mod:`pint_tpu.lint.hlo_audit`): **CONTRACT001-003** compile/
+  dispatch/transfer budgets and warm-start behaviour; **CONTRACT004**
+  SPMD collective-communication budgets — each mesh entrypoint is
+  lowered under the emulated 8-device mesh, its compiled HLO parsed
+  for collectives, and op counts / moved bytes / device peak / output
+  shardings judged against the contract's declared budgets.
 * Runtime jaxpr audit (:mod:`pint_tpu.lint.jaxpr_audit`): **JAXPR001**
   — traces the residual/fitter entry points and rejects narrowing
   ``convert_element_type`` equations that are not exact error-free

@@ -41,6 +41,19 @@ recombination of the result words):
   unhashable literals; and Python-float defaults in the jit signature
   (weak-type promotion + an extra trace per call-site spelling).
 
+* **SHARD001 / SHARD002** (ISSUE 10) — SPMD sharding hazards, riding
+  the same reachability machinery: mesh reachability is computed from
+  MESH ROOTS (functions that call ``shard_map``/``pjit``/``Mesh``/
+  ``NamedSharding``/this package's mesh constructors) through the
+  module-local call graph and into nested closures.  SHARD001 flags a
+  bare ``jax.device_put`` (no sharding/device) inside mesh-reachable
+  code — a silent full replication; SHARD002 flags a ``shard_map``/
+  ``pjit`` wrap whose in-specs shard the ``batch`` axis with no
+  declared output sharding and no ``with_sharding_constraint`` in the
+  wrapped function — XLA may resolve the output replicated (the
+  implicit all-gather :mod:`pint_tpu.lint.hlo_audit` then reports as a
+  CONTRACT004 budget breach).
+
 The rules are deliberately heuristic (no type inference): they encode
 this package's idioms, and the combination of inline suppressions plus
 the checked-in baseline (``pint_tpu/lint/baseline.txt``) keeps the
@@ -77,10 +90,23 @@ RULES = {
               "call-site spelling",
     "JAXPR001": "runtime jaxpr audit: narrowing convert_element_type in a "
                 "traced precision-critical entry point",
+    "SHARD001": "bare jax.device_put (no sharding/device) inside "
+                "mesh-reachable code — silent full replication of the "
+                "staged array",
+    "SHARD002": "shard_map/pjit wrap shards the batch axis in but "
+                "declares no out_specs/out_shardings and the wrapped "
+                "function has no with_sharding_constraint — XLA may "
+                "resolve the output replicated",
     "CONTRACT001": "dispatch-contract budget breach (steady-state "
                    "dispatches/transfers/host bytes, or warmup compiles)",
     "CONTRACT002": "steady-state retrace/recompile of a dispatch-contract "
                    "entrypoint (unstable jit cache key)",
+    "CONTRACT003": "warm-from-store entrypoint compiled or missed the AOT "
+                   "program store on the cold-start leg",
+    "CONTRACT004": "SPMD comm-contract breach in the compiled HLO "
+                   "(collective count/bytes over budget, unbudgeted "
+                   "collective category, per-device peak, or an output "
+                   "sharding resolved differently than declared)",
 }
 
 PRECISION_MODULES = {
@@ -103,6 +129,24 @@ _TRANSFORMS = {
     "while_loop", "cond", "switch", "fori_loop", "map", "associative_scan",
     "shard_map", "pjit", "custom_jvp", "custom_vjp",
 }
+#: calls that make the enclosing function a MESH ROOT for SHARD001
+#: reachability: it builds meshes/shardings or wraps SPMD programs, so
+#: array staging inside it (and its callees) must be sharding-explicit
+_MESH_ROOT_CALLS = {
+    "shard_map", "pjit", "Mesh", "NamedSharding", "make_mesh",
+    "make_batch_mesh", "global_mesh", "with_sharding_constraint",
+}
+#: SPMD wrap entry points SHARD002 audits for a declared output sharding
+_SHARD_WRAPS = {"shard_map", "pjit"}
+
+
+def _contains_batch_str(node) -> bool:
+    """Does this (in_specs/in_shardings) expression shard a 'batch'
+    axis?  The package spells PartitionSpec axes as string constants."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and sub.value == "batch":
+            return True
+    return False
 
 
 def _static_positions(call: ast.Call) -> set:
@@ -232,8 +276,9 @@ def _block_terminates(body) -> bool:
 
 class _FuncInfo:
     __slots__ = ("node", "name", "parent", "jit_root", "jit_reachable",
-                 "contract_root", "contract_reachable", "static_argnums",
-                 "static_argnames", "calls", "local_names")
+                 "contract_root", "contract_reachable", "mesh_root",
+                 "mesh_reachable", "static_argnums", "static_argnames",
+                 "calls", "local_names")
 
     def __init__(self, node, name: str, parent: Optional["_FuncInfo"]):
         self.node = node
@@ -243,6 +288,8 @@ class _FuncInfo:
         self.jit_reachable = False
         self.contract_root = False       # carries @dispatch_contract
         self.contract_reachable = False
+        self.mesh_root = False           # builds meshes/shardings (SHARD001)
+        self.mesh_reachable = False
         self.static_argnums: set = set()
         self.static_argnames: set = set()
         self.calls: set = set()
@@ -259,6 +306,8 @@ class _ModuleIndex(ast.NodeVisitor):
         self.float_consts: set = set()
         self.np_aliases: set = set()
         self.jit_call_sites: List[ast.Call] = []
+        #: (call, enclosing _FuncInfo) for every shard_map/pjit wrap
+        self.shard_sites: List[tuple] = []
         self._jit_sites_seen: set = set()
         self._stack: List[_FuncInfo] = []
         self._class_depth = 0
@@ -367,6 +416,12 @@ class _ModuleIndex(ast.NodeVisitor):
 
     def visit_Call(self, node):
         self._check_wrap_call(node)
+        name = _attr_name(node.func)
+        if name in _SHARD_WRAPS:
+            self.shard_sites.append(
+                (node, self._stack[-1] if self._stack else None))
+        if self._stack and name in _MESH_ROOT_CALLS:
+            self._stack[-1].mesh_root = True
         self.generic_visit(node)
 
 
@@ -642,6 +697,68 @@ class _BodyScanner:
                     "per-iteration device->host materialization; fetch "
                     "once per chunk boundary or keep the loop on device")
 
+    # -- SHARD001: unsharded staging in mesh-reachable code ----------------
+    def _scan_shard001(self, info: _FuncInfo):
+        """``jax.device_put(x)`` with no sharding/device in a function
+        that builds meshes/shardings (or is called from one): on a mesh
+        the bare form stages a FULL REPLICA onto the default device —
+        the silent scaling killer the comm audit sees as memory, and
+        this rule catches at the source."""
+
+        def walk(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not info.node:
+                return      # nested defs are scanned as their own scope
+            if isinstance(node, ast.Call) and \
+                    _attr_name(node.func) == "device_put" and \
+                    len(node.args) == 1 and not any(
+                        kw.arg in ("device", "sharding", "dst_sharding")
+                        for kw in node.keywords):
+                self.report(
+                    "SHARD001", node,
+                    "bare jax.device_put in mesh-reachable code — no "
+                    "sharding/device argument means a full replica on "
+                    "the default device; pass the NamedSharding the "
+                    "surrounding mesh code built")
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(info.node)
+
+    # -- SHARD002: batch-sharded wrap with unconstrained output ------------
+    def _scan_shard002(self):
+        """A ``shard_map``/``pjit`` wrap whose in_specs/in_shardings
+        shard the 'batch' axis but which declares NO out_specs/
+        out_shardings, wrapping a function with no
+        ``with_sharding_constraint``: XLA is free to resolve the output
+        replicated (an implicit all-gather the comm audit then reports
+        as CONTRACT004 — this rule names the wrap site to fix)."""
+        for call, scope in self.index.shard_sites:
+            kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+            in_spec = kwargs.get("in_specs")
+            if in_spec is None:
+                in_spec = kwargs.get("in_shardings")
+            if in_spec is None or not _contains_batch_str(in_spec):
+                continue
+            if "out_specs" in kwargs or "out_shardings" in kwargs:
+                continue
+            wrapped = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                wrapped = self._resolve_from_scope(scope,
+                                                   call.args[0].id)
+            if wrapped is not None and any(
+                    isinstance(sub, ast.Call) and
+                    _attr_name(sub.func) == "with_sharding_constraint"
+                    for sub in ast.walk(wrapped.node)):
+                continue
+            self.report(
+                "SHARD002", call,
+                f"{_attr_name(call.func)} shards the batch axis in but "
+                "declares no out_specs/out_shardings and the wrapped "
+                "function has no with_sharding_constraint — XLA may "
+                "resolve the output REPLICATED (implicit all-gather); "
+                "declare the output spec or constrain the result")
+
     # -- JIT001 body checks ------------------------------------------------
     def _scan_jit001(self, info: _FuncInfo):
         node = info.node
@@ -719,6 +836,8 @@ def _propagate_jit(index: _ModuleIndex):
             info.jit_reachable = True
         if info.contract_root:
             info.contract_reachable = True
+        if info.mesh_root:
+            info.mesh_reachable = True
 
     def resolve_from(info: _FuncInfo, name: str) -> Optional[_FuncInfo]:
         scope = info
@@ -750,6 +869,16 @@ def _propagate_jit(index: _ModuleIndex):
             elif info.parent is not None and \
                     info.parent.contract_reachable:
                 info.contract_reachable = True
+                changed = True
+            if info.mesh_reachable:
+                for name in info.calls:
+                    callee = resolve_from(info, name)
+                    if callee is not None and not callee.mesh_reachable:
+                        callee.mesh_reachable = True
+                        changed = True
+            elif info.parent is not None and info.parent.mesh_reachable:
+                # a closure built inside mesh code stages mesh data
+                info.mesh_reachable = True
                 changed = True
 
 
@@ -791,7 +920,9 @@ def lint_source(source: str, filename: str) -> List[Finding]:
         scanner._check_jit_params(call)
     # weak-type scalars flowing into jit call sites
     scanner._scan_jit002(tree)
-    # per-function trace-safety / retrace rules
+    # batch-sharded wraps with unconstrained outputs
+    scanner._scan_shard002()
+    # per-function trace-safety / retrace / sharding rules
     for info in index.functions:
         if info.jit_reachable:
             scanner._scan_trace_block(info.node.body, False)
@@ -799,6 +930,8 @@ def lint_source(source: str, filename: str) -> List[Finding]:
             scanner._scan_jit001(info)
         if info.contract_reachable and not info.jit_reachable:
             scanner._scan_trace002(info)
+        if info.mesh_reachable:
+            scanner._scan_shard001(info)
 
     findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings

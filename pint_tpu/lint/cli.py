@@ -29,7 +29,9 @@ import sys
 from typing import List, Optional
 
 from pint_tpu.lint import astrules, baseline as bl
-from pint_tpu.lint.findings import Finding, format_json, format_text
+from pint_tpu.lint.findings import (
+    Finding, format_github, format_json, format_text,
+)
 
 __all__ = ["main"]
 
@@ -43,16 +45,19 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="pint-tpu-lint",
         description="Precision & trace-safety static analyzer for pint_tpu "
                     "(AST rules DD001/PREC001/TRACE001/TRACE002/JIT001/"
-                    "JIT002, the JAXPR001 runtime jaxpr audit, and the "
-                    "CONTRACT001/CONTRACT002/CONTRACT003 dispatch-"
+                    "JIT002/SHARD001/SHARD002, the JAXPR001 runtime jaxpr "
+                    "audit, and the CONTRACT001-CONTRACT004 dispatch-"
                     "contract audit incl. the warm-from-store cold-start "
-                    "axis). Exit codes: 0 clean (always 0 with "
+                    "axis and the SPMD collective-communication budgets). "
+                    "Exit codes: 0 clean (always 0 with "
                     "--update-baseline), 1 new findings, 2 usage error.")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the installed "
                          "pint_tpu package)")
-    ap.add_argument("--format", choices=("text", "json"), default="text",
-                    dest="fmt", help="output format (default: text)")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text", dest="fmt",
+                    help="output format (default: text; 'github' emits "
+                         "::error workflow-command annotations for CI)")
     ap.add_argument("--select", default=None, metavar="CODE[,CODE]",
                     help="only report findings with these rule codes "
                          "(see --list-rules)")
@@ -101,11 +106,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         con._ensure_registered()
         for name in sorted(con.REGISTRY):
             c = con.REGISTRY[name]
+            extras = []
+            if c.warm_from_store:
+                extras.append("warm-from-store")
+            if c.max_collectives is not None:
+                budget = ",".join(f"{k}<={v}" for k, v in
+                                  sorted(c.max_collectives.items()))
+                extras.append(f"collectives[{budget or 'none'}]")
+            if c.max_comm_bytes is not None:
+                extras.append(f"comm-bytes<={c.max_comm_bytes}")
+            if c.max_device_peak_bytes is not None:
+                extras.append(f"peak-bytes<={c.max_device_peak_bytes}")
             print(f"{name:20s} {c.qualname:30s} "
                   f"compiles<={c.max_compiles} "
                   f"dispatches<={c.max_dispatches} "
                   f"transfers<={c.max_transfers}"
-                  + (" warm-from-store" if c.warm_from_store else ""))
+                  + "".join(" " + e for e in extras))
         return 0
 
     select = ignore = None
@@ -199,6 +215,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     meta["new"] = len(new)
     if args.fmt == "json":
         print(format_json(new, meta))
+    elif args.fmt == "github":
+        out = format_github(new, meta)
+        if out:
+            print(out)
     else:
         if new:
             print(format_text(new))

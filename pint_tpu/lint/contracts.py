@@ -44,6 +44,15 @@ call — and emits findings through the shared
   store its first build populated (:func:`check_warm`), compiled or
   missed the store — the finding carries the ProgramKey-miss
   attribution (which entry, which key digest, why it missed).
+* **CONTRACT004** — the SPMD communication axis (ISSUE 10): a
+  comm-budgeted entrypoint's compiled HLO (lowered by
+  :mod:`pint_tpu.lint.hlo_audit` under the emulated CPU mesh) exceeded
+  a per-category collective budget, moved more collective bytes than
+  ``max_comm_bytes``, peaked above ``max_device_peak_bytes``, resolved
+  an output sharding differently than declared — or contains a
+  collective category with NO declared budget, which is always-fail
+  (the SPMD mirror of the always-fail steady-state retrace rule).  The
+  finding names the entrypoint, the collective category and the HLO op.
 
 Scan-shaped entrypoints whose programs are rebuilt per call
 (``mcmc_step``) are measured in *marginal* mode: a short run and a
@@ -60,8 +69,9 @@ Run it: ``python -m pint_tpu.lint --contracts`` (or
 ``--contracts=name1,name2`` for a subset); the pytest gate is
 ``tests/test_contracts.py`` (marker ``contracts``, opt out with
 ``PINT_TPU_SKIP_CONTRACTS=1``).  The seeded regressions proving the
-auditor catches real failures are ``faultinject.retrace_storm`` and
-``faultinject.chatty_transfer``.
+auditor catches real failures are ``faultinject.retrace_storm``,
+``faultinject.chatty_transfer`` and (for the comm axis)
+``faultinject.chatty_collective``.
 """
 
 from __future__ import annotations
@@ -93,6 +103,16 @@ class Contract(NamedTuple):
     #: program store, and a warm-store rebuild of it must show ZERO
     #: compiles (CONTRACT003 with ProgramKey-miss attribution)
     warm_from_store: bool = False
+    #: SPMD communication axis (ISSUE 10): per-category collective-op
+    #: budget over the compiled HLO, e.g. ``{"all-reduce": 6}``.  A
+    #: category present in the HLO but absent here is ALWAYS a failure
+    #: (CONTRACT004) — new communication cannot ride in unbudgeted.
+    #: None means the entrypoint has no comm contract (no HLO leg runs).
+    max_collectives: Optional[Dict[str, int]] = None
+    #: total collective bytes over the compiled program (CONTRACT004)
+    max_comm_bytes: Optional[int] = None
+    #: per-device arg+output+temp+code peak bound (CONTRACT004)
+    max_device_peak_bytes: Optional[int] = None
 
 
 #: contract name -> Contract, populated at decoration (import) time
@@ -102,7 +122,10 @@ REGISTRY: Dict[str, Contract] = {}
 def dispatch_contract(name: str, *, max_compiles: int,
                       max_dispatches: int, max_transfers: int = 8,
                       max_host_bytes: int = 1 << 22, warmup: int = 1,
-                      warm_from_store: bool = False):
+                      warm_from_store: bool = False,
+                      max_collectives: Optional[Dict[str, int]] = None,
+                      max_comm_bytes: Optional[int] = None,
+                      max_device_peak_bytes: Optional[int] = None):
     """Register a dispatch budget for a hot public entrypoint.
 
     Returns the function unchanged — zero call-time cost.  The audit
@@ -114,6 +137,13 @@ def dispatch_contract(name: str, *, max_compiles: int,
     the audit's warm leg — rebuild the entrypoint against a store its
     first build just populated — must show ZERO compiles (CONTRACT003,
     attributed to the ProgramKey misses when it fails).
+
+    ``max_collectives`` adds the SPMD communication axis (ISSUE 10):
+    the entrypoint's compiled HLO is audited per collective category by
+    :mod:`pint_tpu.lint.hlo_audit` (CONTRACT004); a category in the HLO
+    with no entry in the dict always fails, and ``max_comm_bytes`` /
+    ``max_device_peak_bytes`` bound total collective traffic and the
+    per-device memory footprint.
     """
     def deco(fn):
         import inspect
@@ -127,7 +157,12 @@ def dispatch_contract(name: str, *, max_compiles: int,
             name, int(max_compiles), int(max_dispatches),
             int(max_transfers), int(max_host_bytes), int(warmup),
             getattr(fn, "__qualname__", str(fn)), path, line,
-            bool(warm_from_store))
+            bool(warm_from_store),
+            dict(max_collectives) if max_collectives is not None
+            else None,
+            None if max_comm_bytes is None else int(max_comm_bytes),
+            None if max_device_peak_bytes is None
+            else int(max_device_peak_bytes))
         fn.__dispatch_contract__ = name
         return fn
 
@@ -154,6 +189,7 @@ def _ensure_registered() -> None:
     import pint_tpu.fleet         # noqa: F401
     import pint_tpu.gridutils     # noqa: F401
     import pint_tpu.mcmc          # noqa: F401
+    import pint_tpu.multihost     # noqa: F401
     import pint_tpu.parallel      # noqa: F401
     import pint_tpu.residuals     # noqa: F401
     import pint_tpu.runtime       # noqa: F401
@@ -381,6 +417,23 @@ def _drv_sharded_chunk(fix: ContractFixture):
         f, grid, mesh=mesh, maxiter=1, chunk_size=2 * nb)}
 
 
+def _drv_multihost_chunk(fix: ContractFixture):
+    import jax
+    from jax.sharding import Mesh
+
+    from pint_tpu.multihost import multihost_grid_chisq
+
+    f = fix.grid_fitter()
+    # the per-process view of the multihost mesh: batch stays at the
+    # host level (size 1 here — single process), TOAs shard over every
+    # local device (the 8-virtual-device CPU mesh in tier-1)
+    devs = fix.np.array(jax.devices())
+    mesh = Mesh(devs.reshape(1, len(devs)), ("batch", "toa"))
+    grid = {"DM": fix.np.asarray([14.9, 14.95, 15.0, 15.05])}
+    return {"call": lambda: multihost_grid_chisq(f, grid, mesh=mesh,
+                                                 maxiter=1)}
+
+
 def _drv_checkpointed_chunk(fix: ContractFixture):
     from pint_tpu.gridutils import grid_chisq_flat
 
@@ -404,6 +457,18 @@ def _drv_mcmc_step(fix: ContractFixture):
     x0 = fix.np.asarray([[0.1, 0.0], [0.0, 0.1],
                          [-0.1, 0.0], [0.0, -0.1]])
     ck1, ck2 = fix.tmpfile("mcmc_a.npz"), fix.tmpfile("mcmc_b.npz")
+    # warm BOTH run shapes OUTSIDE the measured window: on a cold
+    # process the base run pays one-time compiles the extended run then
+    # reuses, driving the marginal compile count negative (the
+    # subtraction only cancels work both runs repeat) — and warming
+    # only one shape would leave the other side's one-time retraces
+    # uncancelled, so each measured shape gets its own warmup
+    ensemble_sample(lnpost, x0, nsteps=2, seed=1,
+                    checkpoint=fix.tmpfile("mcmc_warm_a.npz"),
+                    checkpoint_every=2)
+    ensemble_sample(lnpost, x0, nsteps=6, seed=1,
+                    checkpoint=fix.tmpfile("mcmc_warm_b.npz"),
+                    checkpoint_every=2)
     # marginal mode: the 6-step run re-dispatches the SAME compiled
     # 2-step chunk two extra times — per-chunk marginal compiles must
     # be zero (the one-compiled-chunk-shape property)
@@ -431,6 +496,7 @@ _DRIVERS: Dict[str, Callable[[ContractFixture], dict]] = {
     "fused_fit": _drv_fused_fit,
     "grid_chunk": _drv_grid_chunk,
     "sharded_chunk": _drv_sharded_chunk,
+    "multihost_chunk": _drv_multihost_chunk,
     "checkpointed_chunk": _drv_checkpointed_chunk,
     "mcmc_step": _drv_mcmc_step,
     "fleet_fit": _drv_fleet_fit,
@@ -506,6 +572,86 @@ def _judge(c: Contract, warm: TraceCounters,
     return findings
 
 
+def _has_comm_contract(c: Contract) -> bool:
+    return (c.max_collectives is not None or c.max_comm_bytes is not None
+            or c.max_device_peak_bytes is not None)
+
+
+def _judge_comm(c: Contract, profile, mismatches) -> List[Finding]:
+    """CONTRACT004: the compiled HLO against the declared comm budget.
+    Attribution names the entrypoint, the collective category and the
+    HLO op; an unbudgeted category present in the program is always a
+    failure (the SPMD mirror of the always-fail retrace rule)."""
+    findings: List[Finding] = []
+
+    def f(msg: str):
+        findings.append(Finding(
+            "CONTRACT004", c.path, c.line, 1,
+            f"contract '{c.name}' ({c.qualname}): {msg}",
+            source=f"@dispatch_contract('{c.name}')", origin="contract"))
+
+    budget = c.max_collectives or {}
+    for cat in sorted(profile.counts):
+        n = profile.counts[cat]
+        nb = profile.bytes_by_category.get(cat, 0)
+        first = next(op.name for op in profile.ops if op.category == cat)
+        if cat not in budget:
+            f(f"unbudgeted collective category '{cat}' in the compiled "
+              f"HLO ({n} op(s), {nb} B; HLO op '{first}') — a collective "
+              "with no declared budget always fails: add it to "
+              "max_collectives or eliminate it")
+        elif n > budget[cat]:
+            f(f"collective '{cat}' count {n} exceeds budget "
+              f"{budget[cat]} (HLO op '{first}'; {nb} B in category)")
+    if c.max_comm_bytes is not None and \
+            profile.comm_bytes > c.max_comm_bytes:
+        f(f"collective traffic {profile.comm_bytes} B exceeds "
+          f"max_comm_bytes {c.max_comm_bytes}")
+    if c.max_device_peak_bytes is not None and \
+            profile.peak_bytes > c.max_device_peak_bytes:
+        f(f"per-device peak {profile.peak_bytes} B exceeds "
+          f"max_device_peak_bytes {c.max_device_peak_bytes}")
+    for idx, got, want in mismatches:
+        f(f"output {idx} compiled sharding {got or '(replicated)'} "
+          f"does not match the declared PartitionSpec axes "
+          f"{want or '(replicated)'} — XLA resolved the output "
+          "differently than the contract declares")
+    return findings
+
+
+def _comm_leg(c: Contract, fix: ContractFixture) -> List[Finding]:
+    """Lower the entrypoint's compiled HLO and judge CONTRACT004.
+
+    Runs OUTSIDE :func:`instrument` (lowering compiles; none of it is
+    steady-state work).  The (profile, mismatches) pair is cached on
+    the fixture so repeated checks in one audit pass lower each program
+    once — failpoint runs (``chatty_collective``) therefore need a
+    FRESH fixture, which they need anyway for the program caches the
+    entrypoints keep on their fitters."""
+    from pint_tpu.lint import hlo_audit
+
+    builder = hlo_audit.HLO_DRIVERS.get(c.name)
+    if builder is None:
+        return [Finding(
+            "CONTRACT004", c.path, c.line, 1,
+            f"contract '{c.name}' declares a comm budget but has no HLO "
+            "audit driver — add one to pint_tpu/lint/hlo_audit.py so "
+            "the budget is enforced",
+            source=f"@dispatch_contract('{c.name}')", origin="contract")]
+    cache = getattr(fix, "_cache", None)
+    key = ("comm", c.name)
+    cached = cache.get(key) if isinstance(cache, dict) else None
+    if cached is None:
+        prog = builder(fix)
+        profile = hlo_audit.analyze_compiled(prog.compiled, prog.mesh)
+        cached = (profile,
+                  hlo_audit.sharding_mismatches(profile,
+                                                prog.expected_out_specs))
+        if isinstance(cache, dict):
+            cache[key] = cached
+    return _judge_comm(c, *cached)
+
+
 def check(name: str,
           fixture: Optional[ContractFixture] = None) -> ContractReport:
     """Measure one contract and judge it against its declared budget."""
@@ -525,8 +671,11 @@ def check(name: str,
     fix = fixture if fixture is not None else ContractFixture()
     driver = builder(fix)
     warm, steady = _measure(driver, c.warmup)
-    return ContractReport(name, warm, steady,
-                          tuple(_judge(c, warm, steady)))
+    findings = _judge(c, warm, steady)
+    if _has_comm_contract(c) and \
+            os.environ.get("PINT_TPU_CONTRACT_COMM", "1") != "0":
+        findings.extend(_comm_leg(c, fix))
+    return ContractReport(name, warm, steady, tuple(findings))
 
 
 def check_warm(name: str,

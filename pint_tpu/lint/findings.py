@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 
 __all__ = [
     "Finding", "Suppressions", "scan_suppressions", "normalize_path",
-    "format_text", "format_json",
+    "format_text", "format_json", "format_github",
 ]
 
 _DDLINT_RE = re.compile(
@@ -119,6 +119,23 @@ def format_text(findings, stream_meta: dict | None = None) -> str:
     if stream_meta:
         for k, v in stream_meta.items():
             out.append(f"# {k}: {v}")
+    return "\n".join(out)
+
+
+def format_github(findings, stream_meta: dict | None = None) -> str:
+    """GitHub Actions workflow-command format: one ``::error`` annotation
+    per finding, so CI runs surface findings inline on the PR diff.
+    Newlines and ``::`` cannot appear in a message body, so the message is
+    flattened to one line (the workflow-command escaping rules)."""
+    out = []
+    for f in findings:
+        msg = f"{f.code} {f.message}".replace("%", "%25") \
+            .replace("\r", "%0D").replace("\n", "%0A")
+        out.append(f"::error file={normalize_path(f.path)},"
+                   f"line={f.line},col={f.col}::{msg}")
+    if stream_meta:
+        out.append("::notice::pint-tpu-lint "
+                   + " ".join(f"{k}={v}" for k, v in stream_meta.items()))
     return "\n".join(out)
 
 

@@ -1,0 +1,313 @@
+"""Compiled-HLO communication audit: what XLA *actually emits* for the
+mesh entrypoints (ISSUE 10).
+
+The dispatch contracts (PR 5) count work at the runtime boundary —
+compiles, dispatches, host transfers.  None of that sees INSIDE a
+compiled program, and for the SPMD paths the inside is where scaling
+lives or dies: an accidental replication or an implicit ``all-gather``
+in a sharded solve silently turns the scaling curve flat, and the
+dispatch counters stay green.  This module closes that hole by lowering
+each mesh-using entrypoint to compiled HLO under the emulated
+8-virtual-device CPU mesh (the same MULTICHIP trick conftest.py uses,
+so the audit runs in tier-1 with no accelerator) and reading three
+things off the compiled artifact:
+
+* **collective ops** — every ``all-gather`` / ``all-reduce`` /
+  ``reduce-scatter`` / ``all-to-all`` / ``collective-permute`` in the
+  HLO module text, with op-count AND byte accounting per category
+  (bytes from the op's result shape; tuple shapes sum their
+  components);
+* **per-device memory** — ``compiled.memory_analysis()`` argument /
+  output / temp / generated-code sizes, combined into a peak bound
+  (this jax exposes no single peak field);
+* **output shardings** — the compiled program's actual output
+  ``PartitionSpec`` s, normalized (size-1 mesh axes and unsharded dims
+  dropped) and compared against what the entrypoint declares.  XLA is
+  free to resolve an unconstrained output replicated; the comparison
+  makes that resolution a contract, not an accident.
+
+Judgment lives in :mod:`pint_tpu.lint.contracts` (CONTRACT004): each
+comm-budgeted ``@dispatch_contract`` declares ``max_collectives={...}``
+per category, ``max_comm_bytes`` and ``max_device_peak_bytes``; a
+collective category present in the HLO but absent from the budget is
+ALWAYS a failure — exactly mirroring the always-fail steady-state
+retrace rule — so new communication cannot ride in unbudgeted.  The
+seeded regression proving the auditor catches real failures is
+``faultinject.chatty_collective`` (an extra per-chunk cross-batch
+all-reduce; value-preserving, so only this audit can see it).
+
+Drivers here mirror the dispatch-contract drivers: they build the real
+entrypoint program on the shared :class:`ContractFixture` and lower it
+exactly as the entrypoint would run it — the fast-path whole-grid
+shard_map program for ``sharded_chunk``, the (1, n)-mesh variant the
+multihost wrapper compiles for ``multihost_chunk``, and the fleet
+bucket program lowered on batch-mesh ``NamedSharding`` avals for
+``fleet_fit``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+__all__ = ["COLLECTIVE_CATEGORIES", "CollectiveOp", "CommProfile",
+           "HloProgram", "HLO_DRIVERS", "analyze_compiled",
+           "normalize_spec", "sharding_mismatches", "comm_report",
+           "shape_bytes"]
+
+#: the steady-state collective vocabulary the audit accounts for; a
+#: category outside a contract's ``max_collectives`` is always-fail
+COLLECTIVE_CATEGORIES = ("all-gather", "all-reduce", "reduce-scatter",
+                         "all-to-all", "collective-permute")
+
+# one collective instruction per line in HLO text:
+#   %name = shape CATEGORY(operands), replica_groups=...
+# async pairs lower as CATEGORY-start/-done; counting the -start leg
+# only would miss sync ops, so both spellings fold into the category
+# and the -done leg is skipped below (its operand is the -start tuple).
+_COLL_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<op>" + "|".join(COLLECTIVE_CATEGORIES) + r")"
+    r"(?P<suffix>-start|-done)?\(", re.M)
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+                "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+                "u64": 8, "u32": 4, "u16": 2, "u8": 1,
+                "pred": 1, "c64": 8, "c128": 16}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Bytes in an HLO shape string; tuple shapes sum components,
+    unknown dtypes count zero (conservative, never crashes the audit)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_str):
+        nb = _DTYPE_BYTES.get(m.group(1))
+        if nb is None:
+            continue
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * nb
+    return total
+
+
+class CollectiveOp(NamedTuple):
+    """One collective instruction in the compiled HLO."""
+
+    name: str       #: the HLO op name (CONTRACT004 attribution)
+    category: str   #: one of :data:`COLLECTIVE_CATEGORIES`
+    nbytes: int     #: result-shape bytes moved by this op
+
+
+class CommProfile(NamedTuple):
+    """The communication profile of one compiled mesh program."""
+
+    counts: Dict[str, int]             #: per-category op counts
+    bytes_by_category: Dict[str, int]  #: per-category byte totals
+    ops: Tuple[CollectiveOp, ...]      #: every collective, in HLO order
+    comm_bytes: int                    #: total collective bytes
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    peak_bytes: int                    #: arg+out+temp+generated-code
+    #: normalized actual output specs (None when the compiled artifact
+    #: exposes no inspectable sharding)
+    output_specs: Optional[Tuple[Tuple[str, ...], ...]]
+
+
+class HloProgram(NamedTuple):
+    """What an HLO driver returns: the compiled program, the mesh it
+    was lowered for, and the normalized output specs the entrypoint
+    declares (None disables the sharding comparison — used where the
+    replication choice is itself sanctioned by the collective budget)."""
+
+    compiled: object
+    mesh: object
+    expected_out_specs: Optional[Tuple[Tuple[str, ...], ...]]
+
+
+def normalize_spec(spec, mesh) -> Tuple[str, ...]:
+    """Flatten a ``PartitionSpec`` to the mesh axis names that actually
+    shard data.  Unsharded dims (None) carry no axis; a size-1 mesh
+    axis shards nothing (sharded over it is replication — the (1, n)
+    multihost mesh resolves ``P('batch')`` to ``P()``), so both sides
+    of the comparison drop it."""
+    sizes = dict(zip(mesh.axis_names,
+                     getattr(mesh.devices, "shape", ())))
+    out: List[str] = []
+    for dim in tuple(spec):
+        if dim is None:
+            continue
+        for ax in (dim if isinstance(dim, tuple) else (dim,)):
+            if sizes.get(ax, 1) > 1:
+                out.append(ax)
+    return tuple(out)
+
+
+def _output_specs(compiled, mesh):
+    """Normalized actual output specs, handling both the bare
+    NamedSharding a single-output program exposes and the sequence a
+    multi-output program does; None when uninspectable."""
+    try:
+        sh = compiled.output_shardings
+    except Exception:
+        return None
+    if not isinstance(sh, (list, tuple)):
+        sh = [sh]
+    specs = []
+    for s in sh:
+        spec = getattr(s, "spec", None)
+        if spec is None:
+            return None
+        specs.append(normalize_spec(spec, mesh))
+    return tuple(specs)
+
+
+def analyze_compiled(compiled, mesh=None) -> CommProfile:
+    """Parse one compiled program's HLO text + memory analysis into a
+    :class:`CommProfile`.  ``mesh`` enables the output-sharding read."""
+    txt = compiled.as_text()
+    counts: Dict[str, int] = {}
+    byts: Dict[str, int] = {}
+    ops: List[CollectiveOp] = []
+    for m in _COLL_RE.finditer(txt):
+        if m.group("suffix") == "-done":
+            continue  # the async completion leg of an op already counted
+        cat = m.group("op")
+        nb = shape_bytes(m.group("shape"))
+        counts[cat] = counts.get(cat, 0) + 1
+        byts[cat] = byts.get(cat, 0) + nb
+        ops.append(CollectiveOp(m.group("name"), cat, nb))
+    arg = out = temp = gen = 0
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(ma.argument_size_in_bytes)
+        out = int(ma.output_size_in_bytes)
+        temp = int(ma.temp_size_in_bytes)
+        gen = int(ma.generated_code_size_in_bytes)
+    except Exception:
+        pass
+    specs = _output_specs(compiled, mesh) if mesh is not None else None
+    return CommProfile(counts, byts, tuple(ops), sum(byts.values()),
+                       arg, out, temp, arg + out + temp + gen, specs)
+
+
+def sharding_mismatches(profile: CommProfile,
+                        expected: Optional[Tuple[Tuple[str, ...], ...]]
+                        ) -> List[Tuple[int, tuple, tuple]]:
+    """(index, actual, declared) for every output whose compiled
+    sharding disagrees with the declared spec (both normalized)."""
+    if expected is None or profile.output_specs is None:
+        return []
+    out = []
+    for i, (got, want) in enumerate(zip(profile.output_specs, expected)):
+        if got != want:
+            out.append((i, got, want))
+    return out
+
+
+# --- per-entrypoint HLO drivers ----------------------------------------------
+# Each driver builds the REAL entrypoint program on the shared
+# ContractFixture and lowers it exactly as the entrypoint dispatches it.
+# Drivers adapt to the available device count (tier-1 runs on the
+# 8-virtual-device CPU mesh conftest.py forces; a 1-device session
+# degrades to collective-free programs, which every budget admits).
+
+_AUDIT_GRID = (14.9, 14.95, 15.0, 15.05)
+
+
+def _hlo_sharded_chunk(fix) -> HloProgram:
+    """The fast-path whole-grid shard_map program on the default
+    ("batch", "toa") mesh — declared out_specs (P("batch"),
+    P("batch", None))."""
+    import numpy as np
+
+    from pint_tpu.parallel import make_mesh, prep_sharded_grid
+
+    f = fix.grid_fitter()
+    mesh = make_mesh()
+    grid = {"DM": np.asarray(_AUDIT_GRID)}
+    fit, stacked, batch, _ = prep_sharded_grid(
+        f, grid, mesh, mesh.devices.shape[0], 1, "sharded")
+    compiled = fit.lower(stacked, batch).compile()
+    expected = tuple(normalize_spec(s, mesh)
+                     for s in (("batch",), ("batch", None)))
+    return HloProgram(compiled, mesh, expected)
+
+
+def _hlo_multihost_chunk(fix) -> HloProgram:
+    """The per-process (1, n_local) variant the multihost wrapper
+    compiles: batch stays host-level, TOAs shard over every local
+    device.  The size-1 batch axis normalizes away on both sides."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from pint_tpu.parallel import prep_sharded_grid
+
+    f = fix.grid_fitter()
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(1, len(devs)), ("batch", "toa"))
+    grid = {"DM": np.asarray(_AUDIT_GRID)}
+    fit, stacked, batch, _ = prep_sharded_grid(
+        f, grid, mesh, 1, 1, "multihost")
+    compiled = fit.lower(stacked, batch).compile()
+    expected = tuple(normalize_spec(s, mesh)
+                     for s in (("batch",), ("batch", None)))
+    return HloProgram(compiled, mesh, expected)
+
+
+def _hlo_fleet_fit(fix) -> HloProgram:
+    """The fleet bucket program lowered on batch-mesh NamedSharding
+    avals (what FleetFitter dispatches when built with a sharding).
+    XLA replicates the unconstrained vmap output via the two budgeted
+    all-gathers — that replication choice is sanctioned by the
+    collective budget, so the spec comparison is disabled here."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pint_tpu.fitter import _default_wls_kernel
+    from pint_tpu.fleet import _build_bucket_fit
+    from pint_tpu.parallel import make_batch_mesh
+
+    ff = fix.fleet_fitter()
+    plan = ff._ensure_plan()
+    b = plan["buckets"][0]
+    rep = plan["rep"][b.skey_idx]
+    kern = ff.kernel if ff.kernel is not None else _default_wls_kernel()
+    prog = _build_bucket_fit(
+        rep.model, rep.resid.track_mode, plan["delta_keys"][b.skey_idx],
+        b.n_param, "PhaseOffset" not in rep.model.components,
+        ff.maxiter, ff.tol_chi2, kern, ff.threshold,
+        ff.diverge_streak, ff.stall_iters)
+    args = ff._chunk_args(0)
+    mesh = make_batch_mesh(2 if len(jax.devices()) >= 2 else 1)
+    sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+    avals = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype,
+                                       sharding=sh), args)
+    compiled = prog.lower(*avals).compile()
+    return HloProgram(compiled, mesh, None)
+
+
+#: contract name -> HLO driver; consulted by the CONTRACT004 leg in
+#: :mod:`pint_tpu.lint.contracts` (a comm budget without a driver here
+#: is itself a finding, mirroring the dispatch-driver rule)
+HLO_DRIVERS: Dict[str, Callable] = {
+    "sharded_chunk": _hlo_sharded_chunk,
+    "multihost_chunk": _hlo_multihost_chunk,
+    "fleet_fit": _hlo_fleet_fit,
+}
+
+
+def comm_report(name: str, fixture):
+    """(profile, mismatches) for one comm-budgeted entrypoint — the
+    measurement half of CONTRACT004, exposed for tests and bench."""
+    prog = HLO_DRIVERS[name](fixture)
+    profile = analyze_compiled(prog.compiled, prog.mesh)
+    return profile, sharding_mismatches(profile, prog.expected_out_specs)

@@ -38,6 +38,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from pint_tpu.lint.contracts import dispatch_contract
+
 __all__ = ["init", "global_mesh", "barrier", "multihost_grid_chisq"]
 
 
@@ -214,6 +216,16 @@ def _multihost_dispatch(fitter, grid_values: Dict[str, np.ndarray],
     return np.asarray(full).reshape(g)
 
 
+@dispatch_contract("multihost_chunk", max_compiles=40, max_dispatches=80,
+                   max_transfers=16,
+                   # compiled-HLO comm contract (ISSUE 10), measured on
+                   # the per-process (1, 8) virtual CPU mesh: the same 6
+                   # "toa"-axis all-reduces as the single-process
+                   # program (the batch axis is host-level here), and
+                   # nothing else — an implicit all-gather would be
+                   # unbudgeted and therefore always-fail
+                   max_collectives={"all-reduce": 6},
+                   max_comm_bytes=8192, max_device_peak_bytes=1 << 20)
 def multihost_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
                          mesh=None, maxiter: int = 2, *,
                          timeout_s: Optional[float] = None,

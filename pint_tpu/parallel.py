@@ -203,7 +203,12 @@ def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
         return jax.vmap(fit_one, in_axes=(axes, None))(p, b)
 
     def make(p_stacked, batch, names_of_grid):
+        from pint_tpu import faultinject
+
         grid_names[:] = list(names_of_grid)
+        # comm-audit failpoint (ISSUE 10): an extra value-preserving
+        # cross-batch all-reduce only the compiled-HLO audit can see
+        body = faultinject.wrap("chatty_collective", local_fit)
         gspec = {
             "const": {k: P() for k in p_stacked["const"]},
             "delta": {k: (P("batch") if k in grid_names else P())
@@ -211,7 +216,7 @@ def build_sharded_grid_fit(model: TimingModel, fit_params: Sequence[str],
             "mask": {k: P("toa") for k in p_stacked["mask"]},
         }
         bspec = jax.tree_util.tree_map(lambda leaf: P("toa"), batch)
-        f = shard_map(local_fit, mesh=mesh, in_specs=(gspec, bspec),
+        f = shard_map(body, mesh=mesh, in_specs=(gspec, bspec),
                       out_specs=(P("batch"), P("batch", None)),
                       check_rep=False)
         return jax.jit(f)
@@ -283,7 +288,15 @@ def _chunk_values(gvals: Dict[str, np.ndarray], lo: int, hi: int,
 
 
 @dispatch_contract("sharded_chunk", max_compiles=60, max_dispatches=12,
-                   max_transfers=4)
+                   max_transfers=4,
+                   # compiled-HLO comm contract (ISSUE 10), measured on
+                   # the 8-virtual-device (2, 4) CPU mesh: the psum'd
+                   # normal equations + pmax column scales combine to 6
+                   # "toa"-axis all-reduces and nothing else — any
+                   # all-gather (implicit row replication) is unbudgeted
+                   # and therefore always-fail
+                   max_collectives={"all-reduce": 6},
+                   max_comm_bytes=8192, max_device_peak_bytes=1 << 20)
 def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
                        mesh: Optional[Mesh] = None,
                        maxiter: int = 2, *,

@@ -91,6 +91,18 @@ def _assert_schema(d, fast=False):
     for key in ("store_writes", "aot_hits", "cache_hits",
                 "warm_compiles", "warm_retraces", "warm_misses"):
         assert isinstance(st.get(key), int), (key, st.get(key))
+    # SPMD comm axis (ISSUE 10): the audited sharded-grid program's
+    # collective counts ride the bench series, so a new collective or
+    # byte growth shows up as a diff even when wall-clock hides it
+    assert isinstance(d.get("collectives"), dict), d.get("collectives")
+    assert sum(d["collectives"].values()) > 0
+    assert isinstance(d["comm_bytes"], int) and d["comm_bytes"] > 0
+    # the no-implicit-gather invariant, as a bench number
+    assert d["all_gather_bytes"] == 0, d
+    comm = d["submetrics"].get("comm_profile")
+    assert isinstance(comm, dict) and "error" not in comm, comm
+    assert comm["n_devices"] >= 8
+    assert comm["device_peak_bytes"] > 0
 
 
 def test_quick_steady_state_never_recompiles(quick_line):

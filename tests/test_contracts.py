@@ -40,6 +40,20 @@ pytestmark = pytest.mark.skipif(
     reason="PINT_TPU_SKIP_CONTRACTS=1")
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _comm_legs_off():
+    """The CONTRACT004 comm legs lower three compiled mesh programs
+    (~1 min of HLO lowering); tier-1 pays that ONCE, in
+    tests/test_hlo_audit.py — the module dedicated to the comm audit —
+    so the dispatch-budget gate here runs with the comm legs off
+    (mirroring warm_legs=False, whose CONTRACT003 evidence lives in
+    test_aot.py).  The CLI runs both by default."""
+    mp = pytest.MonkeyPatch()
+    mp.setenv("PINT_TPU_CONTRACT_COMM", "0")
+    yield
+    mp.undo()
+
+
 @pytest.fixture(scope="module")
 def fixture():
     """One shared synthetic fixture for every audit in the module (the
@@ -64,22 +78,28 @@ class TestCleanLeg:
         assert {"residuals", "split_assembly", "wls_step", "gls_step",
                 "wideband_step", "fused_fit", "grid_chunk",
                 "sharded_chunk", "checkpointed_chunk",
-                "mcmc_step", "fleet_fit"} <= set(REGISTRY)
+                "mcmc_step", "fleet_fit", "multihost_chunk"} <= \
+            set(REGISTRY)
 
     def test_every_contract_has_a_driver(self):
         contracts._ensure_registered()
         missing = set(REGISTRY) - set(contracts._DRIVERS)
         assert not missing, f"contracts without audit drivers: {missing}"
 
-    def test_audit_passes_clean(self, fixture):
+    def test_audit_passes_clean(self, reports):
         """THE tier-1 gate: zero unsanctioned findings over every
-        registered entrypoint.  The warm-from-store legs (CONTRACT003)
-        are skipped HERE for tier-1 budget — they re-build and
-        re-export four entrypoints — and enforced instead by
-        tests/test_aot.py (clean + poisoned-store legs) and the
-        ``--contracts`` CLI, which runs them by default."""
-        findings = audit_contracts(fixture=fixture, warm_legs=False)
-        assert findings == [], [f.format() for f in findings]
+        registered entrypoint — judged on the shared ``reports`` run
+        (re-measuring all 12 entrypoints through ``audit_contracts``
+        costs another full audit pass; that API surface is covered by
+        TestMachinery and the CLI subprocess legs).  The
+        warm-from-store legs (CONTRACT003) are skipped HERE for tier-1
+        budget — they re-build and re-export four entrypoints — and
+        enforced instead by tests/test_aot.py (clean + poisoned-store
+        legs) and the ``--contracts`` CLI, which runs them by
+        default."""
+        bad = [f for name, rep in reports.items()
+               for f in rep.findings]
+        assert bad == [], [f.format() for f in bad]
 
     def test_zero_steady_state_recompiles_everywhere(self, reports):
         """The acceptance invariant, asserted per entrypoint: the

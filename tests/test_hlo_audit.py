@@ -1,0 +1,259 @@
+"""The SPMD communication audit (ISSUE 10): CONTRACT004 enforced on the
+three mesh entrypoints under the 8-virtual-device emulated CPU mesh.
+
+Four legs:
+
+* **parsing/judgment machinery** — HLO shape byte accounting, output-
+  spec normalization, and the CONTRACT004 judgment driven on synthetic
+  :class:`CommProfile` s (including the always-fail rule for a
+  collective category absent from the budget).
+* **clean comm contracts** — the sharded grid, multihost grid and fleet
+  bucket programs each lower to compiled HLO whose collectives fit
+  their declared per-category budgets, with zero all-gather bytes on
+  the batch-sharded paths (the no-implicit-gather invariant).
+* **seeded regression** — under the ``chatty_collective`` failpoint
+  (one extra value-preserving cross-batch collective per chunk) the
+  auditor FAILS CONTRACT004 with per-entrypoint + per-category + HLO
+  op-name attribution.
+* the console/JSON subprocess leg lives in ``tests/test_tooling.py``.
+
+Opt out on WIP branches with ``PINT_TPU_SKIP_CONTRACTS=1`` (this module
+rides the ``contracts`` gate; conftest.py marks it accordingly).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pint_tpu import faultinject
+from pint_tpu.lint import contracts, hlo_audit
+from pint_tpu.lint.contracts import REGISTRY, ContractFixture, check
+from pint_tpu.lint.hlo_audit import (
+    CollectiveOp,
+    CommProfile,
+    normalize_spec,
+    shape_bytes,
+    sharding_mismatches,
+)
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PINT_TPU_SKIP_CONTRACTS") == "1",
+    reason="PINT_TPU_SKIP_CONTRACTS=1")
+
+#: the three mesh entrypoints the tentpole must cover in tier-1
+COMM_CONTRACTS = ("sharded_chunk", "multihost_chunk", "fleet_fit")
+
+
+class TestShapeBytes:
+    def test_scalar_vector_matrix(self):
+        assert shape_bytes("f64[]") == 8
+        assert shape_bytes("f64[4]") == 32
+        assert shape_bytes("f32[2,3]") == 24
+
+    def test_tuple_shape_sums_components(self):
+        assert shape_bytes("(f64[4], f32[2,3])") == 32 + 24
+
+    def test_narrow_dtypes(self):
+        assert shape_bytes("pred[8]") == 8
+        assert shape_bytes("bf16[10]") == 20
+        assert shape_bytes("s32[3]") == 12
+
+
+class TestNormalizeSpec:
+    def test_drops_unsharded_dims(self):
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("batch", "toa"))
+        assert normalize_spec(P("batch", None), mesh) == ("batch",)
+        assert normalize_spec(P(None, None), mesh) == ()
+
+    def test_drops_size_one_mesh_axes(self):
+        # the multihost wrapper's per-process (1, n) mesh: a size-1
+        # batch axis is indistinguishable from replication, so the
+        # comparison must treat P("batch") and P() as the same spec
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        devs = np.array(jax.devices()[:8]).reshape(1, 8)
+        mesh = Mesh(devs, ("batch", "toa"))
+        assert normalize_spec(P("batch"), mesh) == ()
+        assert normalize_spec(P("toa"), mesh) == ("toa",)
+
+
+def _profile(counts=None, byts=None, ops=None, peak=0, specs=None):
+    counts = counts or {}
+    byts = byts if byts is not None else {
+        k: 100 for k in counts}
+    if ops is None:  # judgment reads a representative op per category
+        ops = [CollectiveOp(f"{k}.{i}", k, 8)
+               for k in counts for i in range(counts[k])]
+    return CommProfile(counts, byts, tuple(ops), sum(byts.values()),
+                       0, 0, 0, peak, specs)
+
+
+class TestJudgment:
+    """CONTRACT004 judgment on synthetic profiles — the machinery leg
+    (no lowering, so the always-fail semantics are pinned exactly)."""
+
+    @pytest.fixture()
+    def contract(self):
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("_test_comm", max_compiles=1,
+                           max_dispatches=1,
+                           max_collectives={"all-reduce": 2},
+                           max_comm_bytes=1000,
+                           max_device_peak_bytes=10_000)
+        def entry():
+            pass
+
+        yield REGISTRY["_test_comm"]
+        del REGISTRY["_test_comm"]
+
+    def _codes(self, c, profile, mismatches=()):
+        return [(f.code, f.message)
+                for f in contracts._judge_comm(c, profile,
+                                               list(mismatches))]
+
+    def test_clean_profile_has_no_findings(self, contract):
+        prof = _profile({"all-reduce": 2}, peak=500)
+        assert self._codes(contract, prof) == []
+
+    def test_unbudgeted_category_always_fails(self, contract):
+        """The tentpole's always-fail rule: a collective category
+        present in the HLO but absent from max_collectives is a
+        failure no matter how small — new communication cannot ride
+        in unbudgeted."""
+        prof = _profile({"all-reduce": 1, "all-gather": 1})
+        findings = self._codes(contract, prof)
+        assert any(code == "CONTRACT004" and "unbudgeted" in msg
+                   and "all-gather" in msg for code, msg in findings), \
+            findings
+
+    def test_count_breach_names_category_and_op(self, contract):
+        prof = _profile({"all-reduce": 3},
+                        ops=[CollectiveOp(f"all-reduce.{i}",
+                                          "all-reduce", 8)
+                             for i in range(3)])
+        findings = self._codes(contract, prof)
+        assert any(code == "CONTRACT004" and "all-reduce" in msg
+                   and "count 3 exceeds budget 2" in msg
+                   and "all-reduce.0" in msg
+                   for code, msg in findings), findings
+
+    def test_comm_bytes_breach(self, contract):
+        prof = _profile({"all-reduce": 2}, byts={"all-reduce": 5000})
+        findings = self._codes(contract, prof)
+        assert any(code == "CONTRACT004" and "bytes" in msg
+                   for code, msg in findings), findings
+
+    def test_peak_bytes_breach(self, contract):
+        prof = _profile({"all-reduce": 2}, peak=50_000)
+        findings = self._codes(contract, prof)
+        assert any(code == "CONTRACT004" and "peak" in msg
+                   for code, msg in findings), findings
+
+    def test_sharding_mismatch_is_a_finding(self, contract):
+        prof = _profile({"all-reduce": 2})
+        findings = self._codes(contract, prof,
+                               mismatches=[(0, (), ("batch",))])
+        assert any(code == "CONTRACT004" and "sharding" in msg.lower()
+                   for code, msg in findings), findings
+
+    def test_mismatch_helper(self):
+        prof = _profile(specs=((), ("batch",)))
+        mm = sharding_mismatches(prof, (("batch",), ("batch",)))
+        assert mm == [(0, (), ("batch",))]
+        assert sharding_mismatches(prof, None) == []
+
+
+@pytest.fixture(scope="module")
+def comm_runs():
+    """Each mesh entrypoint checked ONCE on a shared fixture; the clean
+    tests below assert different properties of the same lowered
+    programs (the comm leg caches its profile on the fixture)."""
+    contracts._ensure_registered()
+    fix = ContractFixture()
+    runs = {}
+    for name in COMM_CONTRACTS:
+        rep = check(name, fixture=fix)
+        prof, mm = fix._cache[("comm", name)]
+        runs[name] = (rep, prof, mm)
+    return runs
+
+
+class TestCommContractsClean:
+    def test_comm_budgets_declared_on_mesh_entrypoints(self):
+        contracts._ensure_registered()
+        for name in COMM_CONTRACTS:
+            c = REGISTRY[name]
+            assert c.max_collectives is not None, name
+            assert c.max_comm_bytes is not None, name
+            assert c.max_device_peak_bytes is not None, name
+
+    def test_all_three_pass_clean(self, comm_runs):
+        """THE tier-1 CONTRACT004 gate: every mesh entrypoint's
+        compiled HLO fits its declared collective budgets."""
+        for name, (rep, _, _) in comm_runs.items():
+            assert rep.ok, (name, [f.format() for f in rep.findings])
+
+    def test_sharded_grid_has_no_gather(self, comm_runs):
+        """The no-implicit-gather invariant: the batch axis carries
+        whole grid points, so the sharded grid program's collectives
+        are "toa"-axis reductions only — an all-gather would mean XLA
+        resolved an output replicated and the scaling curve is flat."""
+        _, prof, mm = comm_runs["sharded_chunk"]
+        assert prof.counts.get("all-gather", 0) == 0, prof.counts
+        assert set(prof.counts) <= {"all-reduce"}, prof.counts
+        assert prof.comm_bytes > 0          # the audit really saw comm
+        assert mm == []
+        # the compiled outputs really are batch-sharded, not replicated
+        assert prof.output_specs == (("batch",), ("batch",))
+
+    def test_multihost_program_is_reduce_only(self, comm_runs):
+        _, prof, mm = comm_runs["multihost_chunk"]
+        assert set(prof.counts) <= {"all-reduce"}, prof.counts
+        assert mm == []
+
+    def test_fleet_gathers_are_sanctioned_and_bounded(self, comm_runs):
+        """XLA replicates the fleet bucket program's unconstrained vmap
+        output via all-gather; the contract SANCTIONS exactly that
+        (bounded per-category) rather than pretending it isn't there."""
+        _, prof, _ = comm_runs["fleet_fit"]
+        budget = REGISTRY["fleet_fit"].max_collectives
+        for cat, n in prof.counts.items():
+            assert cat in budget, (cat, prof.counts)
+            assert n <= budget[cat], (cat, prof.counts)
+
+    def test_memory_analysis_is_read(self, comm_runs):
+        for name, (_, prof, _) in comm_runs.items():
+            assert prof.peak_bytes > 0, name
+            assert prof.peak_bytes <= \
+                REGISTRY[name].max_device_peak_bytes, name
+
+
+class TestChattyCollective:
+    def test_chatty_collective_fails_contract004(self):
+        """The seeded regression: one extra value-preserving cross-
+        batch collective per chunk (invisible to chi2 AND to the
+        dispatch counters) must fail CONTRACT004 with per-entrypoint,
+        per-category and HLO-op attribution.  A FRESH fixture is
+        required — the failpoint is consulted at program build time."""
+        with faultinject.chatty_collective():
+            rep = check("sharded_chunk", fixture=ContractFixture())
+        bad = [f for f in rep.findings if f.code == "CONTRACT004"]
+        assert bad, [f.format() for f in rep.findings]
+        msg = bad[0].message
+        assert "sharded_chunk" in msg
+        assert "all-reduce" in msg
+        assert "exceeds budget" in msg
+        assert "HLO op" in msg
+        assert "@dispatch_contract('sharded_chunk')" in bad[0].source
+
+    def test_failpoint_is_env_activatable(self):
+        """PINT_TPU_FAULTS=chatty_collective must reach the registry —
+        the subprocess CLI leg in test_tooling.py depends on it."""
+        assert "chatty_collective" in faultinject._ENV_FACTORIES

@@ -489,6 +489,161 @@ class TestTRACE002:
         assert codes(src) == []
 
 
+# --- SHARD001: bare device_put in mesh-reachable code -------------------------
+class TestSHARD001:
+    def test_fires_on_bare_device_put_near_mesh(self):
+        src = """
+        import jax
+        from jax.sharding import Mesh
+
+        def run(xs):
+            mesh = Mesh(jax.devices(), ("batch",))
+            return jax.device_put(xs)
+        """
+        assert codes(src) == ["SHARD001"]
+
+    def test_fires_through_the_call_graph(self):
+        # mesh-reachability propagates roots -> callees, like
+        # jit-reachability does for TRACE001
+        src = """
+        import jax
+        from jax.sharding import Mesh
+
+        def helper(xs):
+            return jax.device_put(xs)
+
+        def run(xs):
+            mesh = Mesh(jax.devices(), ("batch",))
+            return helper(xs)
+        """
+        assert codes(src) == ["SHARD001"]
+
+    def test_clean_with_explicit_sharding(self):
+        src = """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        def run(xs):
+            mesh = Mesh(jax.devices(), ("batch",))
+            s = NamedSharding(mesh, PartitionSpec("batch"))
+            return jax.device_put(xs, s)
+        """
+        assert codes(src) == []
+
+    def test_clean_outside_mesh_reachable_code(self):
+        # a bare device_put is fine on single-device paths: the hazard
+        # is ONLY the silent full replica inside mesh code
+        src = """
+        import jax
+
+        def stage(xs):
+            return jax.device_put(xs)
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        import jax
+        from jax.sharding import Mesh
+
+        def run(xs):
+            mesh = Mesh(jax.devices(), ("batch",))
+            return jax.device_put(xs)  # ddlint: disable=SHARD001 host staging
+        """
+        assert codes(src) == []
+
+
+# --- SHARD002: batch-sharded wrap without declared output specs ---------------
+class TestSHARD002:
+    def test_fires_on_shard_map_without_out_specs(self):
+        src = """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x * 2.0
+
+        def run(mesh, xs):
+            f = shard_map(body, mesh=mesh, in_specs=(P("batch"),))
+            return f(xs)
+        """
+        assert codes(src) == ["SHARD002"]
+
+    def test_fires_on_pjit_without_out_shardings(self):
+        src = """
+        from jax.experimental.pjit import pjit
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x * 2.0
+
+        def run(mesh, xs):
+            f = pjit(body, in_shardings=(P("batch"),))
+            return f(xs)
+        """
+        assert codes(src) == ["SHARD002"]
+
+    def test_clean_with_out_specs(self):
+        src = """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x * 2.0
+
+        def run(mesh, xs):
+            f = shard_map(body, mesh=mesh, in_specs=(P("batch"),),
+                          out_specs=P("batch"))
+            return f(xs)
+        """
+        assert codes(src) == []
+
+    def test_clean_when_body_constrains_its_output(self):
+        src = """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return jax.lax.with_sharding_constraint(x * 2.0, P("batch"))
+
+        def run(mesh, xs):
+            f = shard_map(body, mesh=mesh, in_specs=(P("batch"),))
+            return f(xs)
+        """
+        assert codes(src) == []
+
+    def test_clean_without_batch_axis(self):
+        # only batch-sharded wraps are in scope: a replicated output of
+        # a "toa"-only reduction is not the flat-scaling-curve hazard
+        src = """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x * 2.0
+
+        def run(mesh, xs):
+            f = shard_map(body, mesh=mesh, in_specs=(P("toa"),))
+            return f(xs)
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x * 2.0
+
+        def run(mesh, xs):
+            f = shard_map(body, mesh=mesh, in_specs=(P("batch"),))  # ddlint: disable=SHARD002 replicated by design
+            return f(xs)
+        """
+        assert codes(src) == []
+
+
 # --- the jaxpr audit ----------------------------------------------------------
 class TestJaxprAudit:
     def test_fires_on_seeded_f32_demotion(self):
@@ -600,6 +755,26 @@ class TestGate:
         assert rc == 1
         assert [f["code"] for f in out["findings"]] == ["PREC001"]
 
+    def test_github_format_emits_error_annotations(self, tmp_path,
+                                                   capsys):
+        """ISSUE 10 satellite: ``--format=github`` renders findings as
+        GitHub Actions ``::error`` workflow commands with file/line
+        anchors, so CI surfaces them inline on the PR diff."""
+        from pint_tpu.lint.cli import main
+
+        bad = tmp_path / "residuals.py"
+        bad.write_text(
+            "import jax.numpy as jnp\n\n"
+            "def f(x):\n"
+            "    return x.astype(jnp.float32)\n")
+        rc = main(["--no-jaxpr-audit", "--no-baseline",
+                   "--format=github", str(bad)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert out.startswith("::error file=residuals.py,line=4,col=")
+        assert "PREC001" in out
+        assert "::notice::pint-tpu-lint" in out
+
     def test_update_baseline_roundtrip(self, tmp_path, capsys):
         from pint_tpu.lint.cli import main
 
@@ -623,7 +798,8 @@ class TestGate:
         out = capsys.readouterr().out
         for code in ("DD001", "PREC001", "TRACE001", "TRACE002",
                      "JIT001", "JIT002", "JAXPR001", "CONTRACT001",
-                     "CONTRACT002"):
+                     "CONTRACT002", "CONTRACT003", "CONTRACT004",
+                     "SHARD001", "SHARD002"):
             assert code in out
 
 

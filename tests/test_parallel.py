@@ -100,6 +100,57 @@ def test_mesh_shapes():
     assert make_mesh(1).devices.shape == (1, 1)
 
 
+class TestMeshEdgeCases:
+    """pad_batch / make_mesh / make_batch_mesh boundary behavior the
+    sharded paths rely on (ISSUE 10 satellite)."""
+
+    def test_pad_batch_aligned_is_identity(self, fitter):
+        batch = fitter.resids.batch          # 96 TOAs
+        assert batch.ntoas % 4 == 0
+        assert pad_batch(batch, 4) is batch  # no copy on the fast path
+        assert pad_batch(batch, 1) is batch
+
+    def test_pad_batch_rows_are_fit_neutral(self, fitter):
+        batch = fitter.resids.batch
+        padded = pad_batch(batch, 7)         # 96 -> 98: 2 pad rows
+        assert padded.ntoas == 98
+        err = np.asarray(padded.error_us)
+        np.testing.assert_array_equal(err[:96],
+                                      np.asarray(batch.error_us))
+        assert np.all(err[96:] == 1e12)      # zero weight
+        # pad rows duplicate the last real TOA, so every derived
+        # quantity (delays, phases) stays finite and in-span
+        np.testing.assert_array_equal(
+            np.asarray(padded.tdb_day)[96:],
+            np.broadcast_to(np.asarray(batch.tdb_day)[-1], (2,)))
+
+    def test_make_mesh_rejects_bad_split(self):
+        with pytest.raises(ValueError, match="do not split"):
+            make_mesh(8, batch=3)
+
+    def test_make_mesh_explicit_batch(self):
+        mesh = make_mesh(8, batch=4)
+        assert mesh.devices.shape == (4, 2)
+        assert mesh.axis_names == ("batch", "toa")
+
+    def test_make_batch_mesh_shapes(self):
+        from pint_tpu.parallel import make_batch_mesh
+
+        assert make_batch_mesh(1).devices.shape == (1,)
+        mesh = make_batch_mesh()             # every local device
+        assert mesh.devices.shape == (jax.device_count(),)
+        assert mesh.axis_names == ("batch",)
+
+    def test_degenerate_mesh_matches_flat(self, fitter):
+        """A (1, 1) mesh is the no-parallelism limit: the sharded path
+        must still agree with the plain flat grid (no collectives to
+        hide behind)."""
+        chi2 = sharded_grid_chisq(fitter, GRID, mesh=make_mesh(1),
+                                  maxiter=2)
+        np.testing.assert_allclose(
+            chi2, grid_chisq_flat(fitter, GRID, maxiter=2), rtol=1e-8)
+
+
 class TestCheckpointedShardedScan:
     """Preemption tolerance of the distributed grid (ISSUE 4): the
     chunked sharded scan matches the one-dispatch path, survives a

@@ -425,6 +425,38 @@ class TestContractsGate:
         assert any(f["code"] == "CONTRACT001"
                    for f in doc["findings"]), doc["findings"]
 
+    def test_chatty_collective_exits_one_with_comm_attribution(self):
+        """ISSUE 10 acceptance: the chatty_collective failpoint (one
+        extra value-preserving cross-batch all-reduce per chunk —
+        invisible to chi2 and to the dispatch counters) crosses the
+        process boundary via PINT_TPU_FAULTS and makes the CLI exit 1
+        with per-entrypoint + per-category CONTRACT004 attribution."""
+        import json
+
+        proc = self._run(["--contracts=sharded_chunk", "--format=json"],
+                         {"PINT_TPU_FAULTS": "chatty_collective"})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        msgs = [f["message"] for f in doc["findings"]
+                if f["code"] == "CONTRACT004"]
+        assert msgs, doc["findings"]
+        assert any("sharded_chunk" in m and "all-reduce" in m
+                   and "exceeds budget" in m for m in msgs), msgs
+
+    def test_github_format_annotates_comm_breach(self):
+        """``--format=github`` (ISSUE 10 satellite): the same breach
+        surfaces as ``::error`` workflow-command annotations so CI runs
+        pin findings to the PR diff."""
+        proc = self._run(["--contracts=sharded_chunk",
+                          "--format=github"],
+                         {"PINT_TPU_FAULTS": "chatty_collective"})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        lines = proc.stdout.splitlines()
+        errs = [ln for ln in lines if ln.startswith("::error file=")]
+        assert errs and any("CONTRACT004" in ln for ln in errs), lines
+        assert any(ln.startswith("::notice::pint-tpu-lint")
+                   for ln in lines), lines
+
     def test_unknown_contract_is_a_usage_error(self):
         proc = self._run(["--contracts=not_a_contract"])
         assert proc.returncode == 2
