@@ -420,6 +420,76 @@ def bench_cold_start(fixtures: str = "quick", timeout_s: float = 600):
     }
 
 
+def bench_serve(n_requests: int = 24, batch_size: int = 2,
+                max_wait_ms: float = 25.0, utilization: float = 0.5,
+                seed: int = 77, subset: int = 0):
+    """Open-loop Poisson load against the always-on timing daemon
+    (ISSUE 11, ``pint_tpu.serve``): requests arrive on an exponential
+    inter-arrival clock that does NOT wait for completions (open loop —
+    queueing delay is measured, not hidden), each is routed to its
+    structure/shape bucket and coalesced into the bucket's compiled
+    padded program; partial buckets dispatch on the max-latency timer.
+    Latencies are per-request submit -> future-resolved.  The offered
+    rate is calibrated to ~``utilization`` of the measured warm batch
+    capacity so p99 reflects coalescing + timer policy, not backlog
+    collapse."""
+    from pint_tpu import profiling
+    from pint_tpu.exceptions import ServeSaturated
+    from pint_tpu.serve import _demo_service
+
+    svc, jobs = _demo_service(batch_size=batch_size, maxiter=3,
+                              max_wait_ms=max_wait_ms)
+    if subset:   # quick mode: one shape bucket -> one program compile
+        jobs = jobs[:subset]
+    # warm both bucket programs inline; the timed phase must be the
+    # steady-state request path (serve_request contract: 0 compiles)
+    t0 = time.time()
+    futs = [svc.submit_prepared(j) for j in jobs]
+    svc.flush()
+    for f in futs:
+        f.result(timeout=600.0)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    futs = [svc.submit_prepared(j) for j in jobs]
+    svc.flush()
+    for f in futs:
+        f.result(timeout=600.0)
+    warm_batch_s = max(time.time() - t0, 1e-4)
+    rate_hz = utilization * len(jobs) / warm_batch_s
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_hz, size=n_requests)
+    svc.reset_stats()
+    svc.start()
+    rejected = 0
+    futs = []
+    t0 = time.time()
+    with profiling.paused():   # timed loop: no per-stage blocking
+        for i in range(n_requests):
+            time.sleep(float(gaps[i]))
+            try:
+                futs.append(svc.submit_prepared(jobs[i % len(jobs)]))
+            except ServeSaturated:   # backpressure is a result, not
+                rejected += 1        # a bench failure
+        for f in futs:
+            f.result(timeout=600.0)
+        st = svc.drain(timeout=600.0)
+    wall = max(time.time() - t0, 1e-9)
+    return {
+        "n_requests": n_requests, "completed": st["completed"],
+        "rejected": rejected, "offered_rate_hz": round(rate_hz, 1),
+        "p50_ms": st["p50_ms"], "p99_ms": st["p99_ms"],
+        "mean_ms": st["mean_ms"],
+        "fits_per_sec": round(st["completed"] / wall, 1),
+        "batch_occupancy": st["batch_occupancy"],
+        "timer_flush_fraction": st["timer_flush_fraction"],
+        "dispatches": st["dispatches"],
+        "timer_flushes": st["timer_flushes"],
+        "full_flushes": st["full_flushes"],
+        "max_wait_ms": max_wait_ms, "batch_size": batch_size,
+        "n_buckets": st["n_buckets"], "compile_s": round(compile_s, 2),
+        "wall_s": round(wall, 4)}
+
+
 def bench_design_split(ntoas: int = 2500):
     """Split vs full design-matrix assembly wall-clock at the headline
     width (~86 params, 70 DMX bins), same backend, steady state (cached
@@ -725,6 +795,20 @@ def bench_quick(backend_status=None):
                                       timeout_s=600)
         except Exception as e:  # keep the quick line alive
             comm = {"error": f"{type(e).__name__}: {e}"}
+    # the always-on timing daemon under Poisson open-loop load
+    # (ISSUE 11): per-request p50/p99, sustained fits/sec, mean batch
+    # occupancy and the timer-flush fraction of the continuous-batching
+    # request path — the serving-latency regression axis
+    if fast:
+        serve = {"skipped": "PINT_TPU_BENCH_FAST=1"}
+    else:
+        try:
+            # subset=2: the two 8-TOA pulsars only -> ONE bucket
+            # program compile keeps the quick line inside its budget;
+            # the headline leg runs the full two-bucket routing shape
+            serve = bench_serve(subset=2)
+        except Exception as e:  # keep the quick line alive
+            serve = {"error": f"{type(e).__name__}: {e}"}
     # supervised-acquisition provenance (ISSUE 4): how the backend was
     # obtained — a wedged-probe run shows up as backend_rung
     # "cpu_fallback" with attempts > 1 instead of a null metric
@@ -769,8 +853,14 @@ def bench_quick(backend_status=None):
         "collectives": comm.get("collectives"),
         "comm_bytes": comm.get("comm_bytes"),
         "all_gather_bytes": comm.get("all_gather_bytes"),
+        # continuous-batching serve daemon (ISSUE 11): open-loop Poisson
+        # p50/p99 + sustained throughput of the coalesced request path
+        "serve_p50_ms": serve.get("p50_ms"),
+        "serve_p99_ms": serve.get("p99_ms"),
+        "serve_fits_per_sec": serve.get("fits_per_sec"),
+        "serve_batch_occupancy": serve.get("batch_occupancy"),
         "submetrics": {"fleet": fleet, "aot_cold_start": aot_cold,
-                       "comm_profile": comm},
+                       "comm_profile": comm, "serve": serve},
     }
 
 
@@ -868,6 +958,7 @@ def main(argv=None):
     for name, fn in (
             ("design_split", bench_design_split),
             ("fleet", bench_fleet),
+            ("serve", bench_serve),
             ("aot_cold_start", bench_cold_start),
             ("ngc6440e_wls", bench_ngc6440e),
             ("ensemble_sweep", sweep),
@@ -922,6 +1013,15 @@ def main(argv=None):
         # state whole-fleet wall (supersedes ensemble_32, see MIGRATION)
         "fleet_fits_per_sec": (submetrics.get("fleet") or {}).get(
             "fleet_fits_per_sec"),
+        # continuous-batching serve daemon (ISSUE 11): open-loop
+        # Poisson p50/p99 + sustained throughput of the coalesced
+        # request path (the serving-latency regression axis)
+        "serve_p50_ms": (submetrics.get("serve") or {}).get("p50_ms"),
+        "serve_p99_ms": (submetrics.get("serve") or {}).get("p99_ms"),
+        "serve_fits_per_sec": (submetrics.get("serve") or {}).get(
+            "fits_per_sec"),
+        "serve_batch_occupancy": (submetrics.get("serve") or {}).get(
+            "batch_occupancy"),
         # analytic solve-FLOP floor / measured wall (profiling.solve_flops)
         "solve_utilization": headline_util,
         # steady-state XLA-boundary counters (ISSUE 5): the regression

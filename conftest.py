@@ -97,9 +97,10 @@ _SLOW_TESTS = {
     # bucket-poisoning recovery depth (22.3 s): the chunk_raise reroute
     # leg keeps the requeue path tier-1; ``-m fleet`` still runs this
     ("test_fleet.py", "test_degenerate_pulsar_does_not_poison"),
-    # integrated-ephemeris analytic parity depth (19.7 s): the rest of
-    # TestIntegratedEphemeris plus test_ephemcal_units stay tier-1
-    ("test_astronomy.py", "test_matches_analytic_and_is_smooth"),
+    # integrated-ephemeris analytic parity depth (19.7 s + 22.6 s; the
+    # whole class as of the PR 9 re-tune): test_ephemcal_units and the
+    # Chebyshev ephemeris legs stay tier-1
+    ("test_astronomy.py", "TestIntegratedEphemeris"),
     # degenerate-oscillator chain recovery depth (41.1 s): the chain
     # still provably fires tier-1 via the nan-solver LM-rung recovery
     # and typed whole-chain-failure legs; ``-m faults`` still runs this
@@ -118,6 +119,37 @@ _SLOW_TESTS = {
     # chatty leg rides test_tooling.py — this is the redundant depth
     # copy
     ("test_hlo_audit.py", "test_chatty_collective_fails"),
+    # tier-1 re-tune (2026-08, suite at 922 s of the 870 s budget after
+    # the serving daemon landed): measured top-duration depth legs whose
+    # headline property stays covered by a cheaper tier-1 neighbour —
+    # the fused one-dispatch leg (18.9 s; the fused_fit contract budget
+    # in test_contracts enforces the same dispatch count tier-1, and
+    # ``-m faults`` still runs this),
+    ("test_faults.py", "test_fused_happy_path_one_dispatch"),
+    # the downhill nonfinite-Hessian fallback (7.7 s; the eager
+    # nonfinite-sigma guards and the LM overflow-bailout legs keep the
+    # nonfinite chain tier-1; ``-m faults`` still runs this),
+    ("test_faults.py", "TestDownhillNoiseHessian"),
+    # the J0740 synthetic matrix-parity leg (12.2 s; the tiny-nonlinear
+    # and all-linear TestParity matrix legs remain tier-1),
+    ("test_design_split.py", "test_j0740_synthetic_matrix"),
+    # the large-nonlinear-move refresh leg (7.7 s; cache_counters and
+    # one_device_program keep the program-budget surface tier-1),
+    ("test_design_split.py", "test_refresh_on_large_nonlinear_move"),
+    # the FD fit-recovery loop (6.8 s; delay formula / derivative /
+    # noncontiguous-rejection FD legs stay tier-1),
+    ("test_components.py", "TestFD::test_fit_recovery"),
+    # the transient-event derivative cross-check (5.2 s; the expdip /
+    # chromgauss shape+amplitude legs stay tier-1),
+    ("test_aux_components.py", "TestTransientEvents::test_derivative"),
+    # the fleet SIGTERM resume leg (6.0 s; test_serve's
+    # TestGracefulDrain proves SIGTERM spool + bit-identical resume on
+    # the same checkpoint machinery tier-1, and ``-m fleet`` runs this),
+    ("test_fleet.py", "TestPreemption"),
+    # and the sharded-fleet batch-mesh parity (6.3 s; the CONTRACT004
+    # clean gate on fleet_fit in test_hlo_audit plus the chunk-split
+    # validation leg stay tier-1; ``-m fleet`` still runs this)
+    ("test_fleet.py", "TestSharded::test_batch_mesh_parity"),
 }
 
 
@@ -180,6 +212,53 @@ def pytest_configure(config):
         "aot: the AOT serving-program store gate (tests/test_aot.py "
         "+ the two-process leg in test_tooling.py; rides tier-1, skip "
         "WIP branches with PINT_TPU_SKIP_AOT=1)")
+    config.addinivalue_line(
+        "markers",
+        "serve: the continuous-batching timing-daemon gate "
+        "(tests/test_serve.py rides tier-1; the daemon/warm-start "
+        "subprocess depth legs ride the slow test_tooling.py; run all "
+        "with -m serve, skip WIP branches with PINT_TPU_SKIP_SERVE=1)")
+
+
+# --- tier-1 wall budget ------------------------------------------------------
+# The driver runs tier-1 under ``timeout -k 10 870``: a suite that
+# outgrows that is KILLED mid-run and the truncated output can read as
+# "fewer tests, all green".  Guard the budget *inside* the session
+# instead: when a ``not slow`` run exceeds PINT_TPU_TIER1_BUDGET_S
+# (default 850 s, "0" disables) the run FAILS loudly with the top-10
+# table already on screen, while it still completes — so growth shows
+# up as a red re-tune signal, never as silent truncation (the suite hit
+# 957 s at PR 8 before a re-tune).
+
+_SESSION_T0 = None
+
+
+def pytest_sessionstart(session):
+    global _SESSION_T0
+    import time
+
+    _SESSION_T0 = time.time()
+
+
+def _tier1_budget_s():
+    try:
+        return float(os.environ.get("PINT_TPU_TIER1_BUDGET_S", "850"))
+    except ValueError:
+        return 850.0
+
+
+def _tier1_wall_exceeded(config):
+    import time
+
+    if _SESSION_T0 is None:
+        return None
+    if "not slow" not in (config.getoption("markexpr", "") or ""):
+        return None   # only the smoke tier lives under the 870 s kill
+    budget = _tier1_budget_s()
+    wall = time.time() - _SESSION_T0
+    if budget > 0 and wall > budget:
+        return wall, budget
+    return None
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
@@ -192,15 +271,45 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
         for rep in reports:
             if getattr(rep, "when", None) == "call":
                 durations.append((rep.duration, rep.nodeid))
-    if not durations:
-        return
-    durations.sort(reverse=True, key=lambda t: t[0])
-    total = sum(d for d, _ in durations)
-    terminalreporter.write_sep(
-        "=", f"slowest 10 of {len(durations)} tests "
-             f"({total:.0f}s in test calls)")
-    for d, nodeid in durations[:10]:
-        terminalreporter.write_line(f"{d:7.2f}s {nodeid}")
+    if durations:
+        durations.sort(reverse=True, key=lambda t: t[0])
+        total = sum(d for d, _ in durations)
+        terminalreporter.write_sep(
+            "=", f"slowest 10 of {len(durations)} tests "
+                 f"({total:.0f}s in test calls)")
+        for d, nodeid in durations[:10]:
+            terminalreporter.write_line(f"{d:7.2f}s {nodeid}")
+    over = _tier1_wall_exceeded(config)
+    if over is not None:
+        wall, budget = over
+        terminalreporter.write_sep(
+            "!", f"TIER-1 WALL BUDGET EXCEEDED: {wall:.0f} s > "
+                 f"{budget:.0f} s (PINT_TPU_TIER1_BUDGET_S)", red=True)
+        terminalreporter.write_line(
+            "the 870 s driver timeout would truncate this suite "
+            "silently — move depth legs from the table above into "
+            "conftest._SLOW_TESTS (session exit status forced to 1)",
+            red=True)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # flip the exit status AFTER the summary printed: a green-but-over-
+    # budget tier-1 run must come back red
+    if _tier1_wall_exceeded(session.config) is not None:
+        session.exitstatus = 1
+
+
+def _slow_entry_matches(item, pattern):
+    """_SLOW_TESTS entry forms: a bare test-name prefix, a class name
+    (exact), or ``Class::test_name`` to pick one test out of a class
+    whose siblings share the bare name with other classes."""
+    cls = getattr(item, "cls", None)
+    if "::" in pattern:
+        cname, _, tname = pattern.partition("::")
+        return (cls is not None and cls.__name__ == cname
+                and item.name.startswith(tname))
+    return item.name.startswith(pattern) or (
+        cls is not None and cls.__name__ == pattern)
 
 
 def pytest_collection_modifyitems(config, items):
@@ -223,6 +332,18 @@ def pytest_collection_modifyitems(config, items):
             if skip_aot:
                 item.add_marker(_pytest.mark.skip(
                     reason="PINT_TPU_SKIP_AOT=1"))
+        if fname == "test_serve.py" or (
+                fname == "test_tooling.py" and getattr(
+                    item, "cls", None) is not None
+                and item.cls.__name__.startswith("TestServe")):
+            # the timing-daemon gate: cheap headline legs ride tier-1
+            # (test_serve.py), the subprocess daemon/warm-start depth
+            # legs ride the slow test_tooling.py; ``-m serve`` selects
+            # both
+            item.add_marker(_pytest.mark.serve)
+            if os.environ.get("PINT_TPU_SKIP_SERVE") == "1":
+                item.add_marker(_pytest.mark.skip(
+                    reason="PINT_TPU_SKIP_SERVE=1"))
         if fname == "test_fleet.py":
             # the many-pulsar fleet gate mirrors the contracts gate's
             # opt-out contract (PINT_TPU_SKIP_FLEET=1 on WIP branches)
@@ -253,9 +374,7 @@ def pytest_collection_modifyitems(config, items):
                 item.add_marker(_pytest.mark.skip(
                     reason="PINT_TPU_SKIP_LINT=1"))
         if fname in _SLOW_FILES or any(
-                fname == f and item.name.startswith(p) or
-                fname == f and getattr(item, "cls", None) is not None
-                and item.cls.__name__ == p
+                fname == f and _slow_entry_matches(item, p)
                 for f, p in _SLOW_TESTS):
             item.add_marker(_pytest.mark.slow)
         if fname in _PARITY_FILES or any(

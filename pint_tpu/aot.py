@@ -983,6 +983,31 @@ def _fleet4_fixture():
     return run, run
 
 
+def _serve_fixture():
+    """The timing daemon's bucket programs (PR 9): two serve buckets
+    (8- and 16-TOA shape classes over the same structure key) driven
+    through the inline submit/flush path.  Serve quantizes pad shapes
+    as a pure function of each job (power-of-two, not max-member), so
+    a warm process reproduces the ProgramKeys exactly."""
+    from pint_tpu.fitter import FitStatus
+    from pint_tpu.serve import _demo_service
+
+    svc, jobs = _demo_service(batch_size=2, maxiter=3)
+
+    def run(out: dict) -> None:
+        futs = [svc.submit_prepared(j) for j in jobs]
+        svc.flush()
+        res = [f.result(timeout=600.0) for f in futs]
+        out["serve"] = {
+            "n_jobs": len(res),
+            "n_buckets": svc.stats()["n_buckets"],
+            "n_ok": sum(r.status in (FitStatus.CONVERGED,
+                                     FitStatus.MAXITER) for r in res),
+            "chi2": [round(float(r.chi2), 6) for r in res]}
+
+    return run, run
+
+
 def warm_fixtures() -> Dict[str, Callable]:
     """The deterministic serving fixtures the ``warm``/``check`` CLI
     legs drive — the entrypoint programs a fresh serving process needs
@@ -996,7 +1021,7 @@ def warm_fixtures() -> Dict[str, Callable]:
     thousands of tiny eager dispatches that would otherwise drown the
     measurement in instrumentation overhead)."""
     return {"quick": _quick_fixture, "b1855": _b1855_fixture,
-            "fleet4": _fleet4_fixture}
+            "fleet4": _fleet4_fixture, "serve": _serve_fixture}
 
 
 def _resolve_fixtures(fixtures: Optional[List[str]]) -> List[str]:

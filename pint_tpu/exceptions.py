@@ -161,6 +161,33 @@ class ScanInterrupted(PintTpuError):
         super().__init__(msg)
 
 
+class ServeError(PintTpuError):
+    """Base for timing-service (``pint_tpu.serve``) failures."""
+
+
+class ServeSaturated(ServeError):
+    """The timing service's bounded request queue is full — backpressure,
+    not a crash: the job was never admitted and can be resubmitted once
+    in-flight batches drain (or to another replica)."""
+
+
+class ServeDrained(ServeError):
+    """The timing service is draining (SIGTERM/shutdown): admission is
+    closed and this job was not fitted.  When the service has a spool
+    configured, every still-queued job was flushed there through the
+    checkpoint machinery before this was raised, so
+    ``TimingService.resume_spool`` on a restarted daemon readmits them
+    bit-identically.
+
+    Attributes: ``spool`` (path or None), ``n_spooled``, ``signum``."""
+
+    def __init__(self, msg="", spool=None, n_spooled=0, signum=None):
+        self.spool = spool
+        self.n_spooled = n_spooled
+        self.signum = signum
+        super().__init__(msg)
+
+
 class MultihostTimeoutError(PintTpuError):
     """A multi-host rendezvous (``multihost.init``) or collective barrier
     did not complete within its deadline — a peer process is likely dead

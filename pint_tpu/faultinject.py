@@ -52,7 +52,7 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "wedged_probe", "chunk_nonfinite", "chunk_raise",
            "sigterm_midscan", "corrupt_checkpoint", "retrace_storm",
            "chatty_transfer", "chatty_collective", "corrupt_aot_blob",
-           "stale_aot_version"]
+           "stale_aot_version", "request_flood", "stalled_bucket"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -471,6 +471,53 @@ def chatty_collective() -> Iterator[None]:
         yield
 
 
+def _request_flood_factory(fn):
+    """Replace the serve daemon's admission-capacity check with a
+    constant "queue full" — the sustained-overload regression where
+    arrivals outrun drain.  The daemon must answer with typed
+    backpressure (``ServeSaturated`` per request, ``serve.rejected``
+    counters), never an unbounded queue or a hang."""
+    def flooded(*args, **kwargs):
+        return False
+    return flooded
+
+
+@contextlib.contextmanager
+def request_flood() -> Iterator[None]:
+    """Failpoint ``"request_flood"``: every admission to a
+    ``pint_tpu.serve.TimingService`` sees a full queue and is rejected
+    with ``ServeSaturated`` (see ``TimingService.submit_prepared``,
+    which routes its capacity check through this failpoint).
+    Env-activatable (``PINT_TPU_FAULTS=request_flood``) for the
+    ``python -m pint_tpu.serve check`` subprocess leg."""
+    with _registered("request_flood", _request_flood_factory):
+        yield
+
+
+def _stalled_bucket_factory(fn):
+    """Replace the serve daemon's bucket-full readiness check with a
+    constant "not full", so the fast path (dispatch when ``batch_size``
+    jobs coalesce) can never fire and ONLY the max-latency timer (or
+    drain) can flush a bucket — proving the
+    ``PINT_TPU_SERVE_MAX_WAIT_MS`` deadline path rather than assuming
+    it."""
+    def stalled(*args, **kwargs):
+        return False
+    return stalled
+
+
+@contextlib.contextmanager
+def stalled_bucket() -> Iterator[None]:
+    """Failpoint ``"stalled_bucket"``: serve buckets never report full
+    (see ``TimingService._ready_batch_locked``), so every dispatch is a
+    timer flush — partial-bucket latency is bounded by the deadline,
+    not by traffic.  Env-activatable
+    (``PINT_TPU_FAULTS=stalled_bucket``) for the
+    ``python -m pint_tpu.serve check`` subprocess leg."""
+    with _registered("stalled_bucket", _stalled_bucket_factory):
+        yield
+
+
 #: failpoints activatable across a process boundary via the
 #: PINT_TPU_FAULTS env var (comma-separated names; process-lifetime,
 #: no context manager to exit) — the bench/CLI-subprocess test leg
@@ -480,6 +527,8 @@ _ENV_FACTORIES = {
     "chatty_transfer": _chatty_transfer_factory,
     "chatty_collective": _chatty_collective_factory,
     "stale_aot_version": _stale_aot_version_factory,
+    "request_flood": _request_flood_factory,
+    "stalled_bucket": _stalled_bucket_factory,
 }
 
 

@@ -342,6 +342,20 @@ _TAIL = 5
 _COL_CHI2, _COL_STATUS, _COL_ITERS, _COL_BEST, _COL_NBAD = range(5)
 
 
+def _pad_pdict(resid: Residuals, n_toa: int) -> dict:
+    """Pad a pulsar's params-pytree per-TOA mask leaves to ``n_toa``
+    rows (const/delta leaves are per-parameter, not per-TOA, and pass
+    through).  Shared by the fleet chunk staging and the serve daemon's
+    per-job staging — both stack these into bucket-program inputs."""
+    p = resid.pdict
+    npad = n_toa - resid.batch.ntoas
+    mask = {k: (np.concatenate([np.asarray(v, np.float64),
+                                np.zeros(npad)])
+                if npad else np.asarray(v, np.float64))
+            for k, v in p["mask"].items()}
+    return {"const": p["const"], "delta": p["delta"], "mask": mask}
+
+
 class _EagerOut(NamedTuple):
     chi2: float
     x: np.ndarray
@@ -606,17 +620,7 @@ class FleetFitter:
         dkeys = plan["delta_keys"][b.skey_idx]
         kidx = {k: j for j, k in enumerate(dkeys)}
 
-        def pad_pdict(pu):
-            p = pu.resid.pdict
-            npad = b.n_toa - pu.resid.batch.ntoas
-            mask = {k: (np.concatenate([np.asarray(v, np.float64),
-                                        np.zeros(npad)])
-                        if npad else np.asarray(v, np.float64))
-                    for k, v in p["mask"].items()}
-            return {"const": p["const"], "delta": p["delta"],
-                    "mask": mask}
-
-        pdicts = [pad_pdict(pu) for pu in ps]
+        pdicts = [_pad_pdict(pu.resid, b.n_toa) for pu in ps]
         stacked_p = jax.tree_util.tree_map(
             lambda *xs: np.stack([np.asarray(x, np.float64)
                                   for x in xs]), *pdicts)

@@ -193,6 +193,7 @@ def _ensure_registered() -> None:
     import pint_tpu.parallel      # noqa: F401
     import pint_tpu.residuals     # noqa: F401
     import pint_tpu.runtime       # noqa: F401
+    import pint_tpu.serve         # noqa: F401
 
 
 # --- the synthetic fixture ----------------------------------------------------
@@ -487,6 +488,28 @@ def _drv_fleet_fit(fix: ContractFixture):
     return {"call": lambda: ff.fit()}
 
 
+def _drv_serve_request(fix: ContractFixture):
+    """The serve daemon's steady-state request path: resubmit two
+    prepared 8-TOA jobs (one structure/shape bucket -> ONE coalesced
+    batch) and flush inline.  A FRESH TimingService per builder call —
+    check_warm's leg B must rebuild programs against the warm store —
+    while the jobs reuse the fleet fixture's models/TOAs (preparation
+    is host-side staging, outside the instrumented window)."""
+    from pint_tpu.serve import TimingService
+
+    ff = fix.fleet_fitter()
+    svc = TimingService(batch_size=2, maxiter=3)
+    jobs = [svc.prepare(pu.model, pu.toas, name=pu.name)
+            for pu in ff._pulsars[:2]]
+
+    def call():
+        futs = [svc.submit_prepared(j) for j in jobs]
+        svc.flush()
+        return [f.result(timeout=600.0).chi2 for f in futs]
+
+    return {"call": call}
+
+
 _DRIVERS: Dict[str, Callable[[ContractFixture], dict]] = {
     "residuals": _drv_residuals,
     "split_assembly": _drv_split_assembly,
@@ -500,6 +523,7 @@ _DRIVERS: Dict[str, Callable[[ContractFixture], dict]] = {
     "checkpointed_chunk": _drv_checkpointed_chunk,
     "mcmc_step": _drv_mcmc_step,
     "fleet_fit": _drv_fleet_fit,
+    "serve_request": _drv_serve_request,
 }
 
 

@@ -60,6 +60,7 @@ Typical use::
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
 import time
 from typing import Dict, Iterator, NamedTuple, Optional
@@ -68,7 +69,7 @@ __all__ = ["enable", "disable", "enabled", "reset", "report", "table",
            "stage", "count", "counters", "snapshot", "counters_since",
            "stages_since", "session", "paused", "trace",
            "Session", "Snapshot", "device_peak_flops", "solve_flops",
-           "mfu_report"]
+           "mfu_report", "latency_stats"]
 
 _enabled = False
 _stages: Dict[str, list] = {}   # name -> [calls, wall_s]
@@ -273,6 +274,27 @@ def trace(logdir: str) -> Iterator[None]:
 
     with jax.profiler.trace(logdir):
         yield
+
+
+def latency_stats(samples_s) -> Dict[str, Optional[float]]:
+    """Nearest-rank percentiles over per-request latency samples
+    (seconds in, milliseconds out) — the serving-path summary the
+    ``bench_serve`` submetric and ``TimingService.stats()`` report.
+    Empty input yields ``None`` percentiles (JSON null), never a fake
+    zero."""
+    xs = sorted(float(s) for s in samples_s)
+    if not xs:
+        return {"n_samples": 0, "p50_ms": None, "p99_ms": None,
+                "mean_ms": None}
+
+    def pct(q: float) -> float:
+        i = min(len(xs) - 1, max(0, int(math.ceil(q * len(xs))) - 1))
+        return xs[i] * 1e3
+
+    return {"n_samples": len(xs),
+            "p50_ms": round(pct(0.50), 4),
+            "p99_ms": round(pct(0.99), 4),
+            "mean_ms": round(sum(xs) / len(xs) * 1e3, 4)}
 
 
 # --- FLOP / MFU accounting ---------------------------------------------------

@@ -66,7 +66,7 @@ _log = _logchild("runtime")
 __all__ = ["BackendStatus", "acquire_backend", "configure_compile_cache",
            "write_checkpoint", "load_checkpoint", "scan_signature",
            "ChunkStatus", "ScanSummary", "run_checkpointed_scan",
-           "call_with_deadline"]
+           "call_with_deadline", "SignalFlush"]
 
 
 # --- supervised backend acquisition -------------------------------------------
@@ -438,6 +438,14 @@ class _SignalFlush:
             except (ValueError, OSError):  # pragma: no cover
                 pass
         return False
+
+
+#: public name: the serve daemon's graceful drain enters the same
+#: record-don't-kill signal window around its flush loop that the
+#: checkpointed scans use, so SIGTERM semantics are identical across
+#: every long-running entrypoint (flush state, raise typed, resume
+#: bit-identically)
+SignalFlush = _SignalFlush
 
 
 @dispatch_contract("checkpointed_chunk", max_compiles=40,

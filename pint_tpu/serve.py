@@ -1,0 +1,815 @@
+"""Always-on timing service: continuous batching over the fleet bucket
+programs and the AOT store.
+
+ROADMAP item 1's front door.  Every fit so far is a library call; at
+PTA scale the workload is thousands of independent (model, TOAs)
+requests arriving asynchronously, and the serving answer (the Vela.jl /
+VI-flow ecosystem's per-pulsar workloads, arXiv:2412.15858 /
+arXiv:2405.08857, at array scale) is *continuous batching*: coalesce
+concurrent requests into the already-compiled padded bucket programs so
+per-request cost is amortized dispatch, never a compile.
+
+* **Admission** — :meth:`TimingService.submit` (or :meth:`prepare` +
+  :meth:`submit_prepared`) stages a job host-side and appends it to its
+  bucket's queue, returning a :class:`ServeFuture`.  The queue is
+  bounded (``max_pending``); overflow is typed backpressure
+  (:class:`~pint_tpu.exceptions.ServeSaturated`), routed through the
+  ``request_flood`` failpoint so the rejection path is testable.
+* **Routing** — jobs are grouped by the fleet's structure key
+  (:meth:`FleetFitter._structure_key`) plus a power-of-two-quantized
+  ``(n_toa, n_param)`` pad shape.  Unlike the fleet's pad-to-largest-
+  member policy, the pad shape is a pure function of the job itself, so
+  a restarted daemon reproduces identical program shapes (=> identical
+  AOT ProgramKeys) without ever seeing the same job mix — that is what
+  makes the two-process zero-compile warm start (CONTRACT003) hold.
+* **Coalescing** — a full bucket (``batch_size`` jobs) dispatches
+  immediately; a partial bucket dispatches when its oldest job has
+  waited ``max_wait_ms`` (``PINT_TPU_SERVE_MAX_WAIT_MS``) — the
+  max-latency timer, routed through the ``stalled_bucket`` failpoint so
+  the timer path is provable, not incidental.  The steady-state request
+  path is the ``serve_request`` dispatch contract: 1 dispatch + 1 result
+  fetch per coalesced batch, zero compiles, zero retraces
+  (CONTRACT001/002) — per-request recompilation is structurally
+  impossible.
+* **Buffer donation** — jit-level ``donate_argnums`` would invalidate
+  the cached device inputs (and is a no-op on the CPU backend anyway),
+  so input residency is bounded instead: stacked batch inputs live in a
+  small LRU keyed by the job composition, and evicting an entry between
+  dispatches releases its device buffers back to the allocator before
+  the next batch stages new ones.  Re-dispatching an identical batch
+  pays zero host->device bytes.
+* **Graceful drain** — :meth:`flush` runs under the PR 4 signal
+  machinery (:class:`pint_tpu.runtime.SignalFlush`): on SIGTERM/SIGINT
+  the in-flight batch finishes (its futures resolve), every still-
+  queued job is spooled through
+  :func:`pint_tpu.runtime.write_checkpoint` (CRC-verified, atomic), and
+  :class:`~pint_tpu.exceptions.ServeDrained` is raised;
+  :meth:`resume_spool` on a restarted daemon readmits the spool after
+  verifying each resubmitted job is BIT-identical to what was queued.
+
+``python -m pint_tpu.serve check`` runs the deterministic demo service
+through the daemon path and prints one JSON line of stats — the
+subprocess surface the tooling tests drive under the failpoints.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import aot, faultinject, profiling, runtime
+from pint_tpu.exceptions import (CorrelatedErrors, ServeDrained,
+                                 ServeSaturated)
+from pint_tpu.fitter import FitStatus, _default_wls_kernel
+from pint_tpu.fleet import (_COL_CHI2, _COL_ITERS, _COL_STATUS,
+                            FleetFitter, _build_bucket_fit, _pad_pdict,
+                            _Pulsar)
+from pint_tpu.lint.contracts import dispatch_contract
+from pint_tpu.logging import child as _logchild
+from pint_tpu.residuals import Residuals
+from pint_tpu.toabatch import pad_batch_to
+
+_log = _logchild("serve")
+
+__all__ = ["TimingService", "PreparedJob", "ServeFuture", "ServeResult",
+           "DEFAULT_MAX_WAIT_MS", "main"]
+
+#: partial-bucket max-latency deadline (ms) when neither the ctor arg
+#: nor PINT_TPU_SERVE_MAX_WAIT_MS is given
+DEFAULT_MAX_WAIT_MS = 50.0
+
+#: pad-shape floors: a job's (n_toa, n_param) rounds up to a power of
+#: two at least this large, so the program set stays bounded and the
+#: shapes are reproducible across daemon restarts (the AOT warm-start
+#: property — see the module docstring)
+_MIN_TOA, _MIN_PARAM = 8, 4
+
+_SPOOL_SIG = "pint_tpu.serve spool v1"
+
+_UID = itertools.count()
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    v = max(int(floor), 1)
+    while v < n:
+        v *= 2
+    return v
+
+
+class ServeResult(NamedTuple):
+    """One resolved timing request (the fleet entry shape minus requeue
+    provenance — the daemon path is the vmapped bucket program only)."""
+
+    name: str
+    chi2: float
+    dof: int
+    status: FitStatus
+    iterations: int
+    x: np.ndarray          #: fitted offsets (device units), len(fit_names)
+    fit_names: tuple
+
+    @property
+    def ok(self) -> bool:
+        return self.status in (FitStatus.CONVERGED, FitStatus.MAXITER)
+
+
+class ServeFuture:
+    """Handle for one submitted job; resolves when its coalesced batch
+    dispatch completes (or rejects with ``ServeDrained`` if the job was
+    spooled instead of fitted)."""
+
+    __slots__ = ("name", "submitted_at", "resolved_at", "_ev", "_result",
+                 "_exc")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.submitted_at = time.monotonic()
+        self.resolved_at: Optional[float] = None
+        self._ev = threading.Event()
+        self._result: Optional[ServeResult] = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if not self._ev.wait(timeout):
+            raise TimeoutError(f"timing job {self.name!r} not resolved "
+                               f"within {timeout} s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError(f"timing job {self.name!r} not resolved "
+                               f"within {timeout} s")
+        return self._exc
+
+    def _resolve(self, res: ServeResult) -> None:
+        self._result = res
+        self.resolved_at = time.monotonic()
+        self._ev.set()
+
+    def _reject(self, exc: BaseException) -> None:
+        self._exc = exc
+        self.resolved_at = time.monotonic()
+        self._ev.set()
+
+
+class PreparedJob(NamedTuple):
+    """Host-side staged request: everything admission needs, computed
+    once (Residuals build, structure key, padded single-row program
+    inputs, data CRC).  Resubmitting the same PreparedJob in the same
+    batch composition hits the device-args cache — zero host->device
+    bytes on the steady-state path."""
+
+    name: str
+    uid: int
+    model: object
+    resid: Residuals
+    names: tuple
+    skey: tuple
+    n_toa: int
+    n_param: int
+    dof: int
+    staged_p: dict
+    staged_b: object
+    slot_row: np.ndarray
+    pmask_row: np.ndarray
+    rowmask_row: np.ndarray
+    crc: str               #: CRC32 (8 hex) over the staged arrays
+
+
+class _ServeBucket:
+    """One (structure key, pad shape) queue + its compiled program."""
+
+    __slots__ = ("key", "skey", "n_toa", "n_param", "rep", "dkeys",
+                 "include_offset", "pending")
+
+    def __init__(self, key: tuple, job: PreparedJob):
+        self.key = key
+        self.skey = job.skey
+        self.n_toa, self.n_param = key[1], key[2]
+        self.rep = job
+        self.dkeys = tuple(sorted(
+            k for k, v in job.resid.pdict["delta"].items()
+            if np.ndim(v) == 0))
+        self.include_offset = "PhaseOffset" not in job.model.components
+        self.pending: deque = deque()   # (PreparedJob, ServeFuture)
+
+
+class TimingService:
+    """Continuous-batching timing daemon over the fleet bucket programs.
+
+    Two modes share one dispatch path:
+
+    * **inline** — ``submit*`` then :meth:`flush`: deterministic batch
+      composition, the contract-audited request path.
+    * **daemon** — :meth:`start` spawns the dispatcher thread: full
+      buckets dispatch immediately, partial buckets when their oldest
+      job has waited ``max_wait_ms``; :meth:`drain` closes admission,
+      flushes everything and joins the thread.
+
+    ``batch_size`` is the vmap width of every bucket program (part of
+    the compiled shape, so one program per bucket regardless of
+    occupancy — partial batches pad by repeating the last job's rows
+    and only real rows resolve futures).  ``program_cache`` lets a
+    restarted in-process service reuse compiled programs; across OS
+    processes the same role is played by the AOT store
+    (``runtime.acquire_backend(warm_start=True)``).
+
+    Correlated-noise (GLS) models are rejected at :meth:`prepare` —
+    their solves are host-exact by design (see the fleet module
+    docstring); a serving lane for them would be dishonest."""
+
+    def __init__(self, *, batch_size: int = 4, maxiter: int = 8,
+                 tol_chi2: float = 1e-10,
+                 threshold: Optional[float] = None, kernel=None,
+                 track_mode: Optional[str] = None,
+                 policy: Optional[str] = None,
+                 diverge_streak: Optional[int] = None,
+                 stall_iters: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 max_pending: int = 64,
+                 spool: Optional[str] = None,
+                 args_cache_size: int = 8,
+                 program_cache: Optional[dict] = None):
+        from pint_tpu.fitter import FUSED_DIVERGE_STREAK, FUSED_STALL_ITERS
+
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.batch_size = int(batch_size)
+        self.maxiter = int(maxiter)
+        self.tol_chi2 = float(tol_chi2)
+        self.threshold = threshold
+        self.kernel = kernel
+        self.track_mode = track_mode
+        self.policy = policy
+        self.diverge_streak = FUSED_DIVERGE_STREAK \
+            if diverge_streak is None else int(diverge_streak)
+        self.stall_iters = FUSED_STALL_ITERS \
+            if stall_iters is None else int(stall_iters)
+        if max_wait_ms is None:
+            max_wait_ms = float(os.environ.get(
+                "PINT_TPU_SERVE_MAX_WAIT_MS", DEFAULT_MAX_WAIT_MS))
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
+        self.max_pending = int(max_pending)
+        self.spool = spool
+        self.args_cache_size = max(int(args_cache_size), 1)
+
+        self._buckets: "OrderedDict[tuple, _ServeBucket]" = OrderedDict()
+        self._programs: dict = {} if program_cache is None else program_cache
+        self._args_lru: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._cond = threading.Condition()
+        self._n_pending = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._draining = False
+        self._latencies: deque = deque(maxlen=4096)
+        self._stats = self._zero_stats()
+
+    @staticmethod
+    def _zero_stats() -> dict:
+        return {"submitted": 0, "completed": 0, "rejected": 0,
+                "spooled": 0, "dispatches": 0, "full_flushes": 0,
+                "timer_flushes": 0, "drain_flushes": 0,
+                "flush_flushes": 0, "occupancy_jobs": 0}
+
+    def reset_stats(self) -> None:
+        """Zero the counters + latency samples (e.g. after a warmup
+        pass, so a measurement window starts clean)."""
+        with self._cond:
+            self._stats = self._zero_stats()
+            self._latencies.clear()
+
+    # -- admission -------------------------------------------------------------
+
+    def prepare(self, model, toas, name: Optional[str] = None) -> PreparedJob:
+        """Host-side staging: builds the Residuals, derives the
+        structure/shape bucket key and the padded single-row program
+        inputs.  Everything expensive happens here, once — the request
+        path (:meth:`submit_prepared` + :meth:`flush`) is queue ops and
+        the coalesced dispatch."""
+        if model.has_correlated_errors:
+            raise CorrelatedErrors(model)
+        resid = Residuals(toas, model, track_mode=self.track_mode,
+                          policy=self.policy)
+        names = tuple(FleetFitter._fleet_fit_params(model, resid))
+        if not names:
+            raise ValueError("model has no fleet-fittable free "
+                             "parameters; nothing to serve")
+        if name is None:
+            name = getattr(getattr(model, "PSR", None), "value",
+                           None) or f"JOB{next(_UID):06d}"
+        pu = _Pulsar(str(name), 0, model, toas, resid, names,
+                     resid.dof, False)
+        skey = FleetFitter._structure_key(pu)
+        n_toa = _pow2_at_least(resid.batch.ntoas, _MIN_TOA)
+        n_param = _pow2_at_least(len(names), _MIN_PARAM)
+        dkeys = tuple(sorted(k for k, v in resid.pdict["delta"].items()
+                             if np.ndim(v) == 0))
+        kidx = {k: j for j, k in enumerate(dkeys)}
+        staged_p = _pad_pdict(resid, n_toa)
+        staged_b = pad_batch_to(resid.batch, n_toa)
+        slot_row = np.zeros(n_param, np.int32)
+        pmask_row = np.zeros(n_param, np.float64)
+        rowmask_row = np.zeros(n_toa, np.float64)
+        for i, n in enumerate(names):
+            slot_row[i] = kidx[n]
+            pmask_row[i] = 1.0
+        rowmask_row[:resid.batch.ntoas] = 1.0
+        crc = aot.data_crc(
+            jax.tree_util.tree_map(
+                lambda v: np.asarray(v, np.float64), staged_p),
+            staged_b, slot_row, pmask_row, rowmask_row)
+        return PreparedJob(str(name), next(_UID), model, resid, names,
+                           skey, n_toa, n_param, resid.dof, staged_p,
+                           staged_b, slot_row, pmask_row, rowmask_row,
+                           crc)
+
+    def _has_capacity(self) -> bool:
+        return self._n_pending < self.max_pending
+
+    def _bucket_for(self, job: PreparedJob) -> _ServeBucket:
+        key = (job.skey, job.n_toa, job.n_param)
+        b = self._buckets.get(key)
+        if b is None:
+            b = _ServeBucket(key, job)
+            self._buckets[key] = b
+        return b
+
+    def submit_prepared(self, job: PreparedJob) -> ServeFuture:
+        """Admit a prepared job into its bucket's queue (bounded:
+        overflow raises :class:`ServeSaturated`, the backpressure path
+        driven by the ``request_flood`` failpoint)."""
+        admit = faultinject.wrap("request_flood", self._has_capacity)
+        with self._cond:
+            if self._draining or self._stop:
+                raise ServeDrained("service is draining; admission "
+                                   "closed", spool=self.spool)
+            if not admit():
+                profiling.count("serve.rejected")
+                self._stats["rejected"] += 1
+                raise ServeSaturated(
+                    f"request queue is full "
+                    f"({self._n_pending}/{self.max_pending} pending); "
+                    f"retry after in-flight batches drain")
+            fut = ServeFuture(job.name)
+            self._bucket_for(job).pending.append((job, fut))
+            self._n_pending += 1
+            self._stats["submitted"] += 1
+            profiling.count("serve.submit")
+            self._cond.notify_all()
+        return fut
+
+    def submit(self, model, toas, name: Optional[str] = None) -> ServeFuture:
+        return self.submit_prepared(self.prepare(model, toas, name=name))
+
+    # -- programs + staged device inputs ---------------------------------------
+
+    def _bucket_program(self, bucket: _ServeBucket):
+        prog = self._programs.get(bucket.key)
+        if prog is None:
+            kern = self.kernel if self.kernel is not None else \
+                _default_wls_kernel()
+            profiling.count("serve.program_build")
+            prog = _build_bucket_fit(
+                bucket.rep.model, bucket.rep.resid.track_mode,
+                bucket.dkeys, bucket.n_param, bucket.include_offset,
+                self.maxiter, self.tol_chi2, kern, self.threshold,
+                self.diverge_streak, self.stall_iters)
+            # the pad shape is a pure function of the job (pow2
+            # quantization, not fleet's max-member padding), so this
+            # fingerprint — and the call avals — are reproducible across
+            # daemon restarts: a warm process resolves every program
+            # from the store with zero compiles (CONTRACT003)
+            prog = aot.serve(
+                "serve_bucket", prog,
+                f"{bucket.skey!r}"
+                f"|ntoa={bucket.n_toa}|nparam={bucket.n_param}"
+                f"|bs={self.batch_size}"
+                f"|maxiter={self.maxiter}|tol={self.tol_chi2:g}"
+                f"|thr={self.threshold}"
+                f"|kern={getattr(kern, '__name__', str(kern))}"
+                f"|streak={self.diverge_streak}"
+                f"|stall={self.stall_iters}")
+            self._programs[bucket.key] = prog
+        return prog
+
+    def _batch_args(self, bucket: _ServeBucket, jobs: List[PreparedJob]):
+        akey = (bucket.key, tuple(j.uid for j in jobs))
+        args = self._args_lru.get(akey)
+        if args is not None:
+            self._args_lru.move_to_end(akey)
+            profiling.count("serve.args_reuse")
+            return args
+        stacked_p = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x, np.float64)
+                                  for x in xs]),
+            *[j.staged_p for j in jobs])
+        stacked_b = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *[j.staged_b for j in jobs])
+        args = jax.device_put((
+            stacked_p, stacked_b,
+            jnp.asarray(np.stack([j.slot_row for j in jobs])),
+            jnp.asarray(np.stack([j.pmask_row for j in jobs])),
+            jnp.asarray(np.stack([j.rowmask_row for j in jobs]))))
+        self._args_lru[akey] = args
+        # donation between dispatches: jit donate_argnums would
+        # invalidate these cached inputs (and is a no-op on CPU), so
+        # residency is bounded here instead — evicting the LRU tail
+        # releases its device buffers back to the allocator before the
+        # next dispatch stages new ones
+        while len(self._args_lru) > self.args_cache_size:
+            self._args_lru.popitem(last=False)
+            profiling.count("serve.args_donate")
+        return args
+
+    # -- dispatch --------------------------------------------------------------
+
+    def _dispatch(self, bucket: _ServeBucket, pairs, reason: str) -> None:
+        jobs = [j for j, _ in pairs]
+        padded = jobs + [jobs[-1]] * (self.batch_size - len(jobs))
+        prog = self._bucket_program(bucket)
+        args = self._batch_args(bucket, padded)
+        profiling.count("serve.dispatch")
+        out = np.asarray(prog(*args))   # 1 dispatch + 1 result fetch
+        P = bucket.n_param
+        for row, (job, fut) in enumerate(pairs):
+            st = int(out[row, P + _COL_STATUS])
+            fut._resolve(ServeResult(
+                job.name, float(out[row, P + _COL_CHI2]), job.dof,  # ddlint: disable=TRACE002 `out` is the host array fetched once above — no per-row device sync
+                FitStatus(st) if 0 <= st <= 3 else FitStatus.NONFINITE,
+                int(out[row, P + _COL_ITERS]),
+                out[row, :len(job.names)].copy(), job.names))
+        with self._cond:
+            self._stats["dispatches"] += 1
+            self._stats[f"{reason}_flushes"] += 1
+            self._stats["completed"] += len(pairs)
+            self._stats["occupancy_jobs"] += len(pairs)
+            for _, fut in pairs:
+                self._latencies.append(fut.resolved_at - fut.submitted_at)
+        profiling.count("serve.jobs_done", len(pairs))
+
+    def _take_batch_locked(self, bucket: _ServeBucket) -> list:
+        pairs = []
+        while bucket.pending and len(pairs) < self.batch_size:
+            pairs.append(bucket.pending.popleft())
+        self._n_pending -= len(pairs)
+        return pairs
+
+    def _next_batch_locked(self):
+        for bucket in self._buckets.values():
+            if bucket.pending:
+                return bucket, self._take_batch_locked(bucket)
+        return None
+
+    # warmup budget: one XLA program per bucket plus the one-time tiny
+    # staging executables (stack/device_put) — same shape economics as
+    # fleet_fit; steady state on the audit fixture is 1 coalesced batch
+    # = 1 dispatch + 1 result fetch, compiles == retraces == 0
+    @dispatch_contract("serve_request", max_compiles=24,
+                       max_dispatches=4, max_transfers=8,
+                       warm_from_store=True)
+    def flush(self, reason: str = "flush") -> int:
+        """Dispatch every pending batch now (the inline request path and
+        the drain path); returns the number of jobs resolved.
+
+        Dispatch contract ``serve_request``: the first flush of a bucket
+        compiles its one program (or resolves it from the AOT store —
+        zero compiles in a warm process, CONTRACT003); a steady-state
+        flush is 1 dispatch + 1 result fetch per coalesced batch, zero
+        compiles, zero retraces (CONTRACT001/002) — per-request
+        recompilation is structurally impossible.
+
+        SIGTERM/SIGINT mid-flush rides the PR 4 machinery
+        (:class:`pint_tpu.runtime.SignalFlush` + the
+        ``sigterm_midscan`` failpoint): the in-flight batch finishes and
+        its futures resolve; when a ``spool`` path is configured, every
+        still-queued job is flushed there via
+        :func:`pint_tpu.runtime.write_checkpoint`, its future rejects
+        with :class:`ServeDrained`, and ``ServeDrained`` is raised —
+        :meth:`resume_spool` readmits the spool bit-identically."""
+        after_batch = faultinject.wrap("sigterm_midscan", lambda ci: None)
+        done = 0
+        bi = 0
+        with runtime.SignalFlush() as sigs:
+            while True:
+                with self._cond:
+                    nxt = self._next_batch_locked()
+                if nxt is None:
+                    break
+                bucket, pairs = nxt
+                self._dispatch(bucket, pairs, reason)
+                done += len(pairs)
+                after_batch(bi)
+                bi += 1
+                if sigs.fired is not None and self.spool is not None:
+                    self._spool_pending(sigs.fired)
+        return done
+
+    # -- drain / spool / resume ------------------------------------------------
+
+    def _spool_pending(self, signum: int) -> None:
+        """Flush every queued (not-yet-dispatched) job to the spool and
+        raise ``ServeDrained`` — the SIGTERM half of graceful drain."""
+        with self._cond:
+            self._draining = True
+            pairs = []
+            for bucket in self._buckets.values():
+                while bucket.pending:
+                    pairs.append(bucket.pending.popleft())
+            self._n_pending = 0
+            self._stats["spooled"] += len(pairs)
+        payload = {
+            "signature": np.frombuffer(_SPOOL_SIG.encode(), np.uint8),
+            "count": np.asarray(len(pairs), np.int64)}
+        for i, (job, _) in enumerate(pairs):
+            payload[f"job{i}_name"] = np.frombuffer(job.name.encode(),
+                                                    np.uint8)
+            payload[f"job{i}_crc"] = np.frombuffer(job.crc.encode(),
+                                                   np.uint8)
+            payload[f"job{i}_params"] = np.frombuffer(
+                ",".join(job.names).encode(), np.uint8)
+            payload[f"job{i}_ntoa"] = np.asarray(  # ddlint: disable=TRACE002 ntoas is host metadata (a Python int), not a device value
+                job.resid.batch.ntoas, np.int64)
+        runtime.write_checkpoint(self.spool, payload)
+        profiling.count("serve.spool_write")
+        _log.info("serve drained on signal %s: %d job(s) spooled to %s",
+                  signum, len(pairs), self.spool)
+        err = ServeDrained(
+            f"serve drained on signal {signum}: {len(pairs)} queued "
+            f"job(s) spooled to {self.spool!r}", spool=self.spool,
+            n_spooled=len(pairs), signum=signum)
+        for _, fut in pairs:
+            fut._reject(err)
+        raise err
+
+    def resume_spool(self, jobs) -> List[ServeFuture]:
+        """Readmit the jobs a drained service spooled.  The spool stores
+        identity + a CRC32 of each job's staged arrays, not the (model,
+        TOAs) objects, so the caller supplies re-:meth:`prepare`-d jobs
+        covering the spooled names; each is verified BIT-identical to
+        what was queued (same staged params/batch/mask bytes) before
+        admission — a mismatch raises ``ValueError``, never a silently
+        different fit."""
+        if self.spool is None:
+            raise ValueError("this service has no spool path configured")
+        data = runtime.load_checkpoint(self.spool)   # CRC-verified
+        sig = bytes(np.asarray(data["signature"], np.uint8)).decode(
+            errors="replace")
+        if sig != _SPOOL_SIG:
+            raise ValueError(f"{self.spool!r} is not a serve spool "
+                             f"(signature {sig!r})")
+        by_name: Dict[str, PreparedJob] = {}
+        for j in jobs:
+            by_name.setdefault(j.name, j)
+        futs = []
+        for i in range(int(data["count"])):
+            name = bytes(np.asarray(data[f"job{i}_name"],
+                                    np.uint8)).decode()
+            crc = bytes(np.asarray(data[f"job{i}_crc"],
+                                   np.uint8)).decode()
+            job = by_name.get(name)
+            if job is None:
+                raise ValueError(
+                    f"spool {self.spool!r} names job {name!r} but no "
+                    f"matching prepared job was supplied")
+            if job.crc != crc:
+                raise ValueError(
+                    f"resubmitted job {name!r} does not match the "
+                    f"spooled data (crc {job.crc} != spooled {crc}); "
+                    f"refusing to resume a different fit")
+            futs.append(self.submit_prepared(job))
+        profiling.count("serve.spool_resume", len(futs))
+        return futs
+
+    # -- daemon mode -----------------------------------------------------------
+
+    def start(self) -> "TimingService":
+        """Start the dispatcher thread (daemon mode): full buckets
+        dispatch immediately; partial buckets when their oldest job has
+        waited ``max_wait_ms``."""
+        with self._cond:
+            if self._thread is None:
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._loop, name="pint-tpu-serve", daemon=True)
+                self._thread.start()
+        return self
+
+    def _ready_batch_locked(self):
+        """Next (bucket, pairs, reason) under the continuous-batching
+        policy, or None.  The bucket-full check routes through the
+        ``stalled_bucket`` failpoint: with it active only the
+        max-latency timer (or drain) can flush, which is how the timer
+        path is proven rather than assumed."""
+        full = faultinject.wrap(
+            "stalled_bucket",
+            lambda b: len(b.pending) >= self.batch_size)
+        now = time.monotonic()
+        for bucket in self._buckets.values():
+            if not bucket.pending:
+                continue
+            if self._stop or self._draining:
+                return bucket, self._take_batch_locked(bucket), "drain"
+            if full(bucket):
+                return bucket, self._take_batch_locked(bucket), "full"
+            if now - bucket.pending[0][1].submitted_at >= self.max_wait_s:
+                profiling.count("serve.timer_fire")
+                return bucket, self._take_batch_locked(bucket), "timer"
+        return None
+
+    def _wait_s_locked(self) -> Optional[float]:
+        if self._n_pending == 0:
+            return None
+        deadline = min(b.pending[0][1].submitted_at + self.max_wait_s
+                       for b in self._buckets.values() if b.pending)
+        return max(deadline - time.monotonic(), 0.0) + 1e-3
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    if self._stop and self._n_pending == 0:
+                        return
+                    got = self._ready_batch_locked()
+                    if got is not None:
+                        break
+                    self._cond.wait(self._wait_s_locked())
+                bucket, pairs, reason = got
+            try:
+                self._dispatch(bucket, pairs, reason)
+            except Exception as e:   # futures must always resolve
+                for _, fut in pairs:
+                    fut._reject(e)
+
+    def drain(self, timeout: Optional[float] = 600.0) -> dict:
+        """Graceful shutdown: admission closes, every pending job
+        dispatches (partial buckets included — the drain path), the
+        dispatcher thread exits.  Inline-mode services just flush.
+        Returns :meth:`stats`."""
+        with self._cond:
+            self._draining = True
+            self._stop = True
+            thread = self._thread
+            self._cond.notify_all()
+        if thread is not None:
+            thread.join(timeout)
+            with self._cond:
+                self._thread = None
+        else:
+            self.flush(reason="drain")
+        return self.stats()
+
+    # -- observability ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Thread-safe snapshot: counters, latency percentiles and the
+        derived occupancy/timer fractions (the ``bench_serve``
+        fields)."""
+        with self._cond:
+            s = dict(self._stats)
+            lat = list(self._latencies)
+            s["pending"] = self._n_pending
+            s["n_buckets"] = len(self._buckets)
+            s["n_programs"] = len(self._programs)
+        s.update(profiling.latency_stats(lat))
+        d = s["dispatches"]
+        s["batch_occupancy"] = \
+            (s["occupancy_jobs"] / (d * self.batch_size)) if d else 0.0
+        s["timer_flush_fraction"] = (s["timer_flushes"] / d) if d else 0.0
+        return s
+
+
+# --- demo service + CLI -------------------------------------------------------
+
+def _demo_service(*, batch_size: int = 2, maxiter: int = 3,
+                  max_wait_ms: Optional[float] = None,
+                  spool: Optional[str] = None,
+                  program_cache: Optional[dict] = None):
+    """Deterministic 4-pulsar / 2-bucket service + prepared jobs, shared
+    by the AOT warm fixture (``--fixtures serve``), the serve CLI
+    self-check, and the bench leg.  Mirrors ``aot._fleet4_fixture``'s
+    pulsars (sizes 8/8/16/16, heterogeneous FD-block freezing) under
+    distinct PSR names so its ``serve_bucket`` ProgramKeys are its
+    own."""
+    import warnings as _w
+
+    from pint_tpu.aot import _B1855_PAR
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    svc = TimingService(batch_size=batch_size, maxiter=maxiter,
+                        max_wait_ms=max_wait_ms, spool=spool,
+                        program_cache=program_cache)
+    jobs = []
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        for i, n in enumerate((8, 8, 16, 16)):
+            par = _B1855_PAR.replace("B1855+09SIM", f"SERVE{i}")
+            model = get_model(par.strip().splitlines())
+            model.A1.frozen = True
+            model.TASC.frozen = True
+            if i % 2:   # heterogeneous slots: half freeze the FD block
+                model.FD1.frozen = True
+                model.FD2.frozen = True
+            toas = make_fake_toas_uniform(
+                55000.0, 55060.0, n, model, obs="gbt", error_us=300.0,
+                freq_mhz=np.tile([1400.0, 800.0], (n + 1) // 2)[:n],
+                add_noise=True, seed=200 + i)
+            jobs.append(svc.prepare(model, toas, name=f"SERVE{i}"))
+    return svc, jobs
+
+
+def main(argv=None) -> int:
+    """``python -m pint_tpu.serve check``: drive the demo service
+    through the daemon path and print one JSON line of stats — the
+    subprocess surface the tooling tests exercise under the
+    ``request_flood`` / ``stalled_bucket`` failpoints."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.serve",
+        description="continuous-batching timing daemon")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    chk = sub.add_parser(
+        "check", help="daemon self-exercise -> one JSON line of stats")
+    chk.add_argument("--jobs", type=int, default=12)
+    chk.add_argument("--wait-ms", type=float, default=40.0)
+    chk.add_argument("--batch-size", type=int, default=2)
+    chk.add_argument("--stagger-ms", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    st = runtime.acquire_backend()
+    svc, jobs = _demo_service(batch_size=args.batch_size, maxiter=3,
+                              max_wait_ms=args.wait_ms)
+    # warm the bucket programs inline so the daemon-phase stats measure
+    # the serving policy, not first-call compiles; under request_flood
+    # the warmup is rejected too — then nothing dispatches and no
+    # program is needed
+    warmed = True
+    try:
+        wf = [svc.submit_prepared(j) for j in jobs]
+        svc.flush()
+        for f in wf:
+            f.result(timeout=600.0)
+    except ServeSaturated:
+        warmed = False
+    svc.reset_stats()
+
+    svc.start()
+    t0 = time.monotonic()
+    futs = []
+    rejected = 0
+    for i in range(args.jobs):
+        try:
+            futs.append(svc.submit_prepared(jobs[i % len(jobs)]))
+        except ServeSaturated:
+            rejected += 1
+        time.sleep(args.stagger_ms / 1e3)
+    # let partial buckets hit their max-latency deadline (the timer
+    # path) before drain would flush them
+    time.sleep(3.0 * svc.max_wait_s)
+    s = svc.drain(timeout=600.0)
+    statuses: Dict[str, int] = {}
+    ok = 0
+    for f in futs:
+        r = f.result(timeout=600.0)
+        statuses[r.status.name] = statuses.get(r.status.name, 0) + 1
+        ok += bool(r.ok)
+    wall = time.monotonic() - t0
+    line = {"mode": "check", "backend": st.rung, "warmed": warmed,
+            "jobs": args.jobs, "completed": len(futs),
+            "rejected": rejected, "converged_or_maxiter": ok,
+            "statuses": statuses, "wall_s": round(wall, 3),
+            "fits_per_sec": round(len(futs) / wall, 3) if wall > 0
+            else 0.0}
+    for k in ("dispatches", "full_flushes", "timer_flushes",
+              "drain_flushes", "batch_occupancy",
+              "timer_flush_fraction", "p50_ms", "p99_ms"):
+        v = s[k]
+        line[k] = round(v, 3) if isinstance(v, float) else v
+    print(json.dumps(line))
+    return 0 if len(futs) + rejected == args.jobs else 1
+
+
+if __name__ == "__main__":   # pragma: no cover
+    # delegate to the canonical module instance so failpoints/counters
+    # registered at import time are shared (the aot CLI idiom)
+    import sys as _sys
+
+    from pint_tpu.serve import main as _main
+
+    _sys.exit(_main())

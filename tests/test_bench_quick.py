@@ -103,6 +103,21 @@ def _assert_schema(d, fast=False):
     assert isinstance(comm, dict) and "error" not in comm, comm
     assert comm["n_devices"] >= 8
     assert comm["device_peak_bytes"] > 0
+    # serve axis (ISSUE 11): open-loop Poisson p50/p99 + sustained
+    # throughput of the continuous-batching timing daemon
+    for key in ("serve_p50_ms", "serve_p99_ms", "serve_fits_per_sec",
+                "serve_batch_occupancy"):
+        assert isinstance(d.get(key), (int, float)), (key, d.get(key))
+    assert d["serve_p50_ms"] > 0 and d["serve_p99_ms"] >= d["serve_p50_ms"]
+    assert d["serve_fits_per_sec"] > 0
+    assert 0 < d["serve_batch_occupancy"] <= 1.0
+    sv = d["submetrics"].get("serve")
+    assert isinstance(sv, dict) and "error" not in sv, sv
+    assert sv["completed"] == sv["n_requests"] - sv["rejected"]
+    assert sv["completed"] > 0 and sv["dispatches"] > 0
+    assert isinstance(sv["timer_flush_fraction"], (int, float))
+    assert d["serve_p50_ms"] == sv["p50_ms"]
+    assert d["serve_fits_per_sec"] == sv["fits_per_sec"]
 
 
 def test_quick_steady_state_never_recompiles(quick_line):

@@ -78,8 +78,8 @@ class TestCleanLeg:
         assert {"residuals", "split_assembly", "wls_step", "gls_step",
                 "wideband_step", "fused_fit", "grid_chunk",
                 "sharded_chunk", "checkpointed_chunk",
-                "mcmc_step", "fleet_fit", "multihost_chunk"} <= \
-            set(REGISTRY)
+                "mcmc_step", "fleet_fit", "multihost_chunk",
+                "serve_request"} <= set(REGISTRY)
 
     def test_every_contract_has_a_driver(self):
         contracts._ensure_registered()
@@ -123,6 +123,12 @@ class TestCleanLeg:
         # a steady-state fleet fit really is one dispatch per chunk
         # (the audit fixture is 2 buckets x 1 chunk each)
         assert reports["fleet_fit"].steady.dispatches == 2
+        # the daemon's coalesced request path really is ONE dispatch +
+        # ONE fetch per batch, with ZERO h2d (args donated between
+        # dispatches, reused on identical batch composition) — per-
+        # request recompilation is structurally impossible
+        assert reports["serve_request"].steady.dispatches == 1
+        assert reports["serve_request"].steady.transfers_h2d == 0
 
 
 class TestSeededRegressions:

@@ -1,0 +1,227 @@
+"""The continuous-batching timing daemon (ISSUE 11,
+``pint_tpu.serve``): admission -> structure/shape bucket routing ->
+coalesced dispatch through the bucket's compiled padded program, with
+the max-latency timer for partial buckets, bounded-queue backpressure,
+and the SIGTERM drain -> spool -> bit-identical resume path.
+
+Tier-1 keeps these legs CHEAP: every test shares one module-level
+program cache and routes only the two 8-TOA jobs, so the whole module
+compiles a single tiny bucket program.  The subprocess daemon/CLI and
+two-process warm-start depth legs ride the slow ``test_tooling.py``
+(marker ``serve`` selects both; ``PINT_TPU_SKIP_SERVE=1`` opts out).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu import faultinject
+from pint_tpu.exceptions import ServeDrained, ServeSaturated
+from pint_tpu.fitter import FitStatus
+from pint_tpu.serve import TimingService, _demo_service
+
+#: one compiled program for the whole module: every service below
+#: shares this cache, and every leg routes only the 8-TOA bucket
+_PROGRAMS: dict = {}
+
+
+@pytest.fixture(scope="module")
+def demo():
+    """(service, jobs): the demo pulsars prepared once; the service has
+    the 8-TOA bucket program already built (inline warm flush)."""
+    svc, jobs = _demo_service(batch_size=2, maxiter=3,
+                              program_cache=_PROGRAMS)
+    jobs = jobs[:2]   # SERVE0/SERVE1: one structure/shape bucket
+    futs = [svc.submit_prepared(j) for j in jobs]
+    svc.flush()
+    ctrl = {}
+    for f in futs:
+        r = f.result(timeout=600.0)
+        assert r.status in (FitStatus.CONVERGED, FitStatus.MAXITER)
+        ctrl[r.name] = r
+    svc.reset_stats()
+    return svc, jobs, ctrl
+
+
+def _fresh(**kw):
+    """A fresh service compatible with the shared program cache: the
+    bucket program fingerprint covers batch_size/maxiter, so every
+    service in this module must use the same values."""
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("maxiter", 3)
+    kw.setdefault("program_cache", _PROGRAMS)
+    return TimingService(**kw)
+
+
+class TestInlinePath:
+    def test_results_and_resubmit_bit_identical(self, demo):
+        svc, jobs, ctrl = demo
+        futs = [svc.submit_prepared(j) for j in jobs]
+        svc.flush()
+        for f in futs:
+            r = f.result(timeout=600.0)
+            c = ctrl[r.name]
+            # the steady-state path replays the SAME compiled program
+            # on the SAME staged buffers: bit-identical, not approx
+            assert float(r.chi2) == float(c.chi2)
+            np.testing.assert_array_equal(r.x, c.x)
+            assert r.fit_names == c.fit_names
+            assert r.dof == c.dof and r.ok
+        st = svc.stats()
+        assert st["dispatches"] >= 1
+        assert st["batch_occupancy"] == 1.0   # full coalesced batch
+        assert st["n_programs"] == 1          # the module's one program
+
+    def test_steady_state_contract_counters(self, demo):
+        """CONTRACT001/002 at test granularity: the coalesced request
+        path makes 0 compiles, 0 retraces, exactly 1 dispatch and 0
+        h2d transfers (args-LRU hit) per steady batch."""
+        from pint_tpu.lint.contracts import steady_state_counters
+
+        svc, jobs, _ = demo
+
+        def call():
+            futs = [svc.submit_prepared(j) for j in jobs]
+            svc.flush()
+            return [f.result(timeout=600.0).chi2 for f in futs]
+
+        _, steady = steady_state_counters(call, warmup=1)
+        assert steady.compiles == 0, steady
+        assert steady.retraces == (), steady.retraces
+        assert steady.dispatches == 1, steady
+        assert steady.transfers_h2d == 0, steady   # donated-args reuse
+
+    def test_drained_service_closes_admission(self, demo):
+        _, jobs, _ = demo
+        svc = _fresh()
+        svc.drain(timeout=60.0)
+        with pytest.raises(ServeDrained):
+            svc.submit_prepared(jobs[0])
+
+
+class TestDaemonTimers:
+    def test_partial_bucket_dispatches_on_timer(self, demo):
+        """ISSUE 11 acceptance: a partially-filled bucket provably
+        dispatches within the max-latency deadline — one job, batch
+        capacity two, nothing else ever arrives."""
+        _, jobs, ctrl = demo
+        svc = _fresh(max_wait_ms=30.0)
+        svc.start()
+        fut = svc.submit_prepared(jobs[0])
+        r = fut.result(timeout=5.0)   # << would hang forever un-timed
+        assert float(r.chi2) == float(ctrl[r.name].chi2)
+        st = svc.drain(timeout=60.0)
+        assert st["timer_flushes"] >= 1, st
+        assert st["full_flushes"] == 0, st
+        assert st["batch_occupancy"] == pytest.approx(0.5)
+
+    def test_full_bucket_dispatches_without_waiting(self, demo):
+        _, jobs, ctrl = demo
+        svc = _fresh(max_wait_ms=10_000.0)   # timer can never fire
+        svc.start()
+        futs = [svc.submit_prepared(j) for j in jobs]
+        for f in futs:
+            r = f.result(timeout=60.0)
+            assert float(r.chi2) == float(ctrl[r.name].chi2)
+        st = svc.drain(timeout=60.0)
+        assert st["full_flushes"] >= 1, st
+        assert st["timer_flushes"] == 0, st
+
+    def test_stalled_bucket_failpoint_forces_timer_path(self, demo):
+        """The ``stalled_bucket`` failpoint suppresses the bucket-full
+        predicate, so ONLY the timer can dispatch — the flush path the
+        subprocess legs drive via PINT_TPU_FAULTS."""
+        _, jobs, ctrl = demo
+        with faultinject.stalled_bucket():
+            svc = _fresh(max_wait_ms=30.0)
+            svc.start()
+            futs = [svc.submit_prepared(j) for j in jobs]
+            for f in futs:
+                r = f.result(timeout=5.0)
+                assert float(r.chi2) == float(ctrl[r.name].chi2)
+            st = svc.drain(timeout=60.0)
+        assert st["timer_flushes"] >= 1, st
+        assert st["full_flushes"] == 0, st
+
+
+class TestBackpressure:
+    def test_bounded_queue_saturates(self, demo):
+        _, jobs, ctrl = demo
+        svc = _fresh(max_pending=1)
+        svc.submit_prepared(jobs[0])
+        with pytest.raises(ServeSaturated):
+            svc.submit_prepared(jobs[1])
+        assert svc.stats()["rejected"] == 1
+        svc.flush()   # dispatching frees capacity again
+        fut = svc.submit_prepared(jobs[1])
+        svc.flush()
+        r = fut.result(timeout=600.0)
+        assert float(r.chi2) == float(ctrl[r.name].chi2)
+
+    def test_request_flood_failpoint_rejects_all(self, demo):
+        _, jobs, _ = demo
+        with faultinject.request_flood():
+            svc = _fresh()
+            for j in jobs:
+                with pytest.raises(ServeSaturated):
+                    svc.submit_prepared(j)
+        st = svc.stats()
+        assert st["rejected"] == len(jobs)
+        assert st["submitted"] == 0 and st["dispatches"] == 0
+
+
+class TestGracefulDrain:
+    """SIGTERM with a partially-worked queue: in-flight futures
+    resolve (bit-identical to an uninterrupted run), queued jobs flush
+    to a CRC-verified spool, and a restarted daemon resumes the spool
+    bit-identically (the PR 4 record-don't-kill signal window)."""
+
+    def test_sigterm_spools_queue_and_resume_is_bit_identical(
+            self, demo, tmp_path):
+        _, jobs, ctrl = demo
+        spool = str(tmp_path / "serve_spool.npz")
+        svc = _fresh(spool=spool)
+        # two coalesced batches queued; SIGTERM lands after batch 0
+        futs = [svc.submit_prepared(j) for j in jobs + jobs]
+        with faultinject.sigterm_midscan(after_chunk=0):
+            with pytest.raises(ServeDrained) as ei:
+                svc.flush()
+        assert ei.value.signum == 15
+        assert ei.value.n_spooled == 2
+        assert ei.value.spool == spool
+        # batch 0's futures RESOLVED, bit-identical to the control run
+        for f in futs[:2]:
+            r = f.result(timeout=1.0)
+            assert float(r.chi2) == float(ctrl[r.name].chi2)
+        # batch 1's futures rejected with the drain (job is spooled)
+        for f in futs[2:]:
+            assert isinstance(f.exception(timeout=1.0), ServeDrained)
+        # "restarted daemon": fresh service, same spool path — resumes
+        # and produces the SAME numbers
+        svc2 = _fresh(spool=spool)
+        futs2 = svc2.resume_spool(jobs)
+        assert len(futs2) == 2
+        svc2.flush()
+        for f in futs2:
+            r = f.result(timeout=600.0)
+            assert float(r.chi2) == float(ctrl[r.name].chi2)
+        assert svc2.stats()["completed"] == 2
+
+    def test_resume_rejects_crc_mismatch_and_missing_jobs(
+            self, demo, tmp_path):
+        _, jobs, _ = demo
+        spool = str(tmp_path / "serve_spool.npz")
+        svc = _fresh(spool=spool)
+        for j in jobs + jobs:   # two batches; batch 1 spools
+            svc.submit_prepared(j)
+        with faultinject.sigterm_midscan(after_chunk=0):
+            with pytest.raises(ServeDrained) as ei:
+                svc.flush()
+        assert ei.value.n_spooled == 2
+        # a resubmitted job whose staged data differs from what was
+        # spooled must be refused, not silently re-fit
+        bad = [jobs[0]._replace(crc="deadbeef"), jobs[1]]
+        with pytest.raises(ValueError, match="does not match"):
+            _fresh(spool=spool).resume_spool(bad)
+        # a spooled job the caller did not resubmit is a hard error
+        with pytest.raises(ValueError, match="no matching prepared"):
+            _fresh(spool=spool).resume_spool([jobs[0]])

@@ -532,6 +532,110 @@ class TestAotColdStart:
         assert all(m["reason"] == "absent" for m in doc["misses"])
 
 
+class TestServeDaemon:
+    """The timing daemon's CLI subprocess legs (ISSUE 11): a clean
+    ``python -m pint_tpu.serve check`` run, then the two failpoints
+    activated ACROSS the process boundary with ``PINT_TPU_FAULTS`` —
+    ``request_flood`` drives the backpressure path (every admission
+    rejected, nothing dispatched), ``stalled_bucket`` suppresses the
+    bucket-full predicate so ONLY the max-latency timer can dispatch.
+    Marker ``serve``; opt out with ``PINT_TPU_SKIP_SERVE=1``."""
+
+    @staticmethod
+    def _run(args=(), env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.serve", "check", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_daemon_check_completes_all_requests(self):
+        import json
+
+        p = self._run(["--jobs", "8", "--wait-ms", "40"])
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["completed"] == 8 and doc["rejected"] == 0
+        assert doc["converged_or_maxiter"] == 8
+        assert doc["dispatches"] >= 1
+        assert doc["fits_per_sec"] > 0
+        assert doc["p50_ms"] > 0 and doc["p99_ms"] >= doc["p50_ms"]
+        assert 0 < doc["batch_occupancy"] <= 1.0
+
+    def test_request_flood_rejects_everything(self):
+        import json
+
+        p = self._run(["--jobs", "6"],
+                      {"PINT_TPU_FAULTS": "request_flood"})
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        # every admission refused: backpressure surfaced per-request
+        # (ServeSaturated), nothing silently dropped or dispatched
+        assert doc["rejected"] == 6 and doc["completed"] == 0
+        assert doc["dispatches"] == 0
+        assert doc["p50_ms"] is None   # no fake latency numbers
+
+    def test_stalled_bucket_forces_timer_flushes(self):
+        import json
+
+        p = self._run(["--jobs", "6", "--wait-ms", "30"],
+                      {"PINT_TPU_FAULTS": "stalled_bucket"})
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["completed"] == 6
+        # full-bucket dispatch suppressed: the timer did ALL the work
+        assert doc["timer_flushes"] >= 1, doc
+        assert doc["full_flushes"] == 0, doc
+        assert doc["timer_flush_fraction"] == 1.0, doc
+
+
+class TestServeColdStart:
+    """The two-process warm-start proof for the daemon (ISSUE 11 /
+    CONTRACT003): process A prebuilds the serve bucket programs
+    (``python -m pint_tpu.aot warm --fixtures serve``); process B
+    re-derives the same ProgramKeys (serve pad shapes are a pure
+    function of each job, not of fleet composition) and must fit with
+    ZERO compiles and ZERO store misses."""
+
+    @staticmethod
+    def _run(args, env_extra):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra)
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.aot", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_restarted_server_compiles_nothing(self, tmp_path):
+        import json
+
+        env = {"PINT_TPU_AOT_STORE": str(tmp_path / "store"),
+               "PINT_TPU_XLA_CACHE": str(tmp_path / "cc")}
+        pa = self._run(["warm", "--fixtures", "serve"], env)
+        assert pa.returncode == 0, pa.stderr[-800:]
+        doc_a = json.loads(pa.stdout.splitlines()[-1])
+        assert doc_a["counters"]["writes"] > 0
+        assert doc_a["results"]["serve"]["n_ok"] == 4
+        assert doc_a["results"]["serve"]["n_buckets"] == 2
+        pb = self._run(["check", "--fixtures", "serve"], env)
+        assert pb.returncode == 0, pb.stdout + pb.stderr[-800:]
+        doc_b = json.loads(pb.stdout.splitlines()[-1])
+        assert doc_b["compiles"] == 0, doc_b
+        assert doc_b["retraces"] == 0, doc_b
+        assert doc_b["misses"] == [], doc_b["misses"]
+        assert doc_b["aot_hits"] >= 2          # both bucket programs
+        # the restarted server produced the SAME physics
+        assert doc_b["results"]["serve"]["chi2"] == \
+            doc_a["results"]["serve"]["chi2"]
+
+
 class TestTupleChisq:
     def test_matches_grid(self):
         """tuple_chisq over an arbitrary point list equals grid_chisq_flat
