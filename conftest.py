@@ -218,6 +218,13 @@ def pytest_configure(config):
         "(tests/test_serve.py rides tier-1; the daemon/warm-start "
         "subprocess depth legs ride the slow test_tooling.py; run all "
         "with -m serve, skip WIP branches with PINT_TPU_SKIP_SERVE=1)")
+    config.addinivalue_line(
+        "markers",
+        "telemetry: the span-tracing / flight-recorder gate "
+        "(tests/test_telemetry.py rides tier-1; the crash/summarize "
+        "subprocess depth legs ride the slow test_tooling.py; run all "
+        "with -m telemetry, skip WIP branches with "
+        "PINT_TPU_SKIP_TELEMETRY=1)")
 
 
 # --- tier-1 wall budget ------------------------------------------------------
@@ -279,6 +286,39 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                  f"({total:.0f}s in test calls)")
         for d, nodeid in durations[:10]:
             terminalreporter.write_line(f"{d:7.2f}s {nodeid}")
+    report_path = os.environ.get("PINT_TPU_TIMING_REPORT")
+    if report_path:
+        # machine-readable twin of the table above: the driver (and the
+        # telemetry CLI) re-tune tier assignments from this artifact
+        # without scraping terminal output
+        import json
+        import time
+
+        payload = {
+            "kind": "pint_tpu.timing_report",
+            "unix_time": time.time(),
+            "exitstatus": int(exitstatus),
+            "n_tests": len(durations),
+            "total_call_s": round(sum(d for d, _ in durations), 3),
+            "wall_s": round(time.time() - _SESSION_T0, 3)
+            if _SESSION_T0 is not None else None,
+            "budget_s": _tier1_budget_s(),
+            "slowest": [
+                {"nodeid": nodeid, "duration_s": round(d, 3)}
+                for d, nodeid in durations[:10]
+            ],
+        }
+        try:
+            tmp = report_path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, indent=2)
+                fh.write("\n")
+            os.replace(tmp, report_path)
+            terminalreporter.write_line(
+                f"timing report written to {report_path}")
+        except OSError as exc:
+            terminalreporter.write_line(
+                f"timing report NOT written ({exc})", yellow=True)
     over = _tier1_wall_exceeded(config)
     if over is not None:
         wall, budget = over
@@ -344,6 +384,18 @@ def pytest_collection_modifyitems(config, items):
             if os.environ.get("PINT_TPU_SKIP_SERVE") == "1":
                 item.add_marker(_pytest.mark.skip(
                     reason="PINT_TPU_SKIP_SERVE=1"))
+        if fname == "test_telemetry.py" or (
+                fname == "test_tooling.py" and getattr(
+                    item, "cls", None) is not None
+                and item.cls.__name__.startswith("TestTelemetry")):
+            # the observability gate: cheap span/recorder unit legs ride
+            # tier-1 (test_telemetry.py), the crash-dump / summarize
+            # subprocess depth legs ride the slow test_tooling.py;
+            # ``-m telemetry`` selects both
+            item.add_marker(_pytest.mark.telemetry)
+            if os.environ.get("PINT_TPU_SKIP_TELEMETRY") == "1":
+                item.add_marker(_pytest.mark.skip(
+                    reason="PINT_TPU_SKIP_TELEMETRY=1"))
         if fname == "test_fleet.py":
             # the many-pulsar fleet gate mirrors the contracts gate's
             # opt-out contract (PINT_TPU_SKIP_FLEET=1 on WIP branches)

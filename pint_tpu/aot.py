@@ -86,7 +86,7 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from pint_tpu import faultinject, profiling
+from pint_tpu import faultinject, profiling, telemetry
 from pint_tpu.exceptions import PintTpuWarning
 from pint_tpu.logging import child as _logchild
 
@@ -305,6 +305,7 @@ def _record_miss(key: ProgramKey, reason: str) -> None:
         _COUNTERS["misses"] += 1
         _MISSES.append(ProgramMiss(key.entry, key.digest, reason))
     profiling.count("aot.misses")
+    telemetry.event("aot.miss", entry=key.entry, reason=reason)
 
 
 # --- the disk store -----------------------------------------------------------
@@ -409,6 +410,7 @@ class ProgramStore:
         warnings.warn(msg, AotStoreWarning)
         _log.warning(msg)
         _count("invalidations")
+        telemetry.warn("aot.invalidated", entry=key.entry, why=why)
         with contextlib.suppress(OSError):
             os.unlink(os.path.join(self.path, fname))
         self._manifest["files"].pop(fname, None)
@@ -466,6 +468,7 @@ class ProgramStore:
             _record_miss(key, f"undeserializable: {type(e).__name__}")
             return None
         _count("hits")
+        telemetry.event("aot.hit", entry=key.entry)
         from pint_tpu.lint import tracehooks
 
         tracehooks.note_aot_hit()

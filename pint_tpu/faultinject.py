@@ -52,7 +52,8 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "wedged_probe", "chunk_nonfinite", "chunk_raise",
            "sigterm_midscan", "corrupt_checkpoint", "retrace_storm",
            "chatty_transfer", "chatty_collective", "corrupt_aot_blob",
-           "stale_aot_version", "request_flood", "stalled_bucket"]
+           "stale_aot_version", "request_flood", "stalled_bucket",
+           "recorder_crash"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -494,6 +495,30 @@ def request_flood() -> Iterator[None]:
         yield
 
 
+def _recorder_crash_factory(fn):
+    """Raise inside a flushed serve batch — AFTER admission assigned the
+    requests their trace ids and the bucket's dispatch span opened, but
+    before the program runs.  The crash the flight recorder (ISSUE 12)
+    must survive: the resulting dump has to carry the admitting
+    requests' trace ids and the failing bucket's OPEN span."""
+    def crash(*args, **kwargs):
+        raise RuntimeError(
+            "faultinject: recorder_crash fired inside a serve batch")
+    return crash
+
+
+@contextlib.contextmanager
+def recorder_crash() -> Iterator[None]:
+    """Failpoint ``"recorder_crash"``: every serve bucket dispatch
+    raises mid-flush (see ``TimingService._dispatch_inner``) — the
+    black-box acceptance driver for the telemetry flight recorder.
+    Env-activatable (``PINT_TPU_FAULTS=recorder_crash``) so the
+    ``python -m pint_tpu.serve check`` subprocess leg can prove the
+    crash dump across a process boundary."""
+    with _registered("recorder_crash", _recorder_crash_factory):
+        yield
+
+
 def _stalled_bucket_factory(fn):
     """Replace the serve daemon's bucket-full readiness check with a
     constant "not full", so the fast path (dispatch when ``batch_size``
@@ -529,6 +554,7 @@ _ENV_FACTORIES = {
     "stale_aot_version": _stale_aot_version_factory,
     "request_flood": _request_flood_factory,
     "stalled_bucket": _stalled_bucket_factory,
+    "recorder_crash": _recorder_crash_factory,
 }
 
 

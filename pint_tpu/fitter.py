@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu import faultinject, profiling
+from pint_tpu import faultinject, profiling, telemetry
 from pint_tpu.exceptions import (ConvergenceFailure, DegeneracyWarning,
                                  PintTpuWarning)
 from pint_tpu.lint.contracts import dispatch_contract
@@ -544,6 +544,7 @@ def _make_assembly(model: TimingModel, names: Sequence[str], combined,
 
 @dispatch_contract("split_assembly", max_compiles=30, max_dispatches=2,
                    max_transfers=2)
+# ddlint: disable=OBS001 returns bare jitted closures consumed per step by the fused/eager drivers — the span lives in their callers (fitter.fused_fit / fitter.degrade)
 def build_whitened_assembly(model: TimingModel, batch: TOABatch,
                             fit_params: Sequence[str], track_mode: str,
                             include_offset: bool,
@@ -622,6 +623,7 @@ def build_wideband_chi2_fn(model: TimingModel, batch: TOABatch,
 
 @dispatch_contract("wideband_step", max_compiles=40, max_dispatches=3,
                    max_transfers=3)
+# ddlint: disable=OBS001 returns bare jitted closures for the per-step hot path — a host span wrapper here would be per-iteration overhead; spanned by the fitter drivers
 def build_wideband_assembly(model: TimingModel, batch: TOABatch,
                             dm_index, dm_data, dm_error,
                             fit_params: Sequence[str], track_mode: str,
@@ -675,6 +677,7 @@ def build_wideband_assembly(model: TimingModel, batch: TOABatch,
 
 @dispatch_contract("gls_step", max_compiles=40, max_dispatches=3,
                    max_transfers=3)
+# ddlint: disable=OBS001 returns bare jitted closures for the per-step hot path — a host span wrapper here would be per-iteration overhead; spanned by the fitter drivers
 def build_gls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
@@ -1132,6 +1135,7 @@ def _exact_assemble_factory(batch, default_builder):
 
 @dispatch_contract("wls_step", max_compiles=40, max_dispatches=3,
                    max_transfers=3, warm_from_store=True)
+# ddlint: disable=OBS001 returns bare jitted closures for the per-step hot path — a host span wrapper here would be per-iteration overhead; spanned by the fitter drivers
 def build_wls_step(model: TimingModel, batch: TOABatch,
                    fit_params: Sequence[str], track_mode: str,
                    threshold: Optional[float] = None,
@@ -1474,7 +1478,9 @@ def build_fused_fit(model: TimingModel, batch: TOABatch,
 
     def fit(p, p_host=None):
         profiling.count("jit_call")
-        with profiling.stage("fused_device_fit"):
+        with telemetry.span("fitter.fused_fit", n_par=npar,
+                            n_toa=n_rows), \
+                profiling.stage("fused_device_fit"):
             flat = run(p)
             if profiling.enabled():
                 jax.block_until_ready(flat)
@@ -1939,13 +1945,15 @@ class Fitter:
         for rung in self.DEGRADATION_RUNGS:
             profiling.count(f"guard.degrade_{rung}")
             try:
-                if rung == "eager":
-                    chi2 = self._fit_eager(maxiter=max(maxiter, 8),
-                                           threshold=threshold,
-                                           tol_chi2=tol_chi2)
-                else:
-                    chi2 = self._fit_lm_rescue(threshold=threshold,
+                with telemetry.span("fitter.degrade", rung=rung,
+                                    fused_status=fused_status.name):
+                    if rung == "eager":
+                        chi2 = self._fit_eager(maxiter=max(maxiter, 8),
+                                               threshold=threshold,
                                                tol_chi2=tol_chi2)
+                    else:
+                        chi2 = self._fit_lm_rescue(threshold=threshold,
+                                                   tol_chi2=tol_chi2)
                 st = self.fitresult.status
             except ConvergenceFailure as e:
                 statuses[rung] = e.status if e.status is not None else \
@@ -1968,6 +1976,10 @@ class Fitter:
                 + ("; degrading to damped LM" if rung != "lm" else
                    "; degradation chain exhausted"),
                 FitDegradedWarning)
+        telemetry.warn(
+            "fitter.chain_exhausted",
+            statuses={k: v.name for k, v in statuses.items()})
+        telemetry.dump_on_failure("ConvergenceFailure")
         raise ConvergenceFailure(
             "fit failed through the whole degradation chain "
             f"(fused -> eager -> LM): { {k: v.name for k, v in statuses.items()} }",

@@ -75,7 +75,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu import profiling, runtime
+from pint_tpu import profiling, runtime, telemetry
 from pint_tpu.exceptions import ConvergenceFailure, PintTpuWarning
 from pint_tpu.fitter import (_RUNNING, FitStatus, FitSummary, GLSFitter,
                              WLSFitter, _default_wls_kernel,
@@ -790,7 +790,9 @@ class FleetFitter:
             prog = self._bucket_program(b)
             args = self._chunk_args(ci)
             profiling.count("fleet.chunk_dispatch")
-            out = np.asarray(prog(*args))
+            with telemetry.span("fleet.chunk", chunk=ci, lo=lo, hi=hi,
+                                n_toa=b.n_toa, n_param=b.n_param):
+                out = np.asarray(prog(*args))
             P = b.n_param
             side["x"][lo:hi, :P] = out[:, :P]
             side["x"][lo:hi, P:] = 0.0

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import telemetry
 from pint_tpu.fitter import (Fitter, _default_wls_kernel,
                              build_whitened_assembly, wls_solve)
 from pint_tpu.lint.contracts import dispatch_contract
@@ -237,8 +238,10 @@ def grid_chisq_flat(fitter: Fitter, grid_values: Dict[str, np.ndarray],
                              "vmap")
     stacked = stack_grid_pdict(model, r.pdict, grid_values)
     if chunk_size is None and checkpoint is None and not return_summary:
-        # the historical one-program whole-grid fast path
-        chi2, _ = vfit(stacked)
+        # the historical one-program whole-grid fast path (the chunked
+        # path below gets its spans from runtime.run_checkpointed_scan)
+        with telemetry.span("grid.chisq_flat"):
+            chi2, _ = vfit(stacked)
         return _check_grid_chi2(np.asarray(chi2))
 
     from pint_tpu import runtime

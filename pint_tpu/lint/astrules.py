@@ -107,6 +107,10 @@ RULES = {
                    "(collective count/bytes over budget, unbudgeted "
                    "collective category, per-device peak, or an output "
                    "sharding resolved differently than declared)",
+    "OBS001": "dispatch-contract entrypoint with no telemetry span in "
+              "its body, nested closures, or direct module-local "
+              "callees — the hot path is invisible to the flight "
+              "recorder",
 }
 
 PRECISION_MODULES = {
@@ -646,6 +650,35 @@ class _BodyScanner:
     # -- TRACE002: per-iteration host conversions in contract code ---------
     _TRACE2_NP = {"asarray", "array"}
 
+    def _scan_obs001(self, info: _FuncInfo):
+        """A ``@dispatch_contract`` entrypoint with no telemetry span
+        anywhere in its subtree (nested closures included — a builder's
+        returned closure IS its steady-state body) and none in a direct
+        module-local callee: the hot path the contracts budget is
+        invisible to the flight recorder (ISSUE 12).  Builders that
+        return bare jitted closures (where a host span would wrap the
+        per-step path) sanction with ``# ddlint: disable=OBS001``."""
+
+        def has_span(node) -> bool:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        _attr_name(sub.func) == "span":
+                    return True
+            return False
+
+        if has_span(info.node):
+            return
+        for name in sorted(info.calls):
+            callee = self._resolve_from_scope(info, name)
+            if callee is not None and has_span(callee.node):
+                return
+        self.report(
+            "OBS001", info.node,
+            f"dispatch-contract entrypoint {info.name!r} records no "
+            "telemetry span — its dispatches are invisible to the "
+            "flight recorder; wrap the dispatch in telemetry.span(...) "
+            "or sanction with '# ddlint: disable=OBS001'")
+
     def _scan_trace002(self, info: _FuncInfo):
         """Host-conversion calls lexically inside a for/while loop of a
         function reachable from a ``@dispatch_contract`` entrypoint:
@@ -930,6 +963,8 @@ def lint_source(source: str, filename: str) -> List[Finding]:
             scanner._scan_jit001(info)
         if info.contract_reachable and not info.jit_reachable:
             scanner._scan_trace002(info)
+        if info.contract_root:
+            scanner._scan_obs001(info)
         if info.mesh_reachable:
             scanner._scan_shard001(info)
 

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import telemetry
 from pint_tpu.lint.contracts import dispatch_contract
 
 __all__ = ["ensemble_sample", "hmc_sample", "MCMCFitter"]
@@ -177,7 +178,9 @@ def ensemble_sample(lnpost_fn, x0, nsteps: int, seed: int = 0,
         else nsteps
     while k < nsteps:
         k2 = min(nsteps, k + chunk)
-        x, lnp, c, lp, nacc = run(x, lnp, jnp.asarray(keys[k:k2]))
+        with telemetry.span("mcmc.chunk", lo=k, hi=k2,
+                            nwalkers=nw, ndim=nd):
+            x, lnp, c, lp, nacc = run(x, lnp, jnp.asarray(keys[k:k2]))
         # ONE fetch per checkpoint chunk (bounded by n_chunks, not
         # nsteps) — the chain must live on host to be checkpointable
         chains.append(np.asarray(c))           # ddlint: disable=TRACE002

@@ -38,6 +38,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from pint_tpu import telemetry
 from pint_tpu.lint.contracts import dispatch_contract
 
 __all__ = ["init", "global_mesh", "barrier", "multihost_grid_chisq"]
@@ -257,7 +258,9 @@ def multihost_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
     if timeout_s:
         barrier("multihost_grid_chisq_entry", timeout_s=timeout_s)
     if chunk_size is None and checkpoint is None and not return_summary:
-        return _multihost_dispatch(fitter, grid_values, mesh, maxiter)
+        # chunked runs get their spans from runtime.run_checkpointed_scan
+        with telemetry.span("multihost.grid_chisq"):
+            return _multihost_dispatch(fitter, grid_values, mesh, maxiter)
 
     from pint_tpu import runtime
     from pint_tpu.gridutils import _eager_grid_chisq

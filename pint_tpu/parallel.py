@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pint_tpu import telemetry
 from pint_tpu.lint.contracts import dispatch_contract
 
 try:  # jax >= 0.8 public API; fall back for older jax
@@ -323,10 +324,12 @@ def sharded_grid_chisq(fitter, grid_values: Dict[str, np.ndarray],
     mesh = mesh or make_mesh()
     nb = mesh.devices.shape[0]
     if chunk_size is None and checkpoint is None and not return_summary:
-        # the historical one-dispatch whole-grid fast path
+        # the historical one-dispatch whole-grid fast path (chunked runs
+        # get their spans from runtime.run_checkpointed_scan)
         fit, stacked, batch, _ = prep_sharded_grid(
             fitter, grid_values, mesh, nb, maxiter, "sharded")
-        chi2, _ = fit(stacked, batch)
+        with telemetry.span("parallel.sharded_grid", n_shards=nb):
+            chi2, _ = fit(stacked, batch)
         # same host-boundary non-finite guard as the single-device grid:
         # the sharded program cannot report a poisoned point in-graph
         return _check_grid_chi2(np.asarray(chi2))

@@ -78,6 +78,12 @@ _counters: Dict[str, int] = {}
 #: checkpointed scans and bench sessions may count from concurrent
 #: threads, and a torn read-modify-write would silently lose events
 _lock = threading.Lock()
+#: optional ``(name, n)`` observer set by :mod:`pint_tpu.telemetry` —
+#: called OUTSIDE ``_lock`` so the hook may itself take locks
+_count_hook = None
+#: True while a ``trace(logdir)`` profiler session is live; telemetry
+#: spans only enter ``jax.profiler.TraceAnnotation`` when this is set
+_trace_active = False
 
 
 def enable() -> None:
@@ -170,6 +176,9 @@ def count(name: str, n: int = 1) -> None:
     and the dispatch-budget tests must not require profiling mode)."""
     with _lock:
         _counters[name] = _counters.get(name, 0) + n
+    hook = _count_hook
+    if hook is not None:
+        hook(name, n)
 
 
 def counters() -> Dict[str, int]:
@@ -269,11 +278,35 @@ def session() -> Iterator[Session]:
 
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
-    """Full XLA trace via ``jax.profiler`` (TensorBoard format)."""
-    import jax
+    """Full XLA trace via ``jax.profiler`` (TensorBoard format).
 
-    with jax.profiler.trace(logdir):
+    Degrades to a warned no-op when the profiler cannot start (a second
+    concurrent trace, a backend without profiler support): the traced
+    workload still runs — losing a trace must never lose the fit.
+    Sets ``_trace_active`` while live so telemetry spans mirror into
+    ``jax.profiler.TraceAnnotation``."""
+    global _trace_active
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(logdir)
+        ctx.__enter__()
+    except Exception as exc:  # pragma: no cover - backend-specific
+        import warnings
+
+        warnings.warn(f"profiling.trace({logdir!r}) could not start "
+                      f"({exc!r}); continuing without a profiler trace")
         yield
+        return
+    _trace_active = True
+    try:
+        yield
+    finally:
+        _trace_active = False
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception:  # pragma: no cover - backend-specific
+            pass
 
 
 def latency_stats(samples_s) -> Dict[str, Optional[float]]:
@@ -284,8 +317,8 @@ def latency_stats(samples_s) -> Dict[str, Optional[float]]:
     zero."""
     xs = sorted(float(s) for s in samples_s)
     if not xs:
-        return {"n_samples": 0, "p50_ms": None, "p99_ms": None,
-                "mean_ms": None}
+        return {"n_samples": 0, "p50_ms": None, "p90_ms": None,
+                "p99_ms": None, "max_ms": None, "mean_ms": None}
 
     def pct(q: float) -> float:
         i = min(len(xs) - 1, max(0, int(math.ceil(q * len(xs))) - 1))
@@ -293,7 +326,9 @@ def latency_stats(samples_s) -> Dict[str, Optional[float]]:
 
     return {"n_samples": len(xs),
             "p50_ms": round(pct(0.50), 4),
+            "p90_ms": round(pct(0.90), 4),
             "p99_ms": round(pct(0.99), 4),
+            "max_ms": round(xs[-1] * 1e3, 4),
             "mean_ms": round(sum(xs) / len(xs) * 1e3, 4)}
 
 

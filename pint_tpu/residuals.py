@@ -75,6 +75,7 @@ def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
 
 @dispatch_contract("residuals", max_compiles=30, max_dispatches=1,
                    max_transfers=1, warm_from_store=True)
+# ddlint: disable=OBS001 returns a bare jitted (aot.serve-wrapped) closure — a host span wrapper would break the exported-program identity; spanned by every driver that dispatches it
 def build_resid_fn(model: TimingModel, batch: TOABatch,
                    track_mode: str, subtract_mean: bool, use_weights: bool):
     """A jitted ``(pdict) -> phase residuals [cycles]`` closure over the

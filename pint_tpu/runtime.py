@@ -56,7 +56,7 @@ from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from pint_tpu import faultinject, profiling
+from pint_tpu import faultinject, profiling, telemetry
 from pint_tpu.exceptions import (CheckpointCorruptError, ScanInterrupted)
 from pint_tpu.lint.contracts import dispatch_contract
 from pint_tpu.logging import child as _logchild
@@ -437,6 +437,12 @@ class _SignalFlush:
                 signal.signal(sig, old)
             except (ValueError, OSError):  # pragma: no cover
                 pass
+        if self.fired is not None:
+            # the flight-recorder SIGTERM leg (ISSUE 12): by the time
+            # the signal window closes, the flush/spool spans are in the
+            # ring — dump them (no-op unless PINT_TPU_TELEMETRY_DUMP)
+            telemetry.warn("signal_flush", signum=self.fired)
+            telemetry.dump_on_failure(f"signal_{self.fired}")
         return False
 
 
@@ -553,9 +559,11 @@ def run_checkpointed_scan(
                     # ONE fetch per chunk dispatch: the chunk is the
                     # unit of retry/checkpoint, so its result must land
                     # on host here (bounded by n_chunks, not points)
-                    v = np.asarray(
-                        runner(ci, lo, hi),
-                        np.float64)            # ddlint: disable=TRACE002
+                    with telemetry.span("runtime.chunk", chunk=ci,
+                                        lo=lo, hi=hi, attempt=attempt):
+                        v = np.asarray(
+                            runner(ci, lo, hi),
+                            np.float64)        # ddlint: disable=TRACE002
                 except ScanInterrupted:
                     raise
                 except Exception as e:
@@ -585,9 +593,11 @@ def run_checkpointed_scan(
                              "fallback path", ci, n_chunks)
                 try:
                     # same per-chunk fetch contract as the primary path
-                    v = np.asarray(
-                        fallback(ci, lo, hi),
-                        np.float64)            # ddlint: disable=TRACE002
+                    with telemetry.span("runtime.chunk_fallback",
+                                        chunk=ci, lo=lo, hi=hi):
+                        v = np.asarray(
+                            fallback(ci, lo, hi),
+                            np.float64)        # ddlint: disable=TRACE002
                 except ScanInterrupted:
                     raise
                 except Exception as e:

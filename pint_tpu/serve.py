@@ -66,7 +66,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu import aot, faultinject, profiling, runtime
+from pint_tpu import aot, faultinject, profiling, runtime, telemetry
 from pint_tpu.exceptions import (CorrelatedErrors, ServeDrained,
                                  ServeSaturated)
 from pint_tpu.fitter import FitStatus, _default_wls_kernel
@@ -127,11 +127,15 @@ class ServeFuture:
     dispatch completes (or rejects with ``ServeDrained`` if the job was
     spooled instead of fitted)."""
 
-    __slots__ = ("name", "submitted_at", "resolved_at", "_ev", "_result",
-                 "_exc")
+    __slots__ = ("name", "trace_id", "submitted_at", "resolved_at",
+                 "_ev", "_result", "_exc")
 
     def __init__(self, name: str):
         self.name = name
+        #: per-request telemetry id, threaded from admission through the
+        #: bucket dispatch span (ISSUE 12) — what a flight-recorder dump
+        #: is grepped by
+        self.trace_id = telemetry.new_trace_id()
         self.submitted_at = time.monotonic()
         self.resolved_at: Optional[float] = None
         self._ev = threading.Event()
@@ -243,7 +247,8 @@ class TimingService:
                  max_pending: int = 64,
                  spool: Optional[str] = None,
                  args_cache_size: int = 8,
-                 program_cache: Optional[dict] = None):
+                 program_cache: Optional[dict] = None,
+                 stats_path: Optional[str] = None):
         from pint_tpu.fitter import FUSED_DIVERGE_STREAK, FUSED_STALL_ITERS
 
         if batch_size < 1:
@@ -266,6 +271,17 @@ class TimingService:
         self.max_pending = int(max_pending)
         self.spool = spool
         self.args_cache_size = max(int(args_cache_size), 1)
+        # live metrics (ISSUE 12): daemon mode writes stats() to this
+        # atomic file every stats-interval so an operator (or the
+        # telemetry CLI) can watch a running service without attaching
+        if stats_path is None:
+            stats_path = os.environ.get("PINT_TPU_SERVE_STATS_FILE") \
+                or None
+        self.stats_path = stats_path
+        self._stats_interval_s = max(float(os.environ.get(
+            "PINT_TPU_TELEMETRY_STATS_S", "1.0")), 0.05)
+        self._last_stats_write = 0.0
+        self._stats_file_writes = 0
 
         self._buckets: "OrderedDict[tuple, _ServeBucket]" = OrderedDict()
         self._programs: dict = {} if program_cache is None else program_cache
@@ -370,6 +386,8 @@ class TimingService:
             self._stats["submitted"] += 1
             profiling.count("serve.submit")
             self._cond.notify_all()
+        telemetry.event("serve.admit", job=job.name,
+                        trace_id=fut.trace_id)
         return fut
 
     def submit(self, model, toas, name: Optional[str] = None) -> ServeFuture:
@@ -439,6 +457,20 @@ class TimingService:
     # -- dispatch --------------------------------------------------------------
 
     def _dispatch(self, bucket: _ServeBucket, pairs, reason: str) -> None:
+        with telemetry.span(
+                "serve.dispatch_bucket", reason=reason,
+                n_toa=bucket.n_toa, n_param=bucket.n_param,
+                jobs=[j.name for j, _ in pairs],
+                traces=[f.trace_id for _, f in pairs]):
+            self._dispatch_inner(bucket, pairs, reason)
+
+    def _dispatch_inner(self, bucket: _ServeBucket, pairs,
+                        reason: str) -> None:
+        # the recorder_crash failpoint fires HERE — inside the open
+        # bucket span, after the admit events — so the flight recorder's
+        # crash dump provably carries the failing bucket's span and the
+        # admitting requests' trace ids (ISSUE 12's black-box proof)
+        faultinject.wrap("recorder_crash", lambda: None)()
         jobs = [j for j, _ in pairs]
         padded = jobs + [jobs[-1]] * (self.batch_size - len(jobs))
         prog = self._bucket_program(bucket)
@@ -504,7 +536,8 @@ class TimingService:
         after_batch = faultinject.wrap("sigterm_midscan", lambda ci: None)
         done = 0
         bi = 0
-        with runtime.SignalFlush() as sigs:
+        with telemetry.span("serve.flush", reason=reason), \
+                runtime.SignalFlush() as sigs:
             while True:
                 with self._cond:
                     nxt = self._next_batch_locked()
@@ -544,8 +577,11 @@ class TimingService:
                 ",".join(job.names).encode(), np.uint8)
             payload[f"job{i}_ntoa"] = np.asarray(  # ddlint: disable=TRACE002 ntoas is host metadata (a Python int), not a device value
                 job.resid.batch.ntoas, np.int64)
-        runtime.write_checkpoint(self.spool, payload)
-        profiling.count("serve.spool_write")
+        with telemetry.span("serve.spool", signum=signum,
+                            n_spooled=len(pairs),
+                            traces=[f.trace_id for _, f in pairs]):
+            runtime.write_checkpoint(self.spool, payload)
+            profiling.count("serve.spool_write")
         _log.info("serve drained on signal %s: %d job(s) spooled to %s",
                   signum, len(pairs), self.spool)
         err = ServeDrained(
@@ -554,6 +590,9 @@ class TimingService:
             n_spooled=len(pairs), signum=signum)
         for _, fut in pairs:
             fut._reject(err)
+        telemetry.warn("serve.drained", signum=signum,
+                       n_spooled=len(pairs), spool=self.spool)
+        telemetry.dump_on_failure("ServeDrained")
         raise err
 
     def resume_spool(self, jobs) -> List[ServeFuture]:
@@ -654,6 +693,25 @@ class TimingService:
             except Exception as e:   # futures must always resolve
                 for _, fut in pairs:
                     fut._reject(e)
+            self._maybe_write_stats()
+
+    def _maybe_write_stats(self, force: bool = False) -> None:
+        """Refresh the atomic live-stats file (daemon mode), rate-limited
+        to the ``PINT_TPU_TELEMETRY_STATS_S`` interval.  Best-effort: a
+        full disk must not take the dispatcher down."""
+        if self.stats_path is None:
+            return
+        now = time.monotonic()
+        if not force and \
+                now - self._last_stats_write < self._stats_interval_s:
+            return
+        self._last_stats_write = now
+        try:
+            telemetry.write_stats(self.stats_path, self.stats())
+            with self._cond:
+                self._stats_file_writes += 1
+        except OSError:
+            pass
 
     def drain(self, timeout: Optional[float] = 600.0) -> dict:
         """Graceful shutdown: admission closes, every pending job
@@ -671,6 +729,7 @@ class TimingService:
                 self._thread = None
         else:
             self.flush(reason="drain")
+        self._maybe_write_stats(force=True)
         return self.stats()
 
     # -- observability ---------------------------------------------------------
@@ -685,6 +744,7 @@ class TimingService:
             s["pending"] = self._n_pending
             s["n_buckets"] = len(self._buckets)
             s["n_programs"] = len(self._programs)
+            s["stats_file_writes"] = self._stats_file_writes
         s.update(profiling.latency_stats(lat))
         d = s["dispatches"]
         s["batch_occupancy"] = \
@@ -698,7 +758,8 @@ class TimingService:
 def _demo_service(*, batch_size: int = 2, maxiter: int = 3,
                   max_wait_ms: Optional[float] = None,
                   spool: Optional[str] = None,
-                  program_cache: Optional[dict] = None):
+                  program_cache: Optional[dict] = None,
+                  stats_path: Optional[str] = None):
     """Deterministic 4-pulsar / 2-bucket service + prepared jobs, shared
     by the AOT warm fixture (``--fixtures serve``), the serve CLI
     self-check, and the bench leg.  Mirrors ``aot._fleet4_fixture``'s
@@ -713,7 +774,8 @@ def _demo_service(*, batch_size: int = 2, maxiter: int = 3,
 
     svc = TimingService(batch_size=batch_size, maxiter=maxiter,
                         max_wait_ms=max_wait_ms, spool=spool,
-                        program_cache=program_cache)
+                        program_cache=program_cache,
+                        stats_path=stats_path)
     jobs = []
     with _w.catch_warnings():
         _w.simplefilter("ignore")
@@ -752,6 +814,9 @@ def main(argv=None) -> int:
     chk.add_argument("--stagger-ms", type=float, default=2.0)
     args = ap.parse_args(argv)
 
+    # a crashed check leaves a flight recording when
+    # PINT_TPU_TELEMETRY_DUMP is set — the black-box subprocess surface
+    telemetry.install_excepthook()
     st = runtime.acquire_backend()
     svc, jobs = _demo_service(batch_size=args.batch_size, maxiter=3,
                               max_wait_ms=args.wait_ms)

@@ -1,0 +1,509 @@
+"""Structured span tracing, a crash-surviving flight recorder, and live
+serve-daemon metrics (ISSUE 12).
+
+The contract machinery (ISSUE 5/8) proves dispatch budgets hold in
+tests; this module records *what actually happened* in a failing
+production process, so a degraded fit, a preempted scan, or a drained
+daemon leaves evidence richer than flat counters:
+
+* **Spans** — :func:`span` emits nested begin/end events with
+  attributes, monotonic timestamps, the owning thread, and the ambient
+  per-request trace id (:func:`trace_context`).  Begin and end are
+  SEPARATE ring events, so a span that never finished — the bucket that
+  was mid-dispatch when the process died — survives in the dump as an
+  open span, which is exactly the evidence a post-mortem needs.  When a
+  ``jax.profiler`` trace is active (``profiling.trace``), each span
+  additionally enters ``jax.profiler.TraceAnnotation`` so the XLA
+  timeline carries the same names.
+* **Counters for free** — :mod:`pint_tpu.profiling` exposes a
+  ``_count_hook``; this module registers into it at import, so every
+  existing ``profiling.count`` site (``aot.hits``, ``serve.dispatch``,
+  ``runtime.chunk_retry``, ``guard.degrade_*``, ...) streams into the
+  ring without per-site edits.
+* **Flight recorder** — a bounded ring (``PINT_TPU_TELEMETRY_RING``,
+  default 4096) of the last N events, dumped as CRC-checksummed JSONL
+  via the same write-tmp+``os.replace`` discipline as
+  ``runtime.write_checkpoint``.  Dumps fire on unhandled exceptions
+  (:func:`install_excepthook`), on ``ConvergenceFailure`` /
+  ``ServeDrained`` raises, and on SIGTERM via ``runtime.SignalFlush`` —
+  but ONLY when ``PINT_TPU_TELEMETRY_DUMP`` names a path (or
+  :func:`dump` is called explicitly), so expected-failure tests do not
+  litter the tree.
+* **Live metrics** — :func:`write_stats` / :func:`read_stats` move a
+  ``TimingService.stats()`` snapshot through an atomic stats file
+  (daemon mode writes it every ``PINT_TPU_TELEMETRY_STATS_S`` seconds);
+  the CLI ``python -m pint_tpu.telemetry`` prints it, summarizes a
+  recorder dump, and exports Chrome trace-event JSON for Perfetto.
+
+**Contract neutrality** is the hard requirement that makes this
+TPU-shaped: recording an event is an in-memory dict append under a
+lock — no device sync, no transfer, no Python-level cache-key
+perturbation — so every ``@dispatch_contract`` budget (including
+``serve_request``'s 0-compile / 1-dispatch steady state) holds with
+recording enabled.  ``tests/test_tooling.py`` runs the full contract
+audit with telemetry on; ``bench --quick`` reports the wall overhead
+as ``telemetry_overhead_pct``.
+
+This module imports neither ``jax`` nor ``pint_tpu.runtime`` at module
+level: the recorder must stay importable (and dump-capable) even when
+the accelerator stack is the thing that crashed.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import io
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from pint_tpu import profiling
+
+__all__ = ["enable", "disable", "enabled", "span", "event", "warn",
+           "new_trace_id", "trace_context", "current_trace_id",
+           "events", "clear", "dump", "dump_on_failure", "load_dump",
+           "summarize", "to_chrome_trace", "write_stats", "read_stats",
+           "install_excepthook", "main"]
+
+DUMP_KIND = "pint_tpu.telemetry.flight"
+STATS_KIND = "pint_tpu.telemetry.stats"
+DUMP_VERSION = 1
+
+_enabled = os.environ.get("PINT_TPU_TELEMETRY", "1") != "0"
+_ring: collections.deque = collections.deque(
+    maxlen=max(16, int(os.environ.get("PINT_TPU_TELEMETRY_RING", "4096"))))
+#: guards the ring: serve worker threads, scan drivers and the count
+#: hook all append concurrently, and deque.append alone is atomic but a
+#: dump's iteration is not
+_lock = threading.Lock()
+_tls = threading.local()
+#: process-unique span/trace id sources (cheap: no entropy syscalls on
+#: the hot path; the pid prefix keeps ids distinct across a spool/resume
+#: process pair writing into the same dump directory)
+_span_ids = itertools.count(1)
+_trace_ids = itertools.count(1)
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+# --- trace-id plumbing -------------------------------------------------------
+
+def new_trace_id() -> str:
+    """A process-unique request id (``t<pid>-<seq>``) — assigned at
+    serve admission and threaded through every span the request
+    touches."""
+    return f"t{os.getpid()}-{next(_trace_ids)}"
+
+
+def current_trace_id() -> Optional[str]:
+    return getattr(_tls, "trace", None)
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str] = None) -> Iterator[str]:
+    """Set the ambient trace id for spans/events recorded on this
+    thread (generates a fresh one when ``trace_id`` is None)."""
+    tid = trace_id if trace_id is not None else new_trace_id()
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = tid
+    try:
+        yield tid
+    finally:
+        _tls.trace = prev
+
+
+# --- recording ---------------------------------------------------------------
+
+def _emit(ev: Dict[str, Any]) -> None:
+    with _lock:
+        _ring.append(ev)
+
+
+def _jsonable(v: Any) -> Any:
+    """Clamp attribute values to JSON scalars/lists — a stray device
+    array in span attrs must neither sync nor poison the dump."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return repr(v)
+
+
+def event(name: str, *, kind: str = "I", **attrs) -> None:
+    """Record an instant event (``kind='I'``) or warning (``'W'``)."""
+    if not _enabled:
+        return
+    ev: Dict[str, Any] = {"ev": kind, "t": round(time.monotonic(), 6),
+                          "name": name,
+                          "trace": current_trace_id(),
+                          "tid": threading.get_ident()}
+    if attrs:
+        ev["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
+    _emit(ev)
+
+
+def warn(name: str, **attrs) -> None:
+    """Record a warning event — the "what was wrong just before the
+    crash" channel the dump summary surfaces first."""
+    event(name, kind="W", **attrs)
+
+
+def _on_count(name: str, n: int) -> None:
+    """``profiling._count_hook`` target: every dispatch counter
+    increment becomes a ring event (called OUTSIDE profiling's lock)."""
+    if not _enabled:
+        return
+    _emit({"ev": "C", "t": round(time.monotonic(), 6), "name": name,
+           "n": n, "trace": current_trace_id(),
+           "tid": threading.get_ident()})
+
+
+profiling._count_hook = _on_count
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[None]:
+    """Record a nested begin/end span around the block.
+
+    Contract-neutral by construction: entry/exit each append one dict
+    to the ring — nothing touches the device, so a spanned dispatch is
+    bit-for-bit the unspanned dispatch.  When a ``jax.profiler`` trace
+    is live (``profiling._trace_active``), the block also runs under
+    ``jax.profiler.TraceAnnotation(name)`` so Perfetto/TensorBoard
+    timelines show the same structure."""
+    if not _enabled:
+        yield
+        return
+    sid = next(_span_ids)
+    stack: List[int] = getattr(_tls, "stack", None) or []
+    _tls.stack = stack
+    parent = stack[-1] if stack else None
+    ev: Dict[str, Any] = {"ev": "B", "t": round(time.monotonic(), 6),
+                          "name": name, "span": sid, "parent": parent,
+                          "trace": current_trace_id(),
+                          "tid": threading.get_ident()}
+    if attrs:
+        ev["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
+    _emit(ev)
+    stack.append(sid)
+    t0 = time.monotonic()
+    anno = None
+    if getattr(profiling, "_trace_active", False):
+        try:
+            import jax
+            anno = jax.profiler.TraceAnnotation(name)
+            anno.__enter__()
+        except Exception:
+            anno = None
+    err: Optional[str] = None
+    try:
+        yield
+    except BaseException as exc:
+        # an unwinding exception CLOSES the span (only a hard death —
+        # SIGKILL, or a dump taken inside the span — leaves it open),
+        # so the failing span is marked errored instead: that is what a
+        # post-mortem greps for after an excepthook dump
+        err = type(exc).__name__
+        raise
+    finally:
+        if anno is not None:
+            try:
+                anno.__exit__(None, None, None)
+            except Exception:
+                pass
+        stack.pop()
+        end: Dict[str, Any] = {
+            "ev": "E", "t": round(time.monotonic(), 6), "name": name,
+            "span": sid, "tid": threading.get_ident(),
+            "dur_ms": round((time.monotonic() - t0) * 1e3, 4)}
+        if err is not None:
+            end["err"] = err
+        _emit(end)
+
+
+def events() -> List[Dict[str, Any]]:
+    """A snapshot copy of the ring (oldest first)."""
+    with _lock:
+        return list(_ring)
+
+
+def clear() -> None:
+    with _lock:
+        _ring.clear()
+
+
+# --- flight-recorder dump ----------------------------------------------------
+
+def dump(path: Optional[str] = None, reason: str = "manual"
+         ) -> Optional[str]:
+    """Write the ring as CRC-checksummed JSONL (atomic tmp+replace,
+    the ``runtime.write_checkpoint`` discipline re-implemented locally
+    so a broken jax install cannot take the black box down with it).
+
+    ``path`` defaults to ``PINT_TPU_TELEMETRY_DUMP``; returns the path
+    written, or None (no-op) when neither is set."""
+    if path is None:
+        path = os.environ.get("PINT_TPU_TELEMETRY_DUMP") or None
+    if not path:
+        return None
+    evs = events()
+    buf = io.StringIO()
+    header = {"kind": DUMP_KIND, "version": DUMP_VERSION,
+              "reason": reason, "pid": os.getpid(),
+              "unix_time": round(time.time(), 3), "n_events": len(evs)}
+    buf.write(json.dumps(header, sort_keys=True) + "\n")
+    for ev in evs:
+        buf.write(json.dumps(ev, sort_keys=True) + "\n")
+    body = buf.getvalue()
+    crc = zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(body)
+        fh.write(json.dumps({"kind": "crc", "crc32": crc}) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def dump_on_failure(reason: str) -> Optional[str]:
+    """Best-effort dump at a failure site (``ConvergenceFailure``,
+    ``ServeDrained``, SIGTERM, unhandled exception).  Never raises —
+    the black box must not turn one failure into two — and writes
+    nothing unless ``PINT_TPU_TELEMETRY_DUMP`` opted in."""
+    try:
+        return dump(reason=reason)
+    except Exception:
+        return None
+
+
+def load_dump(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Read and CRC-verify a recorder dump -> (header, events).
+    Raises ``ValueError`` on a missing/mismatched checksum or a foreign
+    file."""
+    with open(path, "r", encoding="utf-8") as fh:
+        lines = fh.read().splitlines(keepends=True)
+    if not lines:
+        raise ValueError(f"{path}: empty recorder dump")
+    trailer = json.loads(lines[-1])
+    if trailer.get("kind") != "crc":
+        raise ValueError(f"{path}: missing CRC trailer")
+    body = "".join(lines[:-1])
+    crc = zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+    if crc != trailer.get("crc32"):
+        raise ValueError(
+            f"{path}: CRC mismatch (file {trailer.get('crc32')}, "
+            f"computed {crc}) — truncated or corrupted dump")
+    header = json.loads(lines[0])
+    if header.get("kind") != DUMP_KIND:
+        raise ValueError(f"{path}: not a telemetry dump "
+                         f"(kind={header.get('kind')!r})")
+    evs = [json.loads(ln) for ln in lines[1:-1]]
+    return header, evs
+
+
+def summarize(evs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a dump's events into the post-mortem shape: per-span
+    totals, OPEN spans (begun, never ended — where the process died),
+    warnings, counters, and the request trace ids seen."""
+    by_kind: Dict[str, int] = {}
+    spans: Dict[str, Dict[str, float]] = {}
+    open_spans: Dict[int, Dict[str, Any]] = {}
+    errored_spans: List[Dict[str, Any]] = []
+    counters: Dict[str, int] = {}
+    warnings: List[Dict[str, Any]] = []
+    traces = set()
+    for ev in evs:
+        kind = ev.get("ev")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        if ev.get("trace"):
+            traces.add(ev["trace"])
+        if kind == "B":
+            open_spans[ev["span"]] = {"name": ev["name"],
+                                      "span": ev["span"],
+                                      "trace": ev.get("trace")}
+        elif kind == "E":
+            begun = open_spans.pop(ev.get("span"), None)
+            s = spans.setdefault(ev["name"], {"count": 0,
+                                              "total_ms": 0.0})
+            s["count"] += 1
+            s["total_ms"] = round(s["total_ms"] + ev.get("dur_ms", 0.0),
+                                  4)
+            if ev.get("err"):
+                errored_spans.append({
+                    "name": ev["name"], "span": ev.get("span"),
+                    "err": ev["err"],
+                    "trace": begun.get("trace") if begun else None})
+        elif kind == "C":
+            counters[ev["name"]] = (counters.get(ev["name"], 0)
+                                    + int(ev.get("n", 1)))
+        elif kind == "W":
+            warnings.append({"name": ev["name"],
+                             "attrs": ev.get("attrs", {}),
+                             "trace": ev.get("trace")})
+    return {"n_events": len(evs), "by_kind": by_kind, "spans": spans,
+            "open_spans": sorted(open_spans.values(),
+                                 key=lambda s: s["span"]),
+            "errored_spans": errored_spans,
+            "warnings": warnings, "counters": counters,
+            "traces": sorted(traces)}
+
+
+def to_chrome_trace(evs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Convert ring events to Chrome trace-event JSON (the Perfetto /
+    ``chrome://tracing`` format): B/E spans map to duration begin/end,
+    counters to ``ph='C'``, warnings/instants to ``ph='i'``."""
+    out = []
+    pid = os.getpid()
+    for ev in evs:
+        kind = ev.get("ev")
+        ts = float(ev.get("t", 0.0)) * 1e6
+        base = {"ts": ts, "pid": pid, "tid": ev.get("tid", 0),
+                "name": ev.get("name", "?")}
+        args = dict(ev.get("attrs") or {})
+        if ev.get("trace"):
+            args["trace"] = ev["trace"]
+        if kind == "B":
+            args["span"] = ev.get("span")
+            out.append(dict(base, ph="B", cat="span", args=args))
+        elif kind == "E":
+            out.append(dict(base, ph="E", cat="span",
+                            args={"span": ev.get("span")}))
+        elif kind == "C":
+            out.append(dict(base, ph="C", cat="counter",
+                            args={ev.get("name", "?"):
+                                  int(ev.get("n", 1))}))
+        else:
+            out.append(dict(base, ph="i", s="t",
+                            cat="warning" if kind == "W" else "instant",
+                            args=args))
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# --- excepthook --------------------------------------------------------------
+
+_hook_installed = False
+
+
+def install_excepthook() -> None:
+    """Chain a dump onto ``sys.excepthook``: an unhandled exception
+    records a warning event and flushes the ring (when
+    ``PINT_TPU_TELEMETRY_DUMP`` is set) before the normal traceback
+    prints.  Idempotent."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    prev = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            warn("unhandled_exception", exc_type=exc_type.__name__,
+                 message=str(exc)[:500])
+            dump_on_failure("unhandled_exception")
+        except Exception:
+            pass
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+    _hook_installed = True
+
+
+if os.environ.get("PINT_TPU_TELEMETRY_DUMP"):
+    install_excepthook()
+
+
+# --- live stats file ---------------------------------------------------------
+
+def write_stats(path: str, stats: Dict[str, Any]) -> str:
+    """Atomically write a stats snapshot (daemon mode calls this every
+    ``PINT_TPU_TELEMETRY_STATS_S`` seconds) — readers always see a
+    complete JSON document, never a torn write."""
+    doc = {"kind": STATS_KIND, "unix_time": round(time.time(), 3),
+           "pid": os.getpid(), "stats": stats}
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_stats(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("kind") != STATS_KIND:
+        raise ValueError(f"{path}: not a telemetry stats file "
+                         f"(kind={doc.get('kind')!r})")
+    return doc
+
+
+# --- CLI ---------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m pint_tpu.telemetry <stats|summarize|export-chrome>``
+    — the operator's window into a live daemon's stats file and a dead
+    process's flight recording."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m pint_tpu.telemetry",
+        description="Inspect pint_tpu telemetry artifacts.")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_stats = sub.add_parser(
+        "stats", help="print a live daemon stats file as one JSON line")
+    p_stats.add_argument("path")
+    p_sum = sub.add_parser(
+        "summarize",
+        help="CRC-verify a flight-recorder dump and print its summary")
+    p_sum.add_argument("path")
+    p_exp = sub.add_parser(
+        "export-chrome",
+        help="convert a dump to Chrome trace-event JSON (Perfetto)")
+    p_exp.add_argument("path")
+    p_exp.add_argument("-o", "--out", required=True)
+    ns = parser.parse_args(argv)
+
+    install_excepthook()
+    if ns.cmd == "stats":
+        print(json.dumps(read_stats(ns.path), sort_keys=True))
+        return 0
+    if ns.cmd == "summarize":
+        header, evs = load_dump(ns.path)
+        out = {"header": header, "summary": summarize(evs)}
+        print(json.dumps(out, sort_keys=True))
+        return 0
+    # export-chrome
+    _, evs = load_dump(ns.path)
+    doc = to_chrome_trace(evs)
+    with open(ns.out, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    print(json.dumps({"written": ns.out,
+                      "events": len(doc["traceEvents"])}))
+    return 0
+
+
+if __name__ == "__main__":
+    # canonical-module delegation (the serve/aot idiom): running as a
+    # script must share the imported module's ring and hook state
+    from pint_tpu.telemetry import main as _main
+
+    sys.exit(_main())

@@ -407,6 +407,7 @@ class TestTRACE002:
         from pint_tpu.lint.contracts import dispatch_contract
 
         @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        # ddlint: disable=OBS001 — TRACE002 fixture
         def entry(vals):
             out = []
             for v in vals:
@@ -421,6 +422,7 @@ class TestTRACE002:
         from pint_tpu.lint.contracts import dispatch_contract
 
         @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        # ddlint: disable=OBS001 — TRACE002 fixture
         def entry(chunks):
             out = []
             for c in chunks:
@@ -456,6 +458,7 @@ class TestTRACE002:
         from pint_tpu.lint.contracts import dispatch_contract
 
         @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        # ddlint: disable=OBS001 — TRACE002 fixture
         def entry(result):
             return np.asarray(result)     # one fetch, not per-iteration
         """
@@ -480,11 +483,88 @@ class TestTRACE002:
         from pint_tpu.lint.contracts import dispatch_contract
 
         @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        # ddlint: disable=OBS001 — TRACE002 fixture
         def entry(chunks):
             out = []
             for c in chunks:
                 out.append(np.asarray(c))  # ddlint: disable=TRACE002 — per-chunk by design
             return out
+        """
+        assert codes(src) == []
+
+
+# --- OBS001: contract entrypoints invisible to the flight recorder ------------
+class TestOBS001:
+    def test_fires_on_unspanned_contract_entrypoint(self):
+        src = """
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(vals):
+            return vals
+        """
+        assert codes(src) == ["OBS001"]
+
+    def test_clean_with_direct_span(self):
+        src = """
+        from pint_tpu import telemetry
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(vals):
+            with telemetry.span("entry", n=len(vals)):
+                return vals
+        """
+        assert codes(src) == []
+
+    def test_clean_with_span_in_nested_closure(self):
+        # the fleet.fit shape: the span lives in the per-chunk closure
+        src = """
+        from pint_tpu import telemetry
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(vals):
+            def run_chunk(v):
+                with telemetry.span("entry.chunk"):
+                    return v
+            return [run_chunk(v) for v in vals]
+        """
+        assert codes(src) == []
+
+    def test_clean_with_span_one_hop_away(self):
+        # the serve.flush shape: the entrypoint delegates to a module-
+        # local helper that owns the span
+        src = """
+        from pint_tpu import telemetry
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        def _dispatch(vals):
+            with telemetry.span("dispatch"):
+                return vals
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        def entry(vals):
+            return _dispatch(vals)
+        """
+        assert codes(src) == []
+
+    def test_clean_on_plain_function(self):
+        # no contract -> no observability obligation
+        src = """
+        def helper(vals):
+            return vals
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        from pint_tpu.lint.contracts import dispatch_contract
+
+        @dispatch_contract("x", max_compiles=1, max_dispatches=1)
+        # ddlint: disable=OBS001 — returns a bare jitted closure
+        def entry(vals):
+            return vals
         """
         assert codes(src) == []
 
@@ -799,7 +879,7 @@ class TestGate:
         for code in ("DD001", "PREC001", "TRACE001", "TRACE002",
                      "JIT001", "JIT002", "JAXPR001", "CONTRACT001",
                      "CONTRACT002", "CONTRACT003", "CONTRACT004",
-                     "SHARD001", "SHARD002"):
+                     "SHARD001", "SHARD002", "OBS001"):
             assert code in out
 
 

@@ -636,6 +636,118 @@ class TestServeColdStart:
             doc_a["results"]["serve"]["chi2"]
 
 
+class TestTelemetryBlackBox:
+    """The flight recorder's black-box proof (ISSUE 12), ACROSS the
+    process boundary: the ``recorder_crash`` failpoint (activated via
+    ``PINT_TPU_FAULTS``) kills a serve batch mid-dispatch, and the
+    crashed process must leave a CRC-valid dump whose ERRORED
+    ``serve.dispatch_bucket`` span names the admitted requests' trace
+    ids; the ``python -m pint_tpu.telemetry`` CLI must summarize it and
+    export valid Chrome trace JSON.  Plus the hard contract-neutrality
+    requirement: the FULL dispatch-contract audit passes with recording
+    enabled.  Marker ``telemetry``; opt out with
+    ``PINT_TPU_SKIP_TELEMETRY=1``."""
+
+    @staticmethod
+    def _run(module, args=(), env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", module, *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_recorder_crash_leaves_readable_dump(self, tmp_path):
+        import json
+
+        from pint_tpu import telemetry
+
+        dump = str(tmp_path / "flight.jsonl")
+        p = self._run("pint_tpu.serve", ["check", "--jobs", "4"],
+                      {"PINT_TPU_FAULTS": "recorder_crash",
+                       "PINT_TPU_TELEMETRY_DUMP": dump})
+        # the crash must be a crash: nonzero exit, the failpoint's
+        # message in the traceback
+        assert p.returncode != 0, p.stdout + p.stderr[-800:]
+        assert "recorder_crash fired" in p.stderr, p.stderr[-800:]
+        # ... and the black box survives it, CRC-intact
+        header, evs = telemetry.load_dump(dump)
+        assert header["reason"] == "unhandled_exception"
+        assert header["pid"] != __import__("os").getpid()
+        admits = [e for e in evs if e.get("name") == "serve.admit"]
+        assert admits, [e.get("name") for e in evs]
+        admitted = {e["attrs"]["trace_id"] for e in admits}
+        # the failing bucket's span is in the dump, marked ERRORED (the
+        # unwinding exception closed it with the error type) and names
+        # the admitted requests it was fitting
+        begins = [e for e in evs if e.get("ev") == "B"
+                  and e.get("name") == "serve.dispatch_bucket"]
+        assert begins, [e.get("name") for e in evs]
+        assert set(begins[-1]["attrs"]["traces"]) <= admitted
+        errored = [e for e in evs if e.get("ev") == "E"
+                   and e.get("span") == begins[-1]["span"]]
+        assert errored and errored[0]["err"] == "RuntimeError"
+        # the unhandled-exception warning is the last word
+        warns = [e for e in evs if e.get("ev") == "W"]
+        assert warns[-1]["name"] == "unhandled_exception"
+        assert "recorder_crash" in warns[-1]["attrs"]["message"]
+
+        # the operator CLI renders the same story from the dump alone
+        ps = self._run("pint_tpu.telemetry", ["summarize", dump])
+        assert ps.returncode == 0, ps.stdout + ps.stderr[-800:]
+        doc = json.loads(ps.stdout)
+        assert doc["header"]["reason"] == "unhandled_exception"
+        errs = doc["summary"]["errored_spans"]
+        assert any(e["name"] == "serve.dispatch_bucket"
+                   and e["err"] == "RuntimeError" for e in errs), errs
+        assert any(w["name"] == "unhandled_exception"
+                   for w in doc["summary"]["warnings"])
+
+        # ... and exports valid Chrome trace-event JSON for Perfetto
+        chrome = str(tmp_path / "chrome.json")
+        pe = self._run("pint_tpu.telemetry",
+                       ["export-chrome", dump, "-o", chrome])
+        assert pe.returncode == 0, pe.stdout + pe.stderr[-800:]
+        with open(chrome, encoding="utf-8") as fh:
+            cdoc = json.load(fh)
+        assert cdoc["displayTimeUnit"] == "ms"
+        assert len(cdoc["traceEvents"]) == len(evs)
+        assert all(e["ph"] in ("B", "E", "C", "i")
+                   for e in cdoc["traceEvents"])
+
+    def test_corrupted_dump_is_refused_by_cli(self, tmp_path):
+        from pint_tpu import telemetry
+
+        dump = str(tmp_path / "flight.jsonl")
+        with telemetry.trace_context():
+            telemetry.event("unit.x")
+        telemetry.dump(dump, reason="unit")
+        with open(dump, "a", encoding="utf-8") as fh:
+            fh.write("garbage after the trailer\n")
+        p = self._run("pint_tpu.telemetry", ["summarize", dump])
+        assert p.returncode != 0
+        assert "CRC" in p.stderr or "trailer" in p.stderr, p.stderr
+
+    def test_full_contract_audit_passes_with_recording_on(self):
+        """ISSUE 12 acceptance: every @dispatch_contract budget —
+        including serve_request's 0-compile / 1-dispatch steady state
+        and the CONTRACT003 warm legs — holds with the telemetry ring
+        recording (PINT_TPU_TELEMETRY=1).  The comm audit is skipped
+        (PINT_TPU_CONTRACT_COMM=0): collectives live in compiled HLO,
+        which host-side recording cannot touch."""
+        import json
+
+        p = self._run("pint_tpu.lint", ["--contracts", "--format=json"],
+                      {"PINT_TPU_TELEMETRY": "1",
+                       "PINT_TPU_CONTRACT_COMM": "0"})
+        assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+        doc = json.loads(p.stdout)
+        assert doc["findings"] == []
+
+
 class TestTupleChisq:
     def test_matches_grid(self):
         """tuple_chisq over an arbitrary point list equals grid_chisq_flat
