@@ -518,6 +518,39 @@ def bench_serve(n_requests: int = 24, batch_size: int = 2,
             f.result(timeout=600.0)
         st = svc.drain(timeout=600.0)
     wall = max(time.time() - t0, 1e-9)
+    # metrics-endpoint leg (ISSUE 13): when PINT_TPU_METRICS_PORT is
+    # set the daemon started a /metrics exporter; scrape it after drain
+    # (the exporter outlives drain by design), require the exposition
+    # to parse strictly, and require the scraped serve counters to
+    # agree with the drain snapshot
+    metrics_scrape = None
+    if svc.metrics_port is not None:
+        import urllib.request
+
+        from pint_tpu import metrics as _metrics
+
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{svc.metrics_port}/metrics",
+                timeout=10).read().decode("utf-8")
+            parsed = _metrics.parse_prometheus(body)
+            scraped = {key: parsed[("pint_tpu_serve_stat",
+                                    (("name", key),))]
+                       for key in ("completed", "dispatches",
+                                   "rejected", "pending")
+                       if ("pint_tpu_serve_stat",
+                           (("name", key),)) in parsed}
+            agree = all(
+                scraped.get(key) == st.get(key)
+                for key in scraped)
+            metrics_scrape = {"port": svc.metrics_port,
+                              "n_samples": len(parsed),
+                              "scraped": scraped, "agree": agree}
+        except Exception as e:
+            metrics_scrape = {"port": svc.metrics_port,
+                              "error": f"{type(e).__name__}: {e}"}
+        finally:
+            svc.stop_metrics()
     try:
         snap = telemetry.read_stats(stats_path)["stats"]
         stats_file = {"completed": snap.get("completed"),
@@ -547,7 +580,10 @@ def bench_serve(n_requests: int = 24, batch_size: int = 2,
         # last stats-file snapshot the daemon wrote while serving
         # (ISSUE 12 live-metrics leg; schema-checked in
         # tests/test_bench_quick.py)
-        "stats_file": stats_file}
+        "stats_file": stats_file,
+        # /metrics scrape vs drain snapshot (ISSUE 13; None when the
+        # exporter is off — the env knob was unset)
+        "metrics_scrape": metrics_scrape}
 
 
 def bench_design_split(ntoas: int = 2500):
@@ -782,6 +818,33 @@ def _run_in_subprocess(func_name: str, timeout_s: float = 900):
         f"tail: {out.stderr[-300:]}")
 
 
+def bench_cost_cards():
+    """Per-program cost cards (ISSUE 13): FLOPs / bytes-accessed /
+    per-device peak of the headline entrypoint programs (residuals,
+    fused_fit, fleet_bucket, serve_bucket), harvested from the compiled
+    artifacts on the audit fixture by
+    ``pint_tpu.lint.contracts.harvest_cost_cards``, plus the device's
+    bf16 peak FLOP/s (null on CPU) so achieved-vs-peak is computable
+    per entrypoint."""
+    from pint_tpu import profiling
+    from pint_tpu.lint.contracts import harvest_cost_cards
+
+    t0 = time.time()
+    cards = harvest_cost_cards()
+    out = {}
+    for entry in sorted(cards):
+        c = cards[entry]
+        out[entry] = {
+            "flops": c.get("flops"),
+            "bytes_accessed": c.get("bytes_accessed"),
+            "peak_bytes": c.get("peak_bytes"),
+            "exec_wall_s": c.get("exec_wall_s"),
+            "achieved_flops_per_sec": c.get("achieved_flops_per_sec")}
+    return {"cards": out,
+            "device_peak_flops": profiling.device_peak_flops(),
+            "wall_s": round(time.time() - t0, 2)}
+
+
 def bench_quick(backend_status=None):
     """CPU-only smoke (``--quick``): ONE small WLS fit, no grid — the
     bench-regression canary that needs no accelerator (run by
@@ -821,7 +884,22 @@ def bench_quick(backend_status=None):
             f.fit_toas(maxiter=2)
             times.append(time.time() - t0)
     t = min(times)
-    counters = _dispatch_counters(lambda: f.fit_toas(maxiter=2))
+    # warm the served residuals program before the counter window: its
+    # first evaluation legitimately traces + compiles
+    f.resids.update()
+    _ = f.resids.phase_resids
+
+    def _steady_window():
+        f.fit_toas(maxiter=2)
+        # one steady-state residual refresh: routes through the served
+        # residuals program and its failpoint wrappers, so cache-key
+        # churn there (the seeded ``retrace_storm`` regression) shows
+        # up in the line's retrace counter — the axis the
+        # ``--compare`` gate reads
+        f.resids.update()
+        _ = f.resids.phase_resids
+
+    counters = _dispatch_counters(_steady_window)
     # recording cost of the span/flight-recorder layer on the warm fit
     # (ISSUE 12: the acceptance gate is <= 2% on the fused-fit leg;
     # tests/test_bench_quick.py applies a lax CI-noise bound here)
@@ -875,6 +953,16 @@ def bench_quick(backend_status=None):
             serve = bench_serve(subset=2)
         except Exception as e:  # keep the quick line alive
             serve = {"error": f"{type(e).__name__}: {e}"}
+    # per-program cost cards (ISSUE 13): what each headline entrypoint
+    # program costs in FLOPs / bytes / per-device peak, off the
+    # compiled artifacts on the audit fixture
+    if fast:
+        cost_cards = {"skipped": "PINT_TPU_BENCH_FAST=1"}
+    else:
+        try:
+            cost_cards = bench_cost_cards()
+        except Exception as e:  # keep the quick line alive
+            cost_cards = {"error": f"{type(e).__name__}: {e}"}
     # supervised-acquisition provenance (ISSUE 4): how the backend was
     # obtained — a wedged-probe run shows up as backend_rung
     # "cpu_fallback" with attempts > 1 instead of a null metric
@@ -929,10 +1017,43 @@ def bench_quick(backend_status=None):
         "serve_p99_ms": serve.get("p99_ms"),
         "serve_fits_per_sec": serve.get("fits_per_sec"),
         "serve_batch_occupancy": serve.get("batch_occupancy"),
+        # per-program cost cards (ISSUE 13): {entry: {flops,
+        # bytes_accessed, peak_bytes, ...}}; null when the leg was
+        # skipped/failed (schema-checked in tests/test_bench_quick.py
+        # and by `python -m pint_tpu.metrics compare --schema-only`)
+        "cost_cards": cost_cards.get("cards"),
+        "device_peak_flops": cost_cards.get("device_peak_flops"),
         "submetrics": {"fleet": fleet, "aot_cold_start": aot_cold,
                        "comm_profile": comm, "serve": serve,
-                       "telemetry": telemetry_cost},
+                       "telemetry": telemetry_cost,
+                       "cost_cards": cost_cards},
     }
+
+
+def _compare_gate(doc, path, tolerance):
+    """``--compare`` (ISSUE 13): gate the just-emitted bench line
+    against a prior artifact (raw line or ``BENCH_r0*.json`` wrapper)
+    via the ``pint_tpu.metrics`` regression rules.  Returns the process
+    exit code: 0 pass, 1 regression (attribution logged per metric),
+    2 unusable history."""
+    from pint_tpu import metrics
+
+    try:
+        old = metrics.load_bench_line(path)
+    except (OSError, ValueError) as e:
+        log(f"--compare: cannot load {path}: {e}")
+        return 2
+    if old is None:
+        log(f"--compare: {path} is an empty round; gate skipped")
+        return 0
+    failures = metrics.compare(old, doc, tolerance=tolerance)
+    if not failures:
+        log(f"--compare: PASS against {path}")
+        return 0
+    for f in failures:
+        log(f"--compare: REGRESSION {f['metric']}: {f['why']} "
+            f"(old={f['old']}, new={f['new']})")
+    return 1
 
 
 def main(argv=None):
@@ -942,6 +1063,13 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true",
                     help="CPU-only smoke: one small WLS fit, no grid; "
                          "emits the same JSON schema as the full bench")
+    ap.add_argument("--compare", metavar="OLD_JSON", default=None,
+                    help="regression-gate the emitted line against a "
+                         "prior bench artifact (exit 1 with per-metric "
+                         "attribution on regression)")
+    ap.add_argument("--compare-tolerance", type=float, default=0.25,
+                    help="allowed fractional wall/bytes growth for "
+                         "--compare (default 0.25)")
     args = ap.parse_args(argv)
     # persistent XLA cache: repeat runs load executables instead of
     # recompiling (measured ~10 s load vs 120-160 s compile per big
@@ -962,7 +1090,11 @@ def main(argv=None):
 
         status = runtime.acquire_backend()
         log(f"backend acquisition: {status.as_dict()}")
-        print(json.dumps(bench_quick(status)))
+        doc = bench_quick(status)
+        print(json.dumps(doc))
+        if args.compare:
+            sys.exit(_compare_gate(doc, args.compare,
+                                   args.compare_tolerance))
         return
     # BENCH r05 recorded value: null from one unretried wedged 300 s
     # probe.  The supervisor retries with backoff under a deadline, then
@@ -1030,6 +1162,7 @@ def main(argv=None):
             ("design_split", bench_design_split),
             ("fleet", bench_fleet),
             ("serve", bench_serve),
+            ("cost_cards", bench_cost_cards),
             ("aot_cold_start", bench_cold_start),
             ("ngc6440e_wls", bench_ngc6440e),
             ("ensemble_sweep", sweep),
@@ -1056,7 +1189,7 @@ def main(argv=None):
             log(f"{name} FAILED: {e}")
         release_device()
 
-    print(json.dumps({
+    doc = {
         "metric": "wls_chisq_grid_3x3_J0740class_12500toas_86params",
         "value": round(t, 4),
         "unit": "s",
@@ -1122,8 +1255,18 @@ def main(argv=None):
             "fit_status"),
         "guard_trips": (submetrics.get("ngc6440e_wls") or {}).get(
             "guard_trips", {}),
+        # per-program cost cards (ISSUE 13): FLOPs / bytes / per-device
+        # peak per headline entrypoint program, and the device's bf16
+        # peak FLOP/s for achieved-vs-peak
+        "cost_cards": (submetrics.get("cost_cards") or {}).get("cards"),
+        "device_peak_flops": (submetrics.get("cost_cards") or {}).get(
+            "device_peak_flops"),
         "submetrics": submetrics,
-    }))
+    }
+    print(json.dumps(doc))
+    if args.compare:
+        sys.exit(_compare_gate(doc, args.compare,
+                               args.compare_tolerance))
 
 
 if __name__ == "__main__":

@@ -225,6 +225,13 @@ def pytest_configure(config):
         "subprocess depth legs ride the slow test_tooling.py; run all "
         "with -m telemetry, skip WIP branches with "
         "PINT_TPU_SKIP_TELEMETRY=1)")
+    config.addinivalue_line(
+        "markers",
+        "metrics: the metrics-plane gate (registry, Prometheus "
+        "exposition, cost cards, bench-history compare gate; "
+        "tests/test_metrics.py rides tier-1, the bench-subprocess "
+        "gate legs ride the slow test_tooling.py; run all with "
+        "-m metrics, skip WIP branches with PINT_TPU_SKIP_METRICS=1)")
 
 
 # --- tier-1 wall budget ------------------------------------------------------
@@ -384,6 +391,18 @@ def pytest_collection_modifyitems(config, items):
             if os.environ.get("PINT_TPU_SKIP_SERVE") == "1":
                 item.add_marker(_pytest.mark.skip(
                     reason="PINT_TPU_SKIP_SERVE=1"))
+        if fname == "test_metrics.py" or (
+                fname == "test_tooling.py" and getattr(
+                    item, "cls", None) is not None
+                and item.cls.__name__.startswith("TestMetrics")):
+            # the metrics-plane gate: cheap registry/exposition/compare
+            # unit legs ride tier-1 (test_metrics.py), the bench
+            # --compare subprocess depth legs ride the slow
+            # test_tooling.py; ``-m metrics`` selects both
+            item.add_marker(_pytest.mark.metrics)
+            if os.environ.get("PINT_TPU_SKIP_METRICS") == "1":
+                item.add_marker(_pytest.mark.skip(
+                    reason="PINT_TPU_SKIP_METRICS=1"))
         if fname == "test_telemetry.py" or (
                 fname == "test_tooling.py" and getattr(
                     item, "cls", None) is not None

@@ -715,12 +715,35 @@ class _ServedProgram:
 
         return guarded
 
+    def _harvest_cost_card(self, ecall, args, key) -> None:
+        """Best-effort cost card at resolution time (ISSUE 13).
+        Counter-neutral by construction: ``ecall.lower(*args)`` traces
+        the already-jitted wrapper and ``Lowered.cost_analysis()`` is a
+        host-side estimate — no ``backend_compile``, no retrace event —
+        so the aot zero-compile contract survives the harvest.  The
+        full memory card (device peak) is filled in by the audit/bench
+        legs, which own a real ``Compiled``."""
+        try:
+            from pint_tpu import metrics
+
+            if not metrics.enabled():
+                return
+            metrics.harvest_lowered(self.entry, ecall.lower(*args),
+                                    digest=key.digest,
+                                    source="aot_resolve")
+        except Exception:
+            pass
+
     def _resolve(self, sig: str, args):
         store = _STORE
         key = program_key(self.entry, self.fingerprint, args)
         exported = store.load(key)
         if exported is not None:
-            return self._guard(sig, exported), _RESOLVE_MISS
+            import jax
+
+            ecall = jax.jit(exported.call)
+            self._harvest_cost_card(ecall, args, key)
+            return self._guard(sig, exported, ecall), _RESOLVE_MISS
         # miss: run the live program (the caller's result), then —
         # unless measurement suspended writes — export, ROUND-TRIP
         # VERIFY, and write, leaving the process dispatching the same
@@ -766,6 +789,7 @@ class _ServedProgram:
             _log.warning(msg)
             return self.fn, out
         store.put(key, payload)
+        self._harvest_cost_card(ecall, args, key)
         return self._guard(sig, restored, ecall), out
 
 

@@ -1546,6 +1546,10 @@ def build_fused_fit(model: TimingModel, batch: TOABatch,
             out["resid_sec"] = r_new
         return x, out
 
+    # the served device program, reachable for the cost-card harvest
+    # (pint_tpu.metrics): the fused fit's XLA cost lives in `run`, not
+    # in the host finish
+    fit.run = run
     return fit
 
 

@@ -84,7 +84,7 @@ from pint_tpu.lint.tracehooks import TraceCounters, instrument
 
 __all__ = ["Contract", "ContractReport", "REGISTRY", "dispatch_contract",
            "check", "audit_contracts", "steady_state_counters",
-           "ContractFixture"]
+           "ContractFixture", "harvest_cost_cards"]
 
 
 class Contract(NamedTuple):
@@ -668,12 +668,123 @@ def _comm_leg(c: Contract, fix: ContractFixture) -> List[Finding]:
     if cached is None:
         prog = builder(fix)
         profile = hlo_audit.analyze_compiled(prog.compiled, prog.mesh)
+        # the audit already owns a real Compiled — feed the metrics
+        # cost-card registry for free (ISSUE 13; best-effort, never
+        # fails the audit)
+        from pint_tpu import metrics
+
+        metrics.harvest_compiled(c.name, prog.compiled,
+                                 source="contract_audit")
         cached = (profile,
                   hlo_audit.sharding_mismatches(profile,
                                                 prog.expected_out_specs))
         if isinstance(cache, dict):
             cache[key] = cached
     return _judge_comm(c, *cached)
+
+
+def _unwrap_program(fn):
+    """Peel ``faultinject.wrap`` / ``aot._ServedProgram`` layers down to
+    the lowerable jitted program (``_ServedProgram.fn`` is the jit; an
+    active failpoint closure has neither attribute, in which case the
+    caller's per-leg guard skips the card)."""
+    while not hasattr(fn, "lower") and hasattr(fn, "fn"):
+        fn = fn.fn
+    return fn
+
+
+def harvest_cost_cards(fixture: Optional[ContractFixture] = None
+                       ) -> Dict[str, Dict[str, object]]:
+    """Build + compile the headline entrypoint programs on the audit
+    fixture and record their full cost cards (FLOPs, bytes accessed,
+    per-device peak) in :mod:`pint_tpu.metrics` — the bench cost-card
+    leg (ISSUE 13).
+
+    Covers ``residuals``, ``fused_fit``, ``fleet_bucket`` (the fleet
+    bucket program on batch-mesh avals, reusing the CONTRACT004 HLO
+    driver) and ``serve_bucket`` (the daemon's coalesced batch
+    program).  Runs OUTSIDE any instrumented window — lowering and
+    compiling here is measurement, not steady-state work.  Each leg is
+    independent: a failure drops that entry from the result rather
+    than taking the others down."""
+    from pint_tpu import metrics
+
+    import time
+
+    fix = fixture if fixture is not None else ContractFixture()
+    cards: Dict[str, Dict[str, object]] = {}
+
+    def leg(entry: str, build: Callable[[], tuple]) -> None:
+        try:
+            compiled, call_args = build()
+            card = metrics.harvest_compiled(entry, compiled,
+                                            source="cost_cards")
+            if card is None:
+                return
+            if call_args is not None:
+                # achieved-vs-peak: time the compiled program itself
+                # (min-of-2 after one warm call) so the card carries a
+                # FLOP/s the flops estimate can be divided against
+                import jax
+
+                jax.block_until_ready(compiled(*call_args))
+                walls = []
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(compiled(*call_args))
+                    walls.append(time.perf_counter() - t0)
+                wall = max(min(walls), 1e-9)
+                extra = {"digest": card.get("digest", ""),
+                         "exec_wall_s": wall}
+                if card.get("flops"):
+                    extra["achieved_flops_per_sec"] = \
+                        float(card["flops"]) / wall
+                metrics.record_cost_card(entry, extra)
+                card.update(extra)
+            cards[entry] = card
+        except Exception:
+            pass
+
+    def _residuals():
+        from pint_tpu.residuals import build_resid_fn
+
+        fn = _unwrap_program(build_resid_fn(
+            fix.model, fix.batch, fix.resid.track_mode, True, True))
+        return fn.lower(fix.pdict).compile(), (fix.pdict,)
+
+    def _fused_fit():
+        from pint_tpu.fitter import build_fused_fit
+
+        fit = build_fused_fit(fix.model, fix.batch, fix.names,
+                              fix.resid.track_mode, maxiter=3,
+                              exact_floor=0.0)
+        run = _unwrap_program(fit.run)
+        return run.lower(fix.pdict).compile(), (fix.pdict,)
+
+    def _fleet_bucket():
+        from pint_tpu.lint import hlo_audit
+
+        # sharded ShapeDtypeStruct avals — inspectable, not callable
+        return hlo_audit.HLO_DRIVERS["fleet_fit"](fix).compiled, None
+
+    def _serve_bucket():
+        from pint_tpu.serve import TimingService
+
+        ff = fix.fleet_fitter()
+        svc = TimingService(batch_size=2, maxiter=3)
+        jobs = [svc.prepare(pu.model, pu.toas, name=pu.name)
+                for pu in ff._pulsars[:2]]
+        bucket = svc._bucket_for(jobs[0])
+        assert svc._bucket_for(jobs[1]) is bucket
+        prog = _unwrap_program(svc._bucket_program(bucket))
+        args = svc._batch_args(bucket, jobs)
+        return prog.lower(*args).compile(), args
+
+    leg("residuals", _residuals)
+    leg("fused_fit", _fused_fit)
+    leg("fleet_bucket", _fleet_bucket)
+    leg("serve_bucket", _serve_bucket)
+    return cards
 
 
 def check(name: str,

@@ -52,8 +52,8 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 __all__ = ["COLLECTIVE_CATEGORIES", "CollectiveOp", "CommProfile",
            "HloProgram", "HLO_DRIVERS", "analyze_compiled",
-           "normalize_spec", "sharding_mismatches", "comm_report",
-           "shape_bytes"]
+           "memory_profile", "normalize_spec", "sharding_mismatches",
+           "comm_report", "shape_bytes"]
 
 #: the steady-state collective vocabulary the audit accounts for; a
 #: category outside a contract's ``max_collectives`` is always-fail
@@ -167,6 +167,27 @@ def _output_specs(compiled, mesh):
     return tuple(specs)
 
 
+def memory_profile(compiled) -> Dict[str, int]:
+    """``compiled.memory_analysis()`` flattened to plain ints — the
+    argument / output / temp / generated-code sizes plus the combined
+    peak bound (this jax exposes no single peak field).  All-zero when
+    the artifact exposes no memory analysis (never raises): the cost
+    cards in :mod:`pint_tpu.metrics` and the CONTRACT004 leg both ride
+    this one extraction."""
+    arg = out = temp = gen = 0
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(ma.argument_size_in_bytes)
+        out = int(ma.output_size_in_bytes)
+        temp = int(ma.temp_size_in_bytes)
+        gen = int(ma.generated_code_size_in_bytes)
+    except Exception:
+        pass
+    return {"argument_bytes": arg, "output_bytes": out,
+            "temp_bytes": temp, "generated_code_bytes": gen,
+            "peak_bytes": arg + out + temp + gen}
+
+
 def analyze_compiled(compiled, mesh=None) -> CommProfile:
     """Parse one compiled program's HLO text + memory analysis into a
     :class:`CommProfile`.  ``mesh`` enables the output-sharding read."""
@@ -182,18 +203,11 @@ def analyze_compiled(compiled, mesh=None) -> CommProfile:
         counts[cat] = counts.get(cat, 0) + 1
         byts[cat] = byts.get(cat, 0) + nb
         ops.append(CollectiveOp(m.group("name"), cat, nb))
-    arg = out = temp = gen = 0
-    try:
-        ma = compiled.memory_analysis()
-        arg = int(ma.argument_size_in_bytes)
-        out = int(ma.output_size_in_bytes)
-        temp = int(ma.temp_size_in_bytes)
-        gen = int(ma.generated_code_size_in_bytes)
-    except Exception:
-        pass
+    mem = memory_profile(compiled)
     specs = _output_specs(compiled, mesh) if mesh is not None else None
     return CommProfile(counts, byts, tuple(ops), sum(byts.values()),
-                       arg, out, temp, arg + out + temp + gen, specs)
+                       mem["argument_bytes"], mem["output_bytes"],
+                       mem["temp_bytes"], mem["peak_bytes"], specs)
 
 
 def sharding_mismatches(profile: CommProfile,

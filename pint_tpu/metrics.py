@@ -1,0 +1,743 @@
+"""The metrics plane: live Prometheus exposition, per-program cost
+cards, and the bench-history regression gate (ISSUE 13).
+
+The repo already *measures* almost everything that matters — dispatch
+counters at the XLA boundary (:mod:`pint_tpu.profiling`), span timings
+in the telemetry ring (:mod:`pint_tpu.telemetry`), collective bytes in
+the compiled HLO (:mod:`pint_tpu.lint.hlo_audit`), and a bench JSON
+trajectory (``BENCH_r0*.json``).  What it lacked was a *plane*: nothing
+exposed those numbers live, tied them to what each compiled program
+costs, or failed a PR when the trajectory regressed.  This module is
+that plane, in three parts, stdlib-only like telemetry.py so a broken
+jax install cannot take observability down with it:
+
+* **registry** — lock-guarded counters / gauges / log2-bucketed latency
+  histograms, fed with ZERO per-site edits: every ``profiling.count``
+  site arrives through :func:`profiling.add_count_hook`, and every
+  ``telemetry.span`` feeds a duration histogram keyed by span name
+  through :func:`telemetry.add_span_end_hook`.  ``PINT_TPU_METRICS=0``
+  is the master off-switch (the hooks stay registered but become
+  no-ops, mirroring ``PINT_TPU_TELEMETRY=0``).
+
+* **cost cards** — at ``aot.serve`` resolution (counter-neutral:
+  ``lowered.cost_analysis()`` only, no extra ``backend_compile``) and
+  at contract-audit / bench time (full: ``compiled.cost_analysis()``
+  FLOPs/bytes plus the :func:`hlo_audit.memory_profile` per-device
+  peak), a per-``(entry, digest)`` card records what each entrypoint
+  program costs, so bench reports achieved-vs-peak FLOP/s per
+  entrypoint instead of a bare wall.
+
+* **exposure** — (1) an opt-in stdlib ``http.server`` thread
+  (``PINT_TPU_METRICS_PORT``; port 0 picks an ephemeral port for
+  tests) serving Prometheus text exposition at ``/metrics`` and the
+  serve daemon's ``stats()`` JSON at ``/healthz``, wired into
+  ``serve.TimingService`` and exercised by ``bench_serve``; (2) the
+  regression gate — ``python -m pint_tpu.metrics compare OLD NEW``
+  (also ``bench.py --compare``) diffs headline wall (tolerance),
+  steady-state compiles/retraces (must stay ZERO), comm / all-gather
+  bytes and serve p99 against a prior bench artifact and exits 1 with
+  per-metric attribution, turning the ``BENCH_r0*.json`` pile into a
+  CI-gateable series.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pint_tpu import profiling, telemetry
+
+__all__ = ["enable", "disable", "enabled", "inc", "set_gauge",
+           "observe", "reset", "snapshot", "record_cost_card",
+           "cost_cards", "harvest_lowered", "harvest_compiled",
+           "render_prometheus", "parse_prometheus", "start_exporter",
+           "Exporter", "load_bench_line", "check_schema", "compare",
+           "main", "HIST_BUCKETS_MS"]
+
+# --- master switch -----------------------------------------------------------
+
+_enabled = os.environ.get("PINT_TPU_METRICS", "1") != "0"
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+# --- the registry ------------------------------------------------------------
+
+#: guards every table below: count hooks arrive from serve worker
+#: threads and scan drivers concurrently with an exporter scrape
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, float] = {}
+
+#: log2 latency buckets in milliseconds, 2^-4 .. 2^14 (62 us .. 16 s):
+#: wide enough for a timer flush at the bottom and a cold compile at
+#: the top, cheap enough (19 floats) to render on every scrape
+HIST_BUCKETS_MS: Tuple[float, ...] = tuple(
+    float(2.0 ** e) for e in range(-4, 15))
+
+
+class _Hist:
+    """One cumulative-on-render histogram: per-bucket counts are stored
+    non-cumulative (one increment per observe) and summed at render
+    time, which keeps observe O(log n_buckets) lock-held work."""
+
+    __slots__ = ("counts", "total", "n")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(HIST_BUCKETS_MS) + 1)  # +1: +Inf
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        lo, hi = 0, len(HIST_BUCKETS_MS)
+        while lo < hi:                      # first bucket with le >= v
+            mid = (lo + hi) // 2
+            if HIST_BUCKETS_MS[mid] < v:
+                lo = mid + 1
+            else:
+                hi = mid
+        self.counts[lo] += 1
+        self.total += v
+        self.n += 1
+
+
+_hists: Dict[str, _Hist] = {}
+
+#: (entry, digest) -> cost card dict
+_cost_cards: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+
+def inc(name: str, n: float = 1) -> None:
+    """Increment counter ``name`` (no-op when disabled)."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def set_gauge(name: str, value: float) -> None:
+    if not _enabled:
+        return
+    with _lock:
+        _gauges[name] = float(value)
+
+
+def observe(name: str, value_ms: float) -> None:
+    """Record one latency sample (milliseconds) in histogram ``name``."""
+    if not _enabled:
+        return
+    if not isinstance(value_ms, (int, float)) or not math.isfinite(
+            value_ms):
+        return
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = _Hist()
+        h.observe(float(value_ms))
+
+
+def reset() -> None:
+    """Clear every table (tests; the bench legs snapshot-delta via
+    profiling, but the metrics registry is process-cumulative)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _cost_cards.clear()
+
+
+def snapshot() -> Dict[str, Any]:
+    """Plain-data copy of the registry (tests and ``/healthz``)."""
+    with _lock:
+        hists = {}
+        for name, h in _hists.items():
+            hists[name] = {"n": h.n, "sum_ms": h.total,
+                           "counts": list(h.counts)}
+        return {"counters": dict(_counters), "gauges": dict(_gauges),
+                "histograms": hists,
+                "cost_cards": [dict(c) for c in _cost_cards.values()]}
+
+
+# --- zero-per-site-edit feeds ------------------------------------------------
+
+def _on_count(name: str, n: int) -> None:
+    """``profiling.add_count_hook`` target — every existing
+    ``profiling.count`` site becomes a Prometheus counter."""
+    inc(name, n)
+
+
+def _on_span_end(name: str, dur_ms: float, err: Optional[str]) -> None:
+    """``telemetry.add_span_end_hook`` target — every span duration
+    lands in the histogram keyed by span name; errored spans also bump
+    a counter so a failing path is visible without log archaeology."""
+    observe(name, dur_ms)
+    if err is not None:
+        inc(f"span_errors.{name}")
+
+
+profiling.add_count_hook(_on_count)
+telemetry.add_span_end_hook(_on_span_end)
+
+
+# --- cost cards --------------------------------------------------------------
+
+def record_cost_card(entry: str, card: Dict[str, Any]) -> None:
+    """Merge a card for ``(entry, digest)``.  Numeric zeros never
+    overwrite a known nonzero (the counter-neutral aot harvest carries
+    flops but no memory peak; the audit harvest fills the peak in
+    later without erasing the flops)."""
+    digest = str(card.get("digest", ""))
+    key = (entry, digest)
+    with _lock:
+        cur = _cost_cards.setdefault(
+            key, {"entry": entry, "digest": digest})
+        for k, v in card.items():
+            if k in ("entry", "digest"):
+                continue
+            if (isinstance(v, (int, float)) and not v
+                    and cur.get(k)):
+                continue
+            cur[k] = v
+
+
+def cost_cards() -> List[Dict[str, Any]]:
+    """Every recorded card, ``(entry, digest)``-sorted copies."""
+    with _lock:
+        cards = [dict(c) for c in _cost_cards.values()]
+    return sorted(cards, key=lambda c: (c["entry"], c["digest"]))
+
+
+def _cost_analysis(obj) -> Dict[str, Any]:
+    """``.cost_analysis()`` across jax versions: some return a dict,
+    some a one-element list of dicts; anything else counts as empty."""
+    try:
+        ca = obj.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if isinstance(ca, dict) else {}
+
+
+def harvest_lowered(entry: str, lowered, digest: str = "",
+                    source: str = "") -> Optional[Dict[str, Any]]:
+    """Counter-neutral harvest from a ``jax.stages.Lowered`` — the
+    ``aot.serve`` resolution path rides this: ``lowered.
+    cost_analysis()`` is a host-side estimate that triggers no
+    ``backend_compile`` and no retrace, so the aot zero-compile
+    contract survives the harvest.  Best-effort: returns the card or
+    None, never raises."""
+    if not _enabled:
+        return None
+    try:
+        ca = _cost_analysis(lowered)
+        card = {"entry": entry, "digest": digest, "source": source,
+                "flops": float(ca.get("flops", 0.0) or 0.0),
+                "bytes_accessed": float(
+                    ca.get("bytes accessed", 0.0) or 0.0)}
+        record_cost_card(entry, card)
+        return card
+    except Exception:
+        return None
+
+
+def harvest_compiled(entry: str, compiled, digest: str = "",
+                     source: str = "") -> Optional[Dict[str, Any]]:
+    """Full harvest from a ``Compiled``: cost_analysis FLOPs/bytes plus
+    the :func:`hlo_audit.memory_profile` per-device sizes.  Only called
+    where a compile already happened (contract audit, bench cost-card
+    leg) — never on the aot hot path.  Best-effort, never raises."""
+    if not _enabled:
+        return None
+    try:
+        from pint_tpu.lint import hlo_audit
+
+        ca = _cost_analysis(compiled)
+        mem = hlo_audit.memory_profile(compiled)
+        card = {"entry": entry, "digest": digest, "source": source,
+                "flops": float(ca.get("flops", 0.0) or 0.0),
+                "bytes_accessed": float(
+                    ca.get("bytes accessed", 0.0) or 0.0)}
+        card.update(mem)
+        record_cost_card(entry, card)
+        return card
+    except Exception:
+        return None
+
+
+# --- Prometheus text exposition ----------------------------------------------
+
+def _esc_label(v: Any) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(extra_stats: Optional[Dict[str, Any]] = None
+                      ) -> str:
+    """The registry as Prometheus text exposition (format 0.0.4).
+
+    Families: ``pint_tpu_counter_total{name=}``,
+    ``pint_tpu_gauge{name=}``, ``pint_tpu_span_ms`` histograms
+    (cumulative ``_bucket{le=}`` + ``_sum`` + ``_count``),
+    ``pint_tpu_cost_card_{flops,bytes_accessed,peak_bytes}{entry=,
+    digest=}``, and — when ``extra_stats`` (the serve daemon's
+    ``stats()``) is given — ``pint_tpu_serve_stat{name=}`` gauges for
+    every scalar numeric stat."""
+    snap = snapshot()
+    out: List[str] = []
+
+    def fam(name: str, typ: str, help_: str) -> None:
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {typ}")
+
+    fam("pint_tpu_counter_total", "counter",
+        "pint_tpu.profiling dispatch/runtime counters")
+    for name in sorted(snap["counters"]):
+        out.append('pint_tpu_counter_total{name="%s"} %s'
+                   % (_esc_label(name), _fmt(snap["counters"][name])))
+    fam("pint_tpu_gauge", "gauge", "pint_tpu point-in-time gauges")
+    for name in sorted(snap["gauges"]):
+        out.append('pint_tpu_gauge{name="%s"} %s'
+                   % (_esc_label(name), _fmt(snap["gauges"][name])))
+    fam("pint_tpu_span_ms", "histogram",
+        "telemetry span durations (ms) by span name")
+    for name in sorted(snap["histograms"]):
+        h = snap["histograms"][name]
+        lab = _esc_label(name)
+        cum = 0
+        for le, c in zip(HIST_BUCKETS_MS, h["counts"]):
+            cum += c
+            out.append('pint_tpu_span_ms_bucket{name="%s",le="%s"} %d'
+                       % (lab, _fmt(le), cum))
+        cum += h["counts"][-1]
+        out.append('pint_tpu_span_ms_bucket{name="%s",le="+Inf"} %d'
+                   % (lab, cum))
+        out.append('pint_tpu_span_ms_sum{name="%s"} %s'
+                   % (lab, _fmt(h["sum_ms"])))
+        out.append('pint_tpu_span_ms_count{name="%s"} %d'
+                   % (lab, h["n"]))
+    for field, help_ in (
+            ("flops", "estimated FLOPs per execution"),
+            ("bytes_accessed", "estimated bytes accessed per execution"),
+            ("peak_bytes", "per-device peak memory bound")):
+        mname = f"pint_tpu_cost_card_{field}"
+        fam(mname, "gauge", f"program cost card: {help_}")
+        for card in snap["cost_cards"]:
+            v = card.get(field)
+            if not isinstance(v, (int, float)):
+                continue
+            out.append('%s{entry="%s",digest="%s"} %s'
+                       % (mname, _esc_label(card["entry"]),
+                          _esc_label(card["digest"]), _fmt(v)))
+    if extra_stats is not None:
+        fam("pint_tpu_serve_stat", "gauge",
+            "TimingService.stats() scalar snapshot")
+        for key in sorted(extra_stats):
+            v = extra_stats[key]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            out.append('pint_tpu_serve_stat{name="%s"} %s'
+                       % (_esc_label(key), _fmt(v)))
+    return "\n".join(out) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+"
+    r"(?P<value>[+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|Inf|NaN))$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str],
+                                                         ...]], float]:
+    """Strict parser for the exposition format: every non-comment,
+    non-blank line must be a valid sample.  Returns
+    ``{(metric_name, ((label, value), ...)): float}`` with labels
+    sorted and unescaped.  Raises ``ValueError`` on any malformed
+    line — the bench scrape check rides this."""
+    samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for ln in text.splitlines():
+        if not ln.strip() or ln.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(ln)
+        if m is None:
+            raise ValueError(f"malformed exposition line: {ln!r}")
+        labels: List[Tuple[str, str]] = []
+        raw = m.group("labels")
+        if raw:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(raw):
+                # single-pass unescape: sequential .replace would turn
+                # an escaped backslash followed by 'n' into a newline
+                val = re.sub(
+                    r"\\(.)",
+                    lambda m: {"n": "\n"}.get(m.group(1), m.group(1)),
+                    lm.group(2))
+                labels.append((lm.group(1), val))
+                consumed = lm.end()
+            leftover = raw[consumed:].strip().strip(",")
+            if leftover:
+                raise ValueError(f"malformed labels in: {ln!r}")
+        samples[(m.group("name"), tuple(sorted(labels)))] = float(
+            m.group("value"))
+    return samples
+
+
+# --- the /metrics endpoint ---------------------------------------------------
+
+class Exporter:
+    """An opt-in stdlib HTTP thread serving ``/metrics`` (Prometheus
+    text) and ``/healthz`` (``stats_fn()`` JSON).  Daemon thread: it
+    can never hold a drained process alive; :meth:`stop` shuts it down
+    explicitly (serve exposes that as ``stop_metrics``)."""
+
+    def __init__(self, server, thread) -> None:
+        self._server = server
+        self._thread = thread
+        self.port: int = server.server_address[1]
+        self.url: str = f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+            self._thread.join(timeout=5.0)
+        except Exception:
+            pass
+
+
+def start_exporter(port: Optional[int] = None,
+                   stats_fn: Optional[Callable[[], Dict[str, Any]]]
+                   = None) -> Optional[Exporter]:
+    """Start the metrics endpoint.  ``port`` defaults to
+    ``PINT_TPU_METRICS_PORT`` (unset/empty -> no exporter, the normal
+    library posture); 0 binds an ephemeral port (tests read
+    ``exporter.port``).  Returns None when opted out, disabled, or the
+    bind fails (a second daemon on the same port must not crash the
+    first's host process — the failure is a telemetry warning)."""
+    if port is None:
+        raw = os.environ.get("PINT_TPU_METRICS_PORT", "").strip()
+        if not raw:
+            return None
+        try:
+            port = int(raw)
+        except ValueError:
+            telemetry.warn("metrics.bad_port", value=raw)
+            return None
+    if not _enabled:
+        return None
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):  # noqa: D102 — silence stderr
+            pass
+
+        def do_GET(self):
+            try:
+                if self.path.split("?")[0] == "/metrics":
+                    stats = None
+                    if stats_fn is not None:
+                        try:
+                            stats = stats_fn()
+                        except Exception:
+                            stats = None
+                    body = render_prometheus(stats).encode("utf-8")
+                    ctype = ("text/plain; version=0.0.4; "
+                             "charset=utf-8")
+                elif self.path.split("?")[0] == "/healthz":
+                    doc: Dict[str, Any] = {"ok": True}
+                    if stats_fn is not None:
+                        try:
+                            doc["stats"] = stats_fn()
+                        except Exception as e:
+                            doc = {"ok": False, "error": str(e)}
+                    body = json.dumps(
+                        doc, sort_keys=True).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except Exception:
+                pass  # a broken scrape must never hurt the daemon
+
+    try:
+        server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", int(port)), _Handler)
+    except OSError as e:
+        telemetry.warn("metrics.bind_failed", port=int(port),
+                       error=str(e))
+        return None
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name="pint-tpu-metrics",
+                              kwargs={"poll_interval": 0.2},
+                              daemon=True)
+    thread.start()
+    exp = Exporter(server, thread)
+    telemetry.event("metrics.exporter_started", port=exp.port)
+    return exp
+
+
+# --- bench-history regression gate -------------------------------------------
+
+def load_bench_line(path: str) -> Optional[Dict[str, Any]]:
+    """Load one bench artifact: either a raw bench JSON line or the
+    ``BENCH_r0*.json`` wrapper ``{"n","cmd","rc","tail","parsed"}``
+    (the ``parsed`` payload is the line).  Returns None for an *empty
+    round* (wrapper whose ``parsed`` is null with a clean rc — rounds
+    before bench.py existed); raises ``ValueError`` for anything
+    malformed."""
+    with open(path, "r", encoding="utf-8") as fh:
+        try:
+            doc = json.load(fh)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not JSON ({e})") from None
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: bench artifact must be a JSON object")
+    if "parsed" in doc and "cmd" in doc:        # the wrapper shape
+        parsed = doc["parsed"]
+        if parsed is None:
+            if doc.get("rc", 0) == 0 and not str(
+                    doc.get("tail", "")).strip():
+                return None                     # empty round, skip
+            raise ValueError(
+                f"{path}: wrapper has no parsed payload but a "
+                f"non-clean rc/tail — truncated or hand-edited")
+        if not isinstance(parsed, dict):
+            raise ValueError(f"{path}: wrapper 'parsed' is not an "
+                             f"object")
+        return parsed
+    return doc
+
+
+def check_schema(doc: Dict[str, Any]) -> List[str]:
+    """Problems with one bench line (empty list = valid).  The rule set
+    is the value-or-error contract every round since r02 satisfies:
+    a ``metric`` string, a ``unit`` string, and EITHER a numeric
+    ``value`` OR an ``error`` string (the r05 wedged-tunnel shape);
+    when the newer axes are present they must be well-typed."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["bench line is not a JSON object"]
+    if not isinstance(doc.get("metric"), str):
+        problems.append("missing/non-string 'metric'")
+    if not isinstance(doc.get("unit"), str):
+        problems.append("missing/non-string 'unit'")
+    val = doc.get("value")
+    if not isinstance(val, (int, float)) or isinstance(val, bool):
+        if not isinstance(doc.get("error"), str):
+            problems.append(
+                "neither a numeric 'value' nor an 'error' string")
+    dc = doc.get("dispatch_counters")
+    if dc is not None:
+        if not isinstance(dc, dict):
+            problems.append("'dispatch_counters' is not an object")
+        else:
+            for key in ("compiles", "retraces", "dispatches"):
+                if not isinstance(dc.get(key), int):
+                    problems.append(
+                        f"dispatch_counters.{key} missing/non-int")
+    for key in ("comm_bytes", "all_gather_bytes"):
+        if key in doc and not isinstance(doc[key], int):
+            problems.append(f"'{key}' is not an int")
+    if "submetrics" in doc and not isinstance(doc["submetrics"], dict):
+        problems.append("'submetrics' is not an object")
+    cc = doc.get("cost_cards")
+    if cc is not None:
+        if not isinstance(cc, dict):
+            problems.append("'cost_cards' is not an object")
+        else:
+            for entry, card in cc.items():
+                if not isinstance(card, dict):
+                    problems.append(f"cost_cards.{entry} not an object")
+                    continue
+                for field in ("flops", "bytes_accessed", "peak_bytes"):
+                    if not isinstance(card.get(field), (int, float)):
+                        problems.append(
+                            f"cost_cards.{entry}.{field} "
+                            f"missing/non-numeric")
+    return problems
+
+
+def _num(doc: Dict[str, Any], *path) -> Optional[float]:
+    cur: Any = doc
+    for p in path:
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(p)
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any],
+            tolerance: float = 0.25, p99_tolerance: float = 0.5
+            ) -> List[Dict[str, Any]]:
+    """The regression gate: failures (empty = pass) comparing a new
+    bench line against a prior one.  Axes:
+
+    * headline wall ``value`` — may grow at most ``tolerance``
+      (fractional; walls are noisy, so the default is generous);
+    * steady-state ``compiles`` / ``retraces`` — must be ZERO in the
+      new line whenever it carries dispatch counters (absolute, not
+      relative: the whole point of the warm contract);
+    * ``comm_bytes`` — bounded growth by ``tolerance``;
+    * ``all_gather_bytes`` — must not exceed the old value at all (the
+      no-implicit-gather invariant as a gate);
+    * ``serve_p99_ms`` — bounded growth by ``p99_tolerance``.
+
+    An axis absent from either line is skipped — early rounds carry
+    only the headline, and a gate that fails on *missing history* would
+    make the series un-adoptable."""
+    failures: List[Dict[str, Any]] = []
+
+    def fail(metric: str, old_v, new_v, why: str) -> None:
+        failures.append({"metric": metric, "old": old_v, "new": new_v,
+                         "why": why})
+
+    ov, nv = _num(old, "value"), _num(new, "value")
+    if ov is not None and nv is not None and ov > 0:
+        if nv > ov * (1.0 + tolerance):
+            fail("value", ov, nv,
+                 f"headline wall grew {nv / ov - 1.0:+.1%} "
+                 f"(> +{tolerance:.0%} tolerance)")
+    for counter in ("compiles", "retraces"):
+        nc = _num(new, "dispatch_counters", counter)
+        if nc is not None and nc != 0:
+            fail(f"dispatch_counters.{counter}",
+                 _num(old, "dispatch_counters", counter), nc,
+                 f"steady-state {counter} must stay 0 "
+                 f"(got {int(nc)})")
+    ob, nb = _num(old, "comm_bytes"), _num(new, "comm_bytes")
+    if ob is not None and nb is not None and ob > 0:
+        if nb > ob * (1.0 + tolerance):
+            fail("comm_bytes", ob, nb,
+                 f"collective bytes grew {nb / ob - 1.0:+.1%} "
+                 f"(> +{tolerance:.0%} tolerance)")
+    og = _num(old, "all_gather_bytes")
+    ng = _num(new, "all_gather_bytes")
+    if og is not None and ng is not None and ng > og:
+        fail("all_gather_bytes", og, ng,
+             "all-gather bytes exceeded the prior round "
+             "(no-implicit-gather invariant)")
+    op, np_ = _num(old, "serve_p99_ms"), _num(new, "serve_p99_ms")
+    if op is not None and np_ is not None and op > 0:
+        if np_ > op * (1.0 + p99_tolerance):
+            fail("serve_p99_ms", op, np_,
+                 f"serve p99 grew {np_ / op - 1.0:+.1%} "
+                 f"(> +{p99_tolerance:.0%} tolerance)")
+    return failures
+
+
+# --- CLI ---------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m pint_tpu.metrics compare OLD.json NEW.json`` — the
+    bench-history regression gate.  Exit 0 on pass, 1 on regression
+    (one attribution line per failed metric), 2 on unusable input.
+    ``--schema-only`` validates any number of bench artifacts
+    (including the ``BENCH_r0*.json`` wrappers) without comparing."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m pint_tpu.metrics",
+        description="pint_tpu metrics plane CLI.")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_cmp = sub.add_parser(
+        "compare", help="gate a new bench line against a prior one")
+    p_cmp.add_argument("files", nargs="+",
+                       help="OLD.json NEW.json (or any number of "
+                            "files with --schema-only)")
+    p_cmp.add_argument("--tolerance", type=float, default=0.25,
+                       help="allowed fractional wall/bytes growth "
+                            "(default 0.25)")
+    p_cmp.add_argument("--p99-tolerance", type=float, default=0.5,
+                       help="allowed fractional serve-p99 growth "
+                            "(default 0.5)")
+    p_cmp.add_argument("--schema-only", action="store_true",
+                       help="validate artifact schemas, no diff")
+    ns = parser.parse_args(argv)
+
+    if ns.schema_only:
+        rc = 0
+        for path in ns.files:
+            try:
+                doc = load_bench_line(path)
+            except (OSError, ValueError) as e:
+                print(json.dumps({"file": path, "ok": False,
+                                  "problems": [str(e)]}))
+                rc = 2
+                continue
+            if doc is None:
+                print(json.dumps({"file": path, "ok": True,
+                                  "empty_round": True}))
+                continue
+            problems = check_schema(doc)
+            print(json.dumps({"file": path, "ok": not problems,
+                              "problems": problems}))
+            if problems:
+                rc = 2
+        return rc
+
+    if len(ns.files) != 2:
+        print("compare takes exactly 2 files: OLD.json NEW.json",
+              file=__import__("sys").stderr)
+        return 2
+    docs = []
+    for path in ns.files:
+        try:
+            doc = load_bench_line(path)
+        except (OSError, ValueError) as e:
+            print(json.dumps({"ok": False, "error": str(e)}))
+            return 2
+        if doc is None:
+            print(json.dumps({"ok": False,
+                              "error": f"{path}: empty round has no "
+                                       f"comparable payload"}))
+            return 2
+        problems = check_schema(doc)
+        if problems:
+            print(json.dumps({"ok": False, "file": path,
+                              "problems": problems}))
+            return 2
+        docs.append(doc)
+    failures = compare(docs[0], docs[1], tolerance=ns.tolerance,
+                       p99_tolerance=ns.p99_tolerance)
+    print(json.dumps({"ok": not failures, "old": ns.files[0],
+                      "new": ns.files[1], "failures": failures},
+                     sort_keys=True))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised by CLI tests
+    import sys
+
+    sys.exit(main())

@@ -69,7 +69,8 @@ __all__ = ["enable", "disable", "enabled", "reset", "report", "table",
            "stage", "count", "counters", "snapshot", "counters_since",
            "stages_since", "session", "paused", "trace",
            "Session", "Snapshot", "device_peak_flops", "solve_flops",
-           "mfu_report", "latency_stats"]
+           "mfu_report", "latency_stats", "add_count_hook",
+           "remove_count_hook"]
 
 _enabled = False
 _stages: Dict[str, list] = {}   # name -> [calls, wall_s]
@@ -81,6 +82,10 @@ _lock = threading.Lock()
 #: optional ``(name, n)`` observer set by :mod:`pint_tpu.telemetry` —
 #: called OUTSIDE ``_lock`` so the hook may itself take locks
 _count_hook = None
+#: additional ``(name, n)`` observers (:func:`add_count_hook`) — the
+#: metrics registry rides here so every existing ``count`` site feeds
+#: Prometheus counters with zero per-site edits; same outside-_lock rule
+_count_hooks: list = []
 #: True while a ``trace(logdir)`` profiler session is live; telemetry
 #: spans only enter ``jax.profiler.TraceAnnotation`` when this is set
 _trace_active = False
@@ -171,6 +176,21 @@ def stage(name: str) -> Iterator[None]:
             s[1] += dt
 
 
+def add_count_hook(hook) -> None:
+    """Register an additional ``(name, n)`` counter observer.  Hooks are
+    called OUTSIDE ``_lock``, must never raise, and are deduplicated by
+    identity (idempotent registration across re-imports)."""
+    if hook not in _count_hooks:
+        _count_hooks.append(hook)
+
+
+def remove_count_hook(hook) -> None:
+    try:
+        _count_hooks.remove(hook)
+    except ValueError:
+        pass
+
+
 def count(name: str, n: int = 1) -> None:
     """Increment dispatch counter ``name`` (always on: integers are free,
     and the dispatch-budget tests must not require profiling mode)."""
@@ -179,6 +199,8 @@ def count(name: str, n: int = 1) -> None:
     hook = _count_hook
     if hook is not None:
         hook(name, n)
+    for h in tuple(_count_hooks):
+        h(name, n)
 
 
 def counters() -> Dict[str, int]:

@@ -66,7 +66,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu import aot, faultinject, profiling, runtime, telemetry
+from pint_tpu import (aot, faultinject, metrics, profiling, runtime,
+                      telemetry)
 from pint_tpu.exceptions import (CorrelatedErrors, ServeDrained,
                                  ServeSaturated)
 from pint_tpu.fitter import FitStatus, _default_wls_kernel
@@ -293,6 +294,11 @@ class TimingService:
         self._draining = False
         self._latencies: deque = deque(maxlen=4096)
         self._stats = self._zero_stats()
+        # metrics plane (ISSUE 13): opt-in /metrics + /healthz endpoint
+        # (PINT_TPU_METRICS_PORT; port 0 -> ephemeral).  None when the
+        # env knob is unset — the normal library posture
+        self._metrics_exporter = metrics.start_exporter(
+            stats_fn=self.stats)
 
     @staticmethod
     def _zero_stats() -> dict:
@@ -386,7 +392,9 @@ class TimingService:
             self._stats["submitted"] += 1
             profiling.count("serve.submit")
             self._cond.notify_all()
-        telemetry.event("serve.admit", job=job.name,
+        # positional-only event() (ISSUE 13 satellite): an attr named
+        # ``name`` no longer collides with the event's own name
+        telemetry.event("serve.admit", name=job.name,
                         trace_id=fut.trace_id)
         return fut
 
@@ -730,9 +738,27 @@ class TimingService:
         else:
             self.flush(reason="drain")
         self._maybe_write_stats(force=True)
+        # the exporter deliberately lives past drain: a supervisor's
+        # last scrape sees the final snapshot.  stop_metrics() (or
+        # process exit — daemon thread) closes it.
         return self.stats()
 
     # -- observability ---------------------------------------------------------
+
+    def stop_metrics(self) -> None:
+        """Shut the /metrics endpoint down (a no-op when the exporter
+        was never started)."""
+        exp = self._metrics_exporter
+        self._metrics_exporter = None
+        if exp is not None:
+            exp.stop()
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        """The bound /metrics port, or None when the exporter is off
+        (tests bind port 0 and read the ephemeral port back here)."""
+        exp = self._metrics_exporter
+        return exp.port if exp is not None else None
 
     def stats(self) -> dict:
         """Thread-safe snapshot: counters, latency percentiles and the

@@ -68,8 +68,9 @@ from pint_tpu import profiling
 __all__ = ["enable", "disable", "enabled", "span", "event", "warn",
            "new_trace_id", "trace_context", "current_trace_id",
            "events", "clear", "dump", "dump_on_failure", "load_dump",
-           "summarize", "to_chrome_trace", "write_stats", "read_stats",
-           "install_excepthook", "main"]
+           "list_dumps", "summarize", "to_chrome_trace", "write_stats",
+           "read_stats", "install_excepthook", "main",
+           "add_span_end_hook", "remove_span_end_hook"]
 
 DUMP_KIND = "pint_tpu.telemetry.flight"
 STATS_KIND = "pint_tpu.telemetry.stats"
@@ -147,8 +148,12 @@ def _jsonable(v: Any) -> Any:
     return repr(v)
 
 
-def event(name: str, *, kind: str = "I", **attrs) -> None:
-    """Record an instant event (``kind='I'``) or warning (``'W'``)."""
+def event(name: str, /, *, kind: str = "I", **attrs) -> None:
+    """Record an instant event (``kind='I'``) or warning (``'W'``).
+
+    ``name`` is positional-only (the PR 10 gotcha): an attribute
+    literally named ``name`` — e.g. a job name at serve admission —
+    lands in ``attrs`` instead of colliding with the event name."""
     if not _enabled:
         return
     ev: Dict[str, Any] = {"ev": kind, "t": round(time.monotonic(), 6),
@@ -160,7 +165,7 @@ def event(name: str, *, kind: str = "I", **attrs) -> None:
     _emit(ev)
 
 
-def warn(name: str, **attrs) -> None:
+def warn(name: str, /, **attrs) -> None:
     """Record a warning event — the "what was wrong just before the
     crash" channel the dump summary surfaces first."""
     event(name, kind="W", **attrs)
@@ -178,9 +183,29 @@ def _on_count(name: str, n: int) -> None:
 
 profiling._count_hook = _on_count
 
+#: span-end observers (:func:`add_span_end_hook`): called with
+#: ``(name, dur_ms, err)`` after the E event is recorded — the metrics
+#: registry rides here so every span feeds a latency histogram with
+#: zero per-site edits.  Hooks must be cheap and must never raise.
+_span_end_hooks: list = []
+
+
+def add_span_end_hook(hook) -> None:
+    """Register a ``(name, dur_ms, err)`` span-end observer
+    (deduplicated by identity; idempotent across re-imports)."""
+    if hook not in _span_end_hooks:
+        _span_end_hooks.append(hook)
+
+
+def remove_span_end_hook(hook) -> None:
+    try:
+        _span_end_hooks.remove(hook)
+    except ValueError:
+        pass
+
 
 @contextlib.contextmanager
-def span(name: str, **attrs) -> Iterator[None]:
+def span(name: str, /, **attrs) -> Iterator[None]:
     """Record a nested begin/end span around the block.
 
     Contract-neutral by construction: entry/exit each append one dict
@@ -237,6 +262,11 @@ def span(name: str, **attrs) -> Iterator[None]:
         if err is not None:
             end["err"] = err
         _emit(end)
+        for hook in tuple(_span_end_hooks):
+            try:
+                hook(name, end["dur_ms"], err)
+            except Exception:
+                pass
 
 
 def events() -> List[Dict[str, Any]]:
@@ -252,6 +282,18 @@ def clear() -> None:
 
 # --- flight-recorder dump ----------------------------------------------------
 
+#: process-global sequence for env-routed dumps: each failure dump gets
+#: a unique ``.<reason>.<seq>`` suffix so a cascade (ServeDrained, then
+#: the SIGTERM superset from ``runtime.SignalFlush``) leaves EVERY dump
+#: on disk instead of the last overwriting the rest
+_dump_seq = itertools.count(1)
+
+
+def _safe_reason(reason: str) -> str:
+    return "".join(c if c.isalnum() or c in "_-" else "_"
+                   for c in str(reason)) or "dump"
+
+
 def dump(path: Optional[str] = None, reason: str = "manual"
          ) -> Optional[str]:
     """Write the ring as CRC-checksummed JSONL (atomic tmp+replace,
@@ -259,9 +301,16 @@ def dump(path: Optional[str] = None, reason: str = "manual"
     so a broken jax install cannot take the black box down with it).
 
     ``path`` defaults to ``PINT_TPU_TELEMETRY_DUMP``; returns the path
-    written, or None (no-op) when neither is set."""
+    written, or None (no-op) when neither is set.  An explicit ``path``
+    is written exactly there; the env default is suffixed
+    ``.<reason>.<seq>`` so cascading failure dumps (a drain dump, then
+    the SIGTERM superset at the same configured path) all survive —
+    :func:`load_dump` on the bare configured path resolves the newest."""
     if path is None:
-        path = os.environ.get("PINT_TPU_TELEMETRY_DUMP") or None
+        base = os.environ.get("PINT_TPU_TELEMETRY_DUMP") or None
+        if not base:
+            return None
+        path = f"{base}.{_safe_reason(reason)}.{next(_dump_seq)}"
     if not path:
         return None
     evs = events()
@@ -295,10 +344,36 @@ def dump_on_failure(reason: str) -> Optional[str]:
         return None
 
 
+def list_dumps(base: str) -> List[str]:
+    """All ``<base>.<reason>.<seq>`` dumps next to the configured base
+    path, oldest first (by sequence number, then name — the sequence is
+    per-process, so a spool/resume pair interleaves by name)."""
+    d = os.path.dirname(base) or "."
+    prefix = os.path.basename(base) + "."
+    found = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        if not name.startswith(prefix):
+            continue
+        rest = name[len(prefix):].rsplit(".", 1)
+        if len(rest) == 2 and rest[1].isdigit():
+            found.append((int(rest[1]), name))
+    return [os.path.join(d, name) for _, name in sorted(found)]
+
+
 def load_dump(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     """Read and CRC-verify a recorder dump -> (header, events).
     Raises ``ValueError`` on a missing/mismatched checksum or a foreign
-    file."""
+    file.  When ``path`` is the bare configured base (no file there but
+    suffixed ``.<reason>.<seq>`` siblings exist — the env-routed dump
+    cascade), the NEWEST sibling is loaded."""
+    if not os.path.exists(path):
+        sibs = list_dumps(path)
+        if sibs:
+            path = sibs[-1]
     with open(path, "r", encoding="utf-8") as fh:
         lines = fh.read().splitlines(keepends=True)
     if not lines:

@@ -24,6 +24,9 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(
 def _run_quick(env_extra=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # hermetic: a metrics endpoint inherited from the caller's shell
+    # would flip the line's metrics_scrape from its default None
+    env.pop("PINT_TPU_METRICS_PORT", None)
     env.update(env_extra or {})
     # quick mode must not touch the (possibly wedged) accelerator or
     # depend on a warm XLA cache
@@ -139,6 +142,28 @@ def _assert_schema(d, fast=False):
     assert sf["pending"] == 0, sf
     assert isinstance(sf["stats_file_writes"], int)
     assert sf["stats_file_writes"] >= 1, sf
+    # cost-card axis (ISSUE 13): per-entrypoint compiled-program cost
+    # (FLOPs, bytes accessed, per-device peak bytes) in the line, so a
+    # program suddenly costing more shows up in the series even when
+    # the wall hides it
+    cc = d.get("cost_cards")
+    assert isinstance(cc, dict), d.get("cost_cards")
+    sub_cc = d["submetrics"].get("cost_cards")
+    assert isinstance(sub_cc, dict) and "error" not in sub_cc, sub_cc
+    for entry in ("residuals", "fused_fit", "fleet_bucket",
+                  "serve_bucket"):
+        card = cc.get(entry)
+        assert isinstance(card, dict), (entry, cc)
+        for field in ("flops", "bytes_accessed", "peak_bytes"):
+            assert isinstance(card.get(field), (int, float)), \
+                (entry, field, card)
+        assert card["peak_bytes"] > 0, (entry, card)
+    # the callable entrypoints also carry achieved FLOP/s
+    assert cc["residuals"].get("exec_wall_s", 0) > 0, cc["residuals"]
+    assert "device_peak_flops" in d          # None on CPU is fine
+    # /metrics scrape: None unless PINT_TPU_METRICS_PORT opted in (the
+    # slow TestMetricsEndpoint leg exercises the exporter-on path)
+    assert sv.get("metrics_scrape") is None, sv.get("metrics_scrape")
 
 
 def test_quick_steady_state_never_recompiles(quick_line):

@@ -1,0 +1,527 @@
+"""Unit coverage for the metrics plane (ISSUE 13): the lock-guarded
+registry and its zero-per-site-edit feeds (profiling count hook,
+telemetry span-end hook), Prometheus text exposition and the strict
+parser, program cost cards, the /metrics HTTP exporter, and the
+bench-history regression gate (loader, schema check, compare axes,
+CLI exit codes).  These are the cheap tier-1 legs; the bench
+--compare subprocess depth legs ride the slow ``test_tooling.py``
+(``TestMetricsGate`` / ``TestMetricsEndpoint``)."""
+
+import json
+import math
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pint_tpu import metrics, profiling, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Each test starts with an empty, enabled registry (and an enabled
+    telemetry ring, which drives the span-end feed) and restores the
+    module-global switches on the way out."""
+    was_m, was_t = metrics.enabled(), telemetry.enabled()
+    metrics.enable()
+    metrics.reset()
+    telemetry.enable()
+    telemetry.clear()
+    yield
+    metrics.reset()
+    telemetry.clear()
+    (metrics.enable if was_m else metrics.disable)()
+    (telemetry.enable if was_t else telemetry.disable)()
+
+
+class TestRegistry:
+    def test_counters_and_gauges(self):
+        metrics.inc("unit.ctr")
+        metrics.inc("unit.ctr", 4)
+        metrics.set_gauge("unit.g", 2.5)
+        snap = metrics.snapshot()
+        assert snap["counters"]["unit.ctr"] == 5
+        assert snap["gauges"]["unit.g"] == 2.5
+
+    def test_histogram_bucket_placement(self):
+        metrics.observe("unit.h", 0.05)      # below the 2^-4 floor
+        metrics.observe("unit.h", 0.0625)    # exactly on a boundary
+        metrics.observe("unit.h", 3.0)       # between 2 and 4
+        metrics.observe("unit.h", 1e9)       # above the top -> +Inf
+        h = metrics.snapshot()["histograms"]["unit.h"]
+        assert h["n"] == 4
+        assert h["sum_ms"] == pytest.approx(0.05 + 0.0625 + 3.0 + 1e9)
+        buckets = dict(zip(metrics.HIST_BUCKETS_MS, h["counts"]))
+        assert buckets[0.0625] == 2          # le is inclusive
+        assert buckets[4.0] == 1
+        assert h["counts"][-1] == 1          # the +Inf slot
+
+    def test_non_finite_observations_dropped(self):
+        metrics.observe("unit.h", float("nan"))
+        metrics.observe("unit.h", float("inf"))
+        assert "unit.h" not in metrics.snapshot()["histograms"]
+
+    def test_reset_clears_everything(self):
+        metrics.inc("unit.ctr")
+        metrics.set_gauge("unit.g", 1)
+        metrics.observe("unit.h", 1.0)
+        metrics.record_cost_card("unit", {"digest": "d", "flops": 1.0})
+        metrics.reset()
+        snap = metrics.snapshot()
+        assert snap == {"counters": {}, "gauges": {}, "histograms": {},
+                        "cost_cards": []}
+
+    def test_master_switch(self):
+        metrics.disable()
+        assert not metrics.enabled()
+        metrics.inc("unit.off")
+        metrics.set_gauge("unit.off", 1)
+        metrics.observe("unit.off", 1.0)
+        metrics.enable()
+        snap = metrics.snapshot()
+        assert snap["counters"] == {} and snap["gauges"] == {} \
+            and snap["histograms"] == {}
+
+
+class TestFeeds:
+    def test_profiling_count_feeds_counter(self):
+        profiling.count("unit.fed", 3)
+        profiling.count("unit.fed")
+        assert metrics.snapshot()["counters"]["unit.fed"] == 4
+
+    def test_span_feeds_histogram(self):
+        with telemetry.span("unit.spanned"):
+            pass
+        h = metrics.snapshot()["histograms"]["unit.spanned"]
+        assert h["n"] == 1 and h["sum_ms"] >= 0.0
+        assert "span_errors.unit.spanned" not in \
+            metrics.snapshot()["counters"]
+
+    def test_errored_span_bumps_error_counter(self):
+        with pytest.raises(RuntimeError):
+            with telemetry.span("unit.boom"):
+                raise RuntimeError("boom")
+        snap = metrics.snapshot()
+        assert snap["histograms"]["unit.boom"]["n"] == 1
+        assert snap["counters"]["span_errors.unit.boom"] == 1
+
+    def test_disabled_metrics_ignores_feeds(self):
+        metrics.disable()
+        profiling.count("unit.ghost")
+        with telemetry.span("unit.ghost_span"):
+            pass
+        metrics.enable()
+        snap = metrics.snapshot()
+        assert "unit.ghost" not in snap["counters"]
+        assert "unit.ghost_span" not in snap["histograms"]
+
+
+class TestCostCards:
+    def test_record_and_sorted_listing(self):
+        metrics.record_cost_card("b_entry", {"digest": "d1",
+                                             "flops": 2.0})
+        metrics.record_cost_card("a_entry", {"digest": "d2",
+                                             "flops": 1.0})
+        cards = metrics.cost_cards()
+        assert [c["entry"] for c in cards] == ["a_entry", "b_entry"]
+
+    def test_merge_prefers_nonzero(self):
+        """The counter-neutral aot harvest carries flops but no memory
+        peak; the later audit harvest must fill the peak in without a
+        zero field erasing the known flops."""
+        metrics.record_cost_card(
+            "e", {"digest": "d", "flops": 100.0, "peak_bytes": 0})
+        metrics.record_cost_card(
+            "e", {"digest": "d", "flops": 0.0, "peak_bytes": 4096})
+        (card,) = metrics.cost_cards()
+        assert card["flops"] == 100.0
+        assert card["peak_bytes"] == 4096
+
+    def test_distinct_digests_are_distinct_cards(self):
+        metrics.record_cost_card("e", {"digest": "d1", "flops": 1.0})
+        metrics.record_cost_card("e", {"digest": "d2", "flops": 2.0})
+        assert len(metrics.cost_cards()) == 2
+
+    def test_harvest_lowered_is_counter_neutral(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.lint import tracehooks
+
+        fn = jax.jit(lambda x: jnp.sin(x) * 2.0)
+        lowered = fn.lower(jnp.ones(8))
+        with tracehooks.instrument() as rec:
+            card = metrics.harvest_lowered("unit_fn", lowered,
+                                           digest="abc",
+                                           source="test")
+        counters = rec.counters()
+        assert counters.compiles == 0
+        assert counters.retraces == ()
+        assert card is not None and card["entry"] == "unit_fn"
+        assert card["flops"] >= 0.0
+        assert metrics.cost_cards()[0]["digest"] == "abc"
+
+    def test_harvest_compiled_adds_memory_profile(self):
+        import jax
+        import jax.numpy as jnp
+
+        compiled = jax.jit(
+            lambda x: jnp.sin(x) * 2.0).lower(jnp.ones(8)).compile()
+        card = metrics.harvest_compiled("unit_fn", compiled,
+                                        digest="abc", source="test")
+        assert card is not None
+        assert "peak_bytes" in card
+        assert isinstance(card["peak_bytes"], int)
+
+    def test_harvest_never_raises(self):
+        assert metrics.harvest_lowered("e", object()) is not None
+        assert metrics.harvest_compiled("e", object()) is not None
+
+    def test_harvest_disabled_returns_none(self):
+        metrics.disable()
+        assert metrics.harvest_lowered("e", object()) is None
+        metrics.enable()
+
+
+class TestExposition:
+    def test_roundtrip(self):
+        metrics.inc("unit.ctr", 3)
+        metrics.set_gauge("unit.g", 1.5)
+        metrics.observe("unit.h", 3.0)
+        metrics.record_cost_card("resid", {"digest": "beef",
+                                           "flops": 1e6,
+                                           "bytes_accessed": 2048.0,
+                                           "peak_bytes": 4096})
+        text = metrics.render_prometheus(
+            extra_stats={"completed": 7, "ok": True, "label": "x"})
+        parsed = metrics.parse_prometheus(text)
+        assert parsed[("pint_tpu_counter_total",
+                       (("name", "unit.ctr"),))] == 3
+        assert parsed[("pint_tpu_gauge", (("name", "unit.g"),))] == 1.5
+        assert parsed[("pint_tpu_span_ms_count",
+                       (("name", "unit.h"),))] == 1
+        assert parsed[("pint_tpu_span_ms_sum",
+                       (("name", "unit.h"),))] == 3.0
+        assert parsed[("pint_tpu_cost_card_flops",
+                       (("digest", "beef"), ("entry", "resid")))] == 1e6
+        assert parsed[("pint_tpu_cost_card_peak_bytes",
+                       (("digest", "beef"), ("entry", "resid")))] == 4096
+        # bools and strings are excluded from serve stats
+        assert parsed[("pint_tpu_serve_stat",
+                       (("name", "completed"),))] == 7
+        assert not any(lbls == (("name", "ok"),) or
+                       lbls == (("name", "label"),)
+                       for (n, lbls) in parsed if n ==
+                       "pint_tpu_serve_stat")
+
+    def test_histogram_buckets_are_cumulative_with_inf(self):
+        metrics.observe("unit.h", 0.05)
+        metrics.observe("unit.h", 1e9)
+        text = metrics.render_prometheus()
+        parsed = metrics.parse_prometheus(text)
+        first = parsed[("pint_tpu_span_ms_bucket",
+                        (("le", "0.0625"), ("name", "unit.h")))]
+        last_finite = parsed[("pint_tpu_span_ms_bucket",
+                              (("le", metrics._fmt(
+                                  metrics.HIST_BUCKETS_MS[-1])),
+                               ("name", "unit.h")))]
+        inf = parsed[("pint_tpu_span_ms_bucket",
+                      (("le", "+Inf"), ("name", "unit.h")))]
+        assert first == 1 and last_finite == 1 and inf == 2
+
+    def test_label_escaping_roundtrip(self):
+        nasty = 'we"ird\\name\nwith everything'
+        metrics.inc(nasty)
+        parsed = metrics.parse_prometheus(metrics.render_prometheus())
+        assert parsed[("pint_tpu_counter_total",
+                       (("name", nasty),))] == 1
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError, match="malformed"):
+            metrics.parse_prometheus("this is not exposition\n")
+        with pytest.raises(ValueError, match="malformed"):
+            metrics.parse_prometheus('m{name=unquoted} 1\n')
+
+    def test_parse_accepts_comments_and_blanks(self):
+        parsed = metrics.parse_prometheus(
+            "# HELP m help\n# TYPE m counter\n\nm 4\n")
+        assert parsed == {("m", ()): 4.0}
+
+
+class TestExporter:
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=10.0) as r:
+            return r.headers.get("Content-Type"), r.read().decode()
+
+    def test_endpoint_serves_metrics_and_healthz(self):
+        metrics.inc("unit.served", 2)
+        exp = metrics.start_exporter(
+            port=0, stats_fn=lambda: {"completed": 5})
+        assert exp is not None
+        try:
+            ctype, body = self._get(exp.url + "/metrics")
+            assert ctype.startswith("text/plain")
+            parsed = metrics.parse_prometheus(body)
+            assert parsed[("pint_tpu_counter_total",
+                           (("name", "unit.served"),))] == 2
+            assert parsed[("pint_tpu_serve_stat",
+                           (("name", "completed"),))] == 5
+            ctype, body = self._get(exp.url + "/healthz")
+            assert ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["ok"] is True
+            assert doc["stats"] == {"completed": 5}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(exp.url + "/nope")
+            assert ei.value.code == 404
+        finally:
+            exp.stop()
+
+    def test_healthz_reports_broken_stats_fn(self):
+        def boom():
+            raise RuntimeError("stats broke")
+
+        exp = metrics.start_exporter(port=0, stats_fn=boom)
+        try:
+            _, body = self._get(exp.url + "/healthz")
+            doc = json.loads(body)
+            assert doc["ok"] is False and "stats broke" in doc["error"]
+            # a broken stats_fn must not break the scrape either
+            _, body = self._get(exp.url + "/metrics")
+            metrics.parse_prometheus(body)
+        finally:
+            exp.stop()
+
+    def test_env_opt_in_contract(self, monkeypatch):
+        monkeypatch.delenv("PINT_TPU_METRICS_PORT", raising=False)
+        assert metrics.start_exporter() is None      # unset -> off
+        monkeypatch.setenv("PINT_TPU_METRICS_PORT", "")
+        assert metrics.start_exporter() is None      # empty -> off
+        monkeypatch.setenv("PINT_TPU_METRICS_PORT", "not-a-port")
+        assert metrics.start_exporter() is None      # bad -> warn, off
+        monkeypatch.setenv("PINT_TPU_METRICS_PORT", "0")
+        exp = metrics.start_exporter()
+        try:
+            assert exp is not None and exp.port > 0
+        finally:
+            exp.stop()
+
+    def test_disabled_means_no_exporter(self):
+        metrics.disable()
+        assert metrics.start_exporter(port=0) is None
+        metrics.enable()
+
+    def test_bind_conflict_returns_none(self):
+        exp = metrics.start_exporter(port=0)
+        try:
+            assert metrics.start_exporter(port=exp.port) is None
+        finally:
+            exp.stop()
+
+
+class TestBenchLoader:
+    def test_raw_line_passthrough(self, tmp_path):
+        p = tmp_path / "line.json"
+        p.write_text(json.dumps({"metric": "m", "unit": "s",
+                                 "value": 1.0}))
+        assert metrics.load_bench_line(str(p))["value"] == 1.0
+
+    def test_wrapper_unwraps_parsed(self, tmp_path):
+        p = tmp_path / "wrap.json"
+        p.write_text(json.dumps({"n": 4, "cmd": "bench", "rc": 0,
+                                 "tail": "x",
+                                 "parsed": {"metric": "m", "unit": "s",
+                                            "value": 2.0}}))
+        assert metrics.load_bench_line(str(p))["value"] == 2.0
+
+    def test_empty_round_returns_none(self, tmp_path):
+        p = tmp_path / "r01.json"
+        p.write_text(json.dumps({"n": 1, "cmd": "", "rc": 0,
+                                 "tail": "", "parsed": None}))
+        assert metrics.load_bench_line(str(p)) is None
+
+    def test_truncated_wrapper_raises(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"n": 1, "cmd": "bench", "rc": 1,
+                                 "tail": "Traceback", "parsed": None}))
+        with pytest.raises(ValueError, match="truncated"):
+            metrics.load_bench_line(str(p))
+
+    def test_non_json_raises(self, tmp_path):
+        p = tmp_path / "garbage.json"
+        p.write_text("{not json")
+        with pytest.raises(ValueError, match="not JSON"):
+            metrics.load_bench_line(str(p))
+
+    def test_repo_artifacts_all_load(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(metrics.__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "BENCH_r0*.json")))
+        assert paths, "no BENCH_r0*.json artifacts found"
+        for p in paths:
+            doc = metrics.load_bench_line(p)     # must not raise
+            if doc is not None:
+                assert metrics.check_schema(doc) == []
+
+
+class TestSchema:
+    def _ok(self):
+        return {"metric": "m", "unit": "s", "value": 1.0}
+
+    def test_valid_minimal(self):
+        assert metrics.check_schema(self._ok()) == []
+
+    def test_error_line_is_valid(self):
+        assert metrics.check_schema(
+            {"metric": "m", "unit": "s", "value": None,
+             "error": "wedged"}) == []
+
+    def test_missing_value_and_error_flagged(self):
+        probs = metrics.check_schema({"metric": "m", "unit": "s"})
+        assert any("value" in p for p in probs)
+
+    def test_bad_dispatch_counters_flagged(self):
+        doc = self._ok()
+        doc["dispatch_counters"] = {"compiles": "zero"}
+        probs = metrics.check_schema(doc)
+        assert any("compiles" in p for p in probs)
+        assert any("retraces" in p for p in probs)
+
+    def test_bad_cost_card_flagged(self):
+        doc = self._ok()
+        doc["cost_cards"] = {"resid": {"flops": 1.0}}
+        probs = metrics.check_schema(doc)
+        assert any("resid.bytes_accessed" in p for p in probs)
+        assert any("resid.peak_bytes" in p for p in probs)
+
+
+class TestCompare:
+    def _line(self, **kw):
+        doc = {"metric": "m", "unit": "s", "value": 1.0}
+        doc.update(kw)
+        return doc
+
+    def test_self_compare_passes(self):
+        line = self._line(
+            dispatch_counters={"compiles": 0, "retraces": 0,
+                               "dispatches": 5},
+            comm_bytes=1000, all_gather_bytes=0, serve_p99_ms=20.0)
+        assert metrics.compare(line, line) == []
+
+    def test_headline_growth_within_tolerance_passes(self):
+        assert metrics.compare(self._line(value=1.0),
+                               self._line(value=1.2)) == []
+
+    def test_headline_growth_fails_with_attribution(self):
+        (f,) = metrics.compare(self._line(value=1.0),
+                               self._line(value=2.0))
+        assert f["metric"] == "value"
+        assert "tolerance" in f["why"]
+        assert f["old"] == 1.0 and f["new"] == 2.0
+
+    def test_retraces_must_stay_zero_absolute(self):
+        old = self._line()                   # no counters in history
+        new = self._line(dispatch_counters={"compiles": 0,
+                                            "retraces": 2,
+                                            "dispatches": 5})
+        (f,) = metrics.compare(old, new)
+        assert f["metric"] == "dispatch_counters.retraces"
+        assert "must stay 0" in f["why"]
+
+    def test_compiles_must_stay_zero(self):
+        new = self._line(dispatch_counters={"compiles": 1,
+                                            "retraces": 0,
+                                            "dispatches": 5})
+        (f,) = metrics.compare(self._line(), new)
+        assert f["metric"] == "dispatch_counters.compiles"
+
+    def test_comm_bytes_growth_fails(self):
+        (f,) = metrics.compare(self._line(comm_bytes=1000),
+                               self._line(comm_bytes=2000))
+        assert f["metric"] == "comm_bytes"
+
+    def test_all_gather_bytes_any_growth_fails(self):
+        (f,) = metrics.compare(self._line(all_gather_bytes=0),
+                               self._line(all_gather_bytes=1))
+        assert f["metric"] == "all_gather_bytes"
+        assert "no-implicit-gather" in f["why"]
+
+    def test_serve_p99_growth_fails(self):
+        (f,) = metrics.compare(self._line(serve_p99_ms=10.0),
+                               self._line(serve_p99_ms=16.0))
+        assert f["metric"] == "serve_p99_ms"
+
+    def test_absent_axes_are_skipped(self):
+        # early rounds carry only the headline: a richer new line must
+        # not fail on missing history, and vice versa
+        old = self._line()
+        new = self._line(comm_bytes=10 ** 9, serve_p99_ms=10.0,
+                         dispatch_counters={"compiles": 0,
+                                            "retraces": 0,
+                                            "dispatches": 1})
+        assert metrics.compare(old, new) == []
+        assert metrics.compare(new, old) == []
+
+    def test_tolerances_are_tunable(self):
+        old, new = self._line(value=1.0), self._line(value=1.4)
+        assert metrics.compare(old, new, tolerance=0.5) == []
+        assert metrics.compare(old, new, tolerance=0.1) != []
+
+
+class TestCLI:
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_compare_pass_exit_0(self, tmp_path, capsys):
+        p = self._write(tmp_path, "a.json",
+                        {"metric": "m", "unit": "s", "value": 1.0})
+        assert metrics.main(["compare", p, p]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is True and out["failures"] == []
+
+    def test_compare_regression_exit_1_with_attribution(
+            self, tmp_path, capsys):
+        old = self._write(tmp_path, "old.json",
+                          {"metric": "m", "unit": "s", "value": 1.0})
+        new = self._write(
+            tmp_path, "new.json",
+            {"metric": "m", "unit": "s", "value": 1.0,
+             "dispatch_counters": {"compiles": 0, "retraces": 3,
+                                   "dispatches": 9}})
+        assert metrics.main(["compare", old, new]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is False
+        assert out["failures"][0]["metric"] \
+            == "dispatch_counters.retraces"
+
+    def test_compare_unusable_input_exit_2(self, tmp_path, capsys):
+        good = self._write(tmp_path, "good.json",
+                           {"metric": "m", "unit": "s", "value": 1.0})
+        bad = tmp_path / "bad.json"
+        bad.write_text("{nope")
+        assert metrics.main(["compare", good, str(bad)]) == 2
+        assert metrics.main(["compare", good]) == 2   # needs 2 files
+        capsys.readouterr()
+
+    def test_schema_only_exit_codes(self, tmp_path, capsys):
+        good = self._write(tmp_path, "good.json",
+                           {"metric": "m", "unit": "s", "value": 1.0})
+        empty = self._write(tmp_path, "empty.json",
+                            {"n": 1, "cmd": "", "rc": 0, "tail": "",
+                             "parsed": None})
+        assert metrics.main(["compare", "--schema-only", good,
+                             empty]) == 0
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.splitlines()]
+        assert [d["ok"] for d in lines] == [True, True]
+        assert lines[1]["empty_round"] is True
+        bad = self._write(tmp_path, "bad.json",
+                          {"metric": 7, "unit": "s", "value": 1.0})
+        assert metrics.main(["compare", "--schema-only", good,
+                             str(bad)]) == 2
+        capsys.readouterr()

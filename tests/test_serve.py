@@ -125,6 +125,44 @@ class TestInlinePath:
         # the final steady batch's span names exactly the admitted ids
         assert set(spans[-1]["attrs"]["traces"]) <= admitted_ids
 
+    def test_contract_holds_with_metrics_exporter_running(
+            self, demo, monkeypatch):
+        """ISSUE 13 acceptance: the serve steady-state budget (0
+        compiles / 0 retraces / 1 dispatch) holds with the /metrics
+        exporter RUNNING, and a live scrape parses strictly and agrees
+        with stats()."""
+        import urllib.request
+
+        from pint_tpu import metrics
+        from pint_tpu.lint.contracts import steady_state_counters
+
+        _, jobs, ctrl = demo
+        monkeypatch.setenv("PINT_TPU_METRICS_PORT", "0")
+        svc = _fresh()
+        try:
+            assert svc.metrics_port is not None
+
+            def call():
+                futs = [svc.submit_prepared(j) for j in jobs]
+                svc.flush()
+                return [f.result(timeout=600.0).chi2 for f in futs]
+
+            _, steady = steady_state_counters(call, warmup=1)
+            assert steady.compiles == 0, steady
+            assert steady.retraces == (), steady.retraces
+            assert steady.dispatches == 1, steady
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{svc.metrics_port}/metrics",
+                timeout=10).read().decode("utf-8")
+            parsed = metrics.parse_prometheus(body)
+            st = svc.stats()
+            assert parsed[("pint_tpu_serve_stat",
+                           (("name", "completed"),))] \
+                == st["completed"]
+        finally:
+            svc.stop_metrics()
+            svc.drain(timeout=60.0)
+
     def test_drained_service_closes_admission(self, demo):
         _, jobs, _ = demo
         svc = _fresh()
@@ -261,11 +299,15 @@ class TestGracefulDrain:
                     svc.flush()
         finally:
             (telemetry.enable if was else telemetry.disable)()
+        # the drain dumps twice at the same configured path: at the
+        # ServeDrained raise, then again (superset ring) when
+        # SignalFlush exits — BOTH survive as uniquely-suffixed files,
+        # and the bare base resolves to the newest (the signal superset)
+        dumps = telemetry.list_dumps(dump_p)
+        reasons = [telemetry.load_dump(p)[0]["reason"] for p in dumps]
+        assert reasons == ["ServeDrained", "signal_15"]
         header, evs = telemetry.load_dump(dump_p)   # CRC-verified
-        # the drain dumps twice at the same path: at the ServeDrained
-        # raise, then again (superset ring) when SignalFlush exits —
-        # the survivor is the later signal dump
-        assert header["reason"] in ("ServeDrained", "signal_15")
+        assert header["reason"] == "signal_15"
         spools = [e for e in evs if e.get("ev") == "B"
                   and e.get("name") == "serve.spool"]
         assert len(spools) == 1
@@ -279,8 +321,7 @@ class TestGracefulDrain:
         # fires inside SignalFlush.__exit__, before the span closes)
         s = telemetry.summarize(evs)
         assert s["warnings"] and "serve.spool" in s["spans"]
-        if header["reason"] == "signal_15":
-            assert "serve.flush" in [o["name"] for o in s["open_spans"]]
+        assert "serve.flush" in [o["name"] for o in s["open_spans"]]
 
     def test_resume_rejects_crc_mismatch_and_missing_jobs(
             self, demo, tmp_path):

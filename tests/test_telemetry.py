@@ -104,6 +104,83 @@ class TestSpans:
         assert after_b["parent"] is None
 
 
+class TestNameAttrCollision:
+    """Satellite regression (the PR 10 gotcha): an attribute literally
+    named ``name`` must land in ``attrs``, not collide with the
+    positional-only event/span name."""
+
+    def test_event_with_name_attr(self):
+        telemetry.event("serve.admit", name="J1909-3744", n=1)
+        ev = telemetry.events()[0]
+        assert ev["name"] == "serve.admit"
+        assert ev["attrs"] == {"name": "J1909-3744", "n": 1}
+
+    def test_warn_and_span_with_name_attr(self):
+        telemetry.warn("unit.warned", name="attr-name")
+        with telemetry.span("unit.spanned", name="attr-name"):
+            pass
+        w, b, e = telemetry.events()
+        assert w["name"] == "unit.warned"
+        assert w["attrs"] == {"name": "attr-name"}
+        assert b["name"] == "unit.spanned"
+        assert b["attrs"] == {"name": "attr-name"}
+
+    def test_name_is_not_a_keyword(self):
+        with pytest.raises(TypeError):
+            telemetry.event(name="unit.kw")  # noqa — the point
+
+
+class TestEdgeCases:
+    """Satellite: the ring/dump edge shapes a crash can produce."""
+
+    def test_chrome_trace_of_empty_ring(self):
+        doc = telemetry.to_chrome_trace([])
+        assert doc["traceEvents"] == []
+        json.dumps(doc)
+
+    def test_dump_with_only_open_spans(self, tmp_path):
+        # a process killed mid-dispatch dumps B events with no E
+        telemetry._emit({"ev": "B", "t": 1.0, "name": "unit.open",
+                         "span": 424242, "parent": None,
+                         "trace": "t-crash", "tid": 0})
+        p = str(tmp_path / "open.jsonl")
+        telemetry.dump(p, reason="crash")
+        header, evs = telemetry.load_dump(p)
+        assert header["n_events"] == len(evs) == 1
+        s = telemetry.summarize(evs)
+        assert [o["name"] for o in s["open_spans"]] == ["unit.open"]
+        assert s["spans"] == {}
+        # and the Chrome export of an unclosed span still serializes
+        json.dumps(telemetry.to_chrome_trace(evs))
+
+    def test_cross_thread_spans_do_not_nest(self):
+        """Span nesting is thread-local: a span opened on another
+        thread while an outer span is live on this one must come out
+        parentless, not parented across threads."""
+        ready = threading.Event()
+        done = threading.Event()
+
+        def worker():
+            ready.wait(5.0)
+            with telemetry.span("unit.other_thread"):
+                pass
+            done.set()
+
+        th = threading.Thread(target=worker)
+        th.start()
+        with telemetry.span("unit.this_thread"):
+            ready.set()
+            assert done.wait(5.0)
+        th.join()
+        evs = telemetry.events()
+        other_b = next(e for e in evs if e["ev"] == "B"
+                       and e["name"] == "unit.other_thread")
+        this_b = next(e for e in evs if e["ev"] == "B"
+                      and e["name"] == "unit.this_thread")
+        assert other_b["parent"] is None
+        assert other_b["tid"] != this_b["tid"]
+
+
 class TestCounterHook:
     def test_profiling_count_flows_into_ring(self):
         profiling.count("unit.hooked", 2)
@@ -169,11 +246,54 @@ class TestDump:
         monkeypatch.delenv("PINT_TPU_TELEMETRY_DUMP", raising=False)
         telemetry.event("unit.x")
         assert telemetry.dump() is None
-        # env opt-in routes the default path
+        # env opt-in routes the default path, uniquely suffixed
+        # ``.<reason>.<seq>`` so cascading dumps never clobber
         p = str(tmp_path / "env.jsonl")
         monkeypatch.setenv("PINT_TPU_TELEMETRY_DUMP", p)
-        assert telemetry.dump(reason="env") == p
-        assert telemetry.dump_on_failure("env2") == p
+        d1 = telemetry.dump(reason="env")
+        assert d1 is not None and d1.startswith(p + ".env.")
+        d2 = telemetry.dump_on_failure("env2")
+        assert d2 is not None and d2.startswith(p + ".env2.")
+        assert d1 != d2
+
+    def test_env_dump_cascade_all_survive(self, monkeypatch, tmp_path):
+        """Satellite: a drain dump followed by the SIGTERM superset at
+        the same configured path must BOTH survive on disk, and
+        ``load_dump`` on the bare base resolves the newest."""
+        base = str(tmp_path / "flight.jsonl")
+        monkeypatch.setenv("PINT_TPU_TELEMETRY_DUMP", base)
+        telemetry.event("unit.first")
+        p1 = telemetry.dump(reason="ServeDrained")
+        telemetry.event("unit.second")
+        p2 = telemetry.dump(reason="signal_15")
+        assert p1 != p2
+        import os
+        assert os.path.exists(p1) and os.path.exists(p2)
+        dumps = telemetry.list_dumps(base)
+        assert dumps == [p1, p2]            # oldest first
+        h1, evs1 = telemetry.load_dump(p1)
+        assert h1["reason"] == "ServeDrained" and len(evs1) == 1
+        # the bare configured base resolves to the newest (superset)
+        header, evs = telemetry.load_dump(base)
+        assert header["reason"] == "signal_15"
+        assert [e["name"] for e in evs] == ["unit.first", "unit.second"]
+
+    def test_explicit_path_is_written_exactly(self, tmp_path):
+        p = str(tmp_path / "exact.jsonl")
+        telemetry.event("unit.x")
+        assert telemetry.dump(p, reason="whatever") == p
+
+    def test_unsafe_reason_is_sanitized_in_suffix(self, monkeypatch,
+                                                  tmp_path):
+        base = str(tmp_path / "flight.jsonl")
+        monkeypatch.setenv("PINT_TPU_TELEMETRY_DUMP", base)
+        telemetry.event("unit.x")
+        p = telemetry.dump(reason="../../evil path")
+        import os
+        assert os.path.dirname(p) == str(tmp_path)
+        assert "/evil" not in os.path.basename(p)
+        assert telemetry.load_dump(base)[0]["reason"] \
+            == "../../evil path"
 
     def test_dump_on_failure_never_raises(self, monkeypatch):
         monkeypatch.setenv("PINT_TPU_TELEMETRY_DUMP",

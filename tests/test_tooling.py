@@ -772,3 +772,124 @@ class TestTupleChisq:
         chi2_g = grid_chisq_flat(f, grid, maxiter=2)
         np.testing.assert_allclose(chi2_t, chi2_g, rtol=1e-12)
         assert chi2_t.shape == (3,) and dof > 0
+
+
+class TestMetricsGate:
+    """The bench-history regression gate ACROSS the process boundary
+    (ISSUE 13): ``python -m pint_tpu.metrics compare`` must validate
+    the repo's own BENCH artifact pile and pass a self-compare, and a
+    seeded ``retrace_storm`` (via ``PINT_TPU_FAULTS``) must make
+    ``bench.py --quick --compare`` exit 1 naming the regressed counter.
+    Marker ``metrics``; opt out with ``PINT_TPU_SKIP_METRICS=1``."""
+
+    @staticmethod
+    def _repo():
+        import os
+
+        return os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+
+    @classmethod
+    def _run_cli(cls, args, env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.metrics", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    @classmethod
+    def _run_bench(cls, args, env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PINT_TPU_BENCH_FAST="1")
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, os.path.join(cls._repo(), "bench.py"),
+             "--quick", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_schema_only_validates_the_artifact_pile(self):
+        import glob
+        import json
+        import os
+
+        paths = sorted(glob.glob(os.path.join(self._repo(),
+                                              "BENCH_r0*.json")))
+        assert paths
+        p = self._run_cli(["compare", "--schema-only", *paths])
+        assert p.returncode == 0, p.stdout + p.stderr
+        lines = [json.loads(ln) for ln in p.stdout.splitlines()]
+        assert len(lines) == len(paths)
+        assert all(d["ok"] for d in lines)
+
+    def test_artifact_self_compare_exits_zero(self):
+        import json
+        import os
+
+        r04 = os.path.join(self._repo(), "BENCH_r04.json")
+        p = self._run_cli(["compare", r04, r04])
+        assert p.returncode == 0, p.stdout + p.stderr
+        doc = json.loads(p.stdout)
+        assert doc["ok"] is True and doc["failures"] == []
+
+    def test_clean_fast_quick_passes_the_gate(self):
+        import json
+        import os
+
+        r04 = os.path.join(self._repo(), "BENCH_r04.json")
+        p = self._run_bench(["--compare", r04])
+        assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["dispatch_counters"]["retraces"] == 0
+        assert "--compare: PASS" in p.stderr, p.stderr[-2000:]
+
+    def test_seeded_retrace_storm_fails_the_gate_with_attribution(
+            self):
+        import json
+        import os
+
+        r04 = os.path.join(self._repo(), "BENCH_r04.json")
+        p = self._run_bench(["--compare", r04],
+                            {"PINT_TPU_FAULTS": "retrace_storm"})
+        assert p.returncode == 1, p.stdout + p.stderr[-2000:]
+        # the quick line itself still prints (the gate is a verdict on
+        # a valid line, not a crash) and carries the storm's evidence
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["dispatch_counters"]["retraces"] >= 1
+        # per-metric attribution names the regressed counter
+        assert "REGRESSION dispatch_counters.retraces" in p.stderr, \
+            p.stderr[-2000:]
+
+
+class TestMetricsEndpoint:
+    """The /metrics exporter under real serve load (ISSUE 13
+    acceptance): ``bench_serve`` with ``PINT_TPU_METRICS_PORT=0``
+    scrapes the daemon's own endpoint after drain — the exposition must
+    parse strictly and the scraped counters must agree with the drain
+    snapshot.  Marker ``metrics``."""
+
+    def test_bench_serve_scrape_agrees_with_stats(self, monkeypatch):
+        import importlib.util
+        import os
+
+        monkeypatch.setenv("PINT_TPU_METRICS_PORT", "0")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        spec = importlib.util.spec_from_file_location(
+            "pint_tpu_bench_for_test", os.path.join(repo, "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        out = bench.bench_serve(n_requests=8, subset=2)
+        ms = out["metrics_scrape"]
+        assert ms is not None, "exporter did not start"
+        assert "error" not in ms, ms
+        assert ms["agree"] is True, ms
+        assert ms["scraped"]["completed"] == out["completed"]
+        assert ms["n_samples"] > 0
