@@ -845,6 +845,69 @@ def bench_cost_cards():
             "wall_s": round(time.time() - t0, 2)}
 
 
+def bench_pta(n_pulsars=64, span_days=1830.0, cadence_days=14.0,
+              chunk_size=8):
+    """The PTA scenario factory + Hellings-Downs workload (ISSUE 15):
+    on-device fleet-scale simulation throughput (`sim_toas_per_sec`,
+    steady-state — staged chunk inputs cached, 1 dispatch + 1 fetch
+    per chunk), whole-array timing-solution throughput over the
+    simulated fleet (`pta_fleet_fits_per_sec`), and the end-to-end
+    simulate -> fit -> correlate pipeline wall with the detection S/N
+    of the injected common process (`hd_snr`)."""
+    from pint_tpu import profiling, pta
+    from pint_tpu.fitter import FitStatus
+
+    sc = pta.Scenario(
+        n_pulsars=n_pulsars, seed=0, chunk_size=chunk_size,
+        cadence=pta.Cadence(span_days=span_days,
+                            cadence_days=cadence_days))
+    t0 = time.time()
+    run = pta.build(sc)
+    build_s = time.time() - t0
+    t0 = time.time()
+    sim = run.simulate(realization=0)   # cold: compiles the synth prog
+    sim_cold_s = time.time() - t0
+    times = []
+    with profiling.paused():   # timed loop: no per-stage blocking
+        for _ in range(3):
+            t0 = time.time()
+            sim = run.simulate(realization=0)
+            times.append(time.time() - t0)
+    sim_s = min(times)
+    ff = sim.fleet(maxiter=5)
+    t0 = time.time()
+    res = ff.fit()
+    fit_compile_s = time.time() - t0
+    times = []
+    with profiling.paused():
+        for _ in range(2):
+            t0 = time.time()
+            res = ff.fit()
+            times.append(time.time() - t0)
+    fit_s = min(times)
+    t0 = time.time()
+    resid = ff.residuals(res)
+    hd = pta.correlate(sim, resid)
+    corr_s = time.time() - t0
+    n_ok = sum(e.status in (FitStatus.CONVERGED, FitStatus.MAXITER)
+               for e in res.entries)
+    return {"n_pulsars": n_pulsars, "ntoas_total": sim.ntoas_total,
+            "build_s": round(build_s, 2),
+            "sim_cold_s": round(sim_cold_s, 2),
+            "sim_wall_s": round(sim_s, 4),
+            "sim_toas_per_sec": round(sim.ntoas_total / sim_s, 1),
+            "fit_compile_s": round(fit_compile_s, 2),
+            "fit_wall_s": round(fit_s, 4),
+            "pta_fleet_fits_per_sec": round(n_pulsars / fit_s, 1),
+            "correlate_wall_s": round(corr_s, 4),
+            "pipeline_wall_s": round(sim_s + fit_s + corr_s, 4),
+            "hd_snr": round(float(hd["snr"]), 3),
+            "hd_kappa": float(hd["kappa"]),
+            "n_pairs": hd["n_pairs"],
+            "n_buckets": res.n_buckets, "n_ok": n_ok,
+            "scan": sim.scan.counts()}
+
+
 def bench_quick(backend_status=None):
     """CPU-only smoke (``--quick``): ONE small WLS fit, no grid — the
     bench-regression canary that needs no accelerator (run by
@@ -963,6 +1026,18 @@ def bench_quick(backend_status=None):
             cost_cards = bench_cost_cards()
         except Exception as e:  # keep the quick line alive
             cost_cards = {"error": f"{type(e).__name__}: {e}"}
+    # the PTA scenario factory + HD workload (ISSUE 15), CPU-sized:
+    # 8 pulsars on a 1-year span — schema coverage for the simulation-
+    # throughput and detection axes; the headline leg runs the real
+    # N=64 multi-year shape
+    if fast:
+        pta_leg = {"skipped": "PINT_TPU_BENCH_FAST=1"}
+    else:
+        try:
+            pta_leg = bench_pta(n_pulsars=8, span_days=360.0,
+                                cadence_days=15.0, chunk_size=4)
+        except Exception as e:  # keep the quick line alive
+            pta_leg = {"error": f"{type(e).__name__}: {e}"}
     # supervised-acquisition provenance (ISSUE 4): how the backend was
     # obtained — a wedged-probe run shows up as backend_rung
     # "cpu_fallback" with attempts > 1 instead of a null metric
@@ -1023,10 +1098,18 @@ def bench_quick(backend_status=None):
         # and by `python -m pint_tpu.metrics compare --schema-only`)
         "cost_cards": cost_cards.get("cards"),
         "device_peak_flops": cost_cards.get("device_peak_flops"),
+        # PTA-scale simulation + HD detection axes (ISSUE 15): steady-
+        # state on-device simulation throughput, whole-array timing-
+        # solution throughput over the simulated fleet, and the
+        # end-to-end pipeline wall / detection S/N
+        "sim_toas_per_sec": pta_leg.get("sim_toas_per_sec"),
+        "pta_fleet_fits_per_sec": pta_leg.get("pta_fleet_fits_per_sec"),
+        "pta_pipeline_wall_s": pta_leg.get("pipeline_wall_s"),
+        "hd_snr": pta_leg.get("hd_snr"),
         "submetrics": {"fleet": fleet, "aot_cold_start": aot_cold,
                        "comm_profile": comm, "serve": serve,
                        "telemetry": telemetry_cost,
-                       "cost_cards": cost_cards},
+                       "cost_cards": cost_cards, "pta": pta_leg},
     }
 
 
@@ -1163,6 +1246,7 @@ def main(argv=None):
             ("fleet", bench_fleet),
             ("serve", bench_serve),
             ("cost_cards", bench_cost_cards),
+            ("pta", bench_pta),
             ("aot_cold_start", bench_cold_start),
             ("ngc6440e_wls", bench_ngc6440e),
             ("ensemble_sweep", sweep),
@@ -1261,6 +1345,14 @@ def main(argv=None):
         "cost_cards": (submetrics.get("cost_cards") or {}).get("cards"),
         "device_peak_flops": (submetrics.get("cost_cards") or {}).get(
             "device_peak_flops"),
+        # PTA-scale simulation + HD detection axes (ISSUE 15)
+        "sim_toas_per_sec": (submetrics.get("pta") or {}).get(
+            "sim_toas_per_sec"),
+        "pta_fleet_fits_per_sec": (submetrics.get("pta") or {}).get(
+            "pta_fleet_fits_per_sec"),
+        "pta_pipeline_wall_s": (submetrics.get("pta") or {}).get(
+            "pipeline_wall_s"),
+        "hd_snr": (submetrics.get("pta") or {}).get("hd_snr"),
         "submetrics": submetrics,
     }
     print(json.dumps(doc))

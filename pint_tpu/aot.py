@@ -1035,6 +1035,37 @@ def _serve_fixture():
     return run, run
 
 
+def _pta_fixture():
+    """The PTA scenario factory's noise-synthesis program plus the
+    fleet bucket programs its simulated array routes into (ISSUE 15):
+    a tiny 4-pulsar scenario, deterministic by seeding, so a warm
+    serving process prebuilds the exact pta_noise/fleet_bucket
+    ProgramKeys an N=1024 campaign's shape classes start from."""
+    from pint_tpu import pta
+    from pint_tpu.fitter import FitStatus
+
+    sc = pta.Scenario(n_pulsars=4, seed=0, chunk_size=2,
+                      cadence=pta.Cadence(span_days=360.0,
+                                          cadence_days=15.0))
+    run_ = pta.build(sc)
+
+    def run(out: dict) -> None:
+        sim = run_.simulate(realization=0)
+        ff = sim.fleet(maxiter=3)
+        res = ff.fit()
+        out["pta"] = {
+            "n_pulsars": len(res.entries),
+            "n_buckets": res.n_buckets,
+            "n_chunks": sim.scan.n_chunks,
+            "scan": sim.scan.counts(),
+            "n_ok": sum(e.status in (FitStatus.CONVERGED,
+                                     FitStatus.MAXITER)
+                        for e in res.entries),
+            "rms_us": [round(float(r) * 1e6, 6) for r in sim.rms_sec]}
+
+    return run, run
+
+
 def warm_fixtures() -> Dict[str, Callable]:
     """The deterministic serving fixtures the ``warm``/``check`` CLI
     legs drive — the entrypoint programs a fresh serving process needs
@@ -1048,7 +1079,8 @@ def warm_fixtures() -> Dict[str, Callable]:
     thousands of tiny eager dispatches that would otherwise drown the
     measurement in instrumentation overhead)."""
     return {"quick": _quick_fixture, "b1855": _b1855_fixture,
-            "fleet4": _fleet4_fixture, "serve": _serve_fixture}
+            "fleet4": _fleet4_fixture, "serve": _serve_fixture,
+            "pta": _pta_fixture}
 
 
 def _resolve_fixtures(fixtures: Optional[List[str]]) -> List[str]:

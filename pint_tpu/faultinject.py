@@ -53,7 +53,7 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "sigterm_midscan", "corrupt_checkpoint", "retrace_storm",
            "chatty_transfer", "chatty_collective", "corrupt_aot_blob",
            "stale_aot_version", "request_flood", "stalled_bucket",
-           "recorder_crash"]
+           "recorder_crash", "nan_gwb_draw", "corrupt_sim_chunk"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -280,6 +280,67 @@ def chunk_raise(chunks: Sequence[int] = (0,),
         return crashing
 
     with _registered("chunk_raise", factory):
+        yield
+
+
+def _nan_gwb_factory(fn, chunks=(0,), times=1):
+    hit = set(int(c) for c in chunks)
+    counts: dict = {}
+
+    def poisoned(ci, *a):
+        out = np.asarray(fn(ci, *a), np.float64)
+        if ci in hit and counts.get(ci, 0) < times:
+            counts[ci] = counts.get(ci, 0) + 1
+            out = out.copy()
+            out[:] = np.nan
+        return out
+    return poisoned
+
+
+@contextlib.contextmanager
+def nan_gwb_draw(chunks: Sequence[int] = (0,),
+                 times: int = 1) -> Iterator[None]:
+    """Failpoint ``"nan_gwb_draw"``: the PTA factory's per-chunk
+    common-process (GWB) coefficient rows come back NaN for the first
+    ``times`` dispatches of the chunks in ``chunks`` — the non-finite
+    realization failure.  The poisoned rows drive the synthesized
+    delays non-finite, so the simulate scan must retry the chunk
+    (ChunkStatus.RETRIED) and converge once the poison budget is
+    spent.  Also env-activatable (``PINT_TPU_FAULTS=nan_gwb_draw``,
+    chunk 0, one poisoning)."""
+    def factory(fn):
+        return _nan_gwb_factory(fn, chunks=chunks, times=times)
+
+    with _registered("nan_gwb_draw", factory):
+        yield
+
+
+def _corrupt_sim_chunk_factory(fn, chunks=(1,)):
+    hit = set(int(c) for c in chunks)
+
+    def crashing(ci, *a):
+        if ci in hit:
+            raise RuntimeError(
+                f"injected simulate-dispatch corruption on chunk {ci} "
+                "(corrupt_sim_chunk failpoint)")
+        return fn(ci, *a)
+    return crashing
+
+
+@contextlib.contextmanager
+def corrupt_sim_chunk(chunks: Sequence[int] = (1,)) -> Iterator[None]:
+    """Failpoint ``"corrupt_sim_chunk"``: the PTA factory's device
+    noise-synthesis dispatch raises PERSISTENTLY for the chunks in
+    ``chunks`` (a wedged/corrupting device), so the simulate scan must
+    exhaust its retries and requeue those chunks onto the host-numpy
+    fallback path (ChunkStatus.REROUTED) — the simulation completes
+    with the chunk named in the scan summary.  Also env-activatable
+    (``PINT_TPU_FAULTS=corrupt_sim_chunk``, chunk 1) for the
+    ``python -m pint_tpu.pta`` subprocess leg."""
+    def factory(fn):
+        return _corrupt_sim_chunk_factory(fn, chunks=chunks)
+
+    with _registered("corrupt_sim_chunk", factory):
         yield
 
 
@@ -555,6 +616,8 @@ _ENV_FACTORIES = {
     "request_flood": _request_flood_factory,
     "stalled_bucket": _stalled_bucket_factory,
     "recorder_crash": _recorder_crash_factory,
+    "nan_gwb_draw": _nan_gwb_factory,
+    "corrupt_sim_chunk": _corrupt_sim_chunk_factory,
 }
 
 

@@ -337,6 +337,47 @@ def _build_bucket_fit(model: TimingModel, track_mode: str,
     return jax.jit(jax.vmap(fit_one))
 
 
+def _build_bucket_resid(model: TimingModel, track_mode: str,
+                        delta_keys: Tuple[str, ...], n_param: int,
+                        include_offset: bool):
+    """ONE jitted, vmapped residual evaluator for a bucket:
+    ``prog(p, batch, x, slots, pmask, rowmask) -> (B, n_toa)`` seconds,
+    padded rows exactly zero.  The PTA workload's correlation stage
+    needs post-fit residuals for EVERY pulsar of a fleet — evaluating
+    them through per-pulsar ``Residuals`` objects would pay one XLA
+    compile per pulsar, which is exactly the tax the bucket machinery
+    exists to avoid, so this shares the fit program's slot/pmask
+    apply-x mapping and compiles once per bucket.  ``include_offset``
+    mirrors the fit's implicit phase-offset column by subtracting the
+    (mask-)weighted mean."""
+    calc = model.calc
+    keys = tuple(delta_keys)
+
+    def apply_x(p, x, slots, pmask):
+        d = jnp.stack([jnp.asarray(p["delta"][k], jnp.float64)
+                       for k in keys])
+        d = d.at[slots].add(x * pmask)
+        delta = dict(p["delta"])
+        for j, k in enumerate(keys):
+            delta[k] = d[j]
+        out = dict(p)
+        out["delta"] = delta
+        return out
+
+    def resid_one(p, b, x, slots, pmask, rowmask):
+        p2 = apply_x(p, x, slots, pmask)
+        r = raw_phase_resids(calc, p2, b, track_mode,
+                             subtract_mean=False, use_weights=False)
+        r = r / pv(p2, "F0")
+        if include_offset:
+            sigma = model.scaled_toa_uncertainty(p2, b) * 1e-6
+            w = rowmask / (sigma * sigma)
+            r = r - jnp.sum(r * w) / jnp.maximum(jnp.sum(w), 1e-300)
+        return r * rowmask
+
+    return jax.jit(jax.vmap(resid_one))
+
+
 #: columns appended after the x block in a bucket program's output row
 _TAIL = 5
 _COL_CHI2, _COL_STATUS, _COL_ITERS, _COL_BEST, _COL_NBAD = range(5)
@@ -447,6 +488,7 @@ class FleetFitter:
             raise ValueError("FleetFitter needs at least one pulsar")
         self._plan = None
         self._programs: dict = {}
+        self._resid_programs: dict = {}
         self._args_cache: dict = {}
 
     # -- preparation -----------------------------------------------------------
@@ -881,3 +923,92 @@ class FleetFitter:
             pu.resid.update()
         self._args_cache.clear()
         self._plan = None
+
+    # -- bucketed residual evaluation ------------------------------------------
+
+    def _resid_program(self, bucket: _Bucket):
+        plan = self._plan
+        key = (bucket.skey_idx, bucket.n_toa, bucket.n_param)
+        prog = self._resid_programs.get(key)
+        if prog is None:
+            rep = plan["rep"][bucket.skey_idx]
+            profiling.count("fleet.resid_program_build")
+            prog = _build_bucket_resid(
+                rep.model, rep.resid.track_mode,
+                plan["delta_keys"][bucket.skey_idx], bucket.n_param,
+                "PhaseOffset" not in rep.model.components)
+            if self._sharding is None:
+                from pint_tpu import aot
+
+                prog = aot.serve(
+                    "fleet_resid", prog,
+                    f"{plan['skey_repr'][bucket.skey_idx]}"
+                    f"|ntoa={bucket.n_toa}|nparam={bucket.n_param}")
+            self._resid_programs[key] = prog
+        return prog
+
+    def residuals(self, result: Optional[FleetResult] = None
+                  ) -> Dict[str, np.ndarray]:
+        """Whitened-mean-subtracted residual SECONDS for every pulsar,
+        evaluated through ONE compiled program per bucket (the PTA
+        correlation stage's entrypoint — per-pulsar ``Residuals``
+        evaluation would pay a compile per pulsar).
+
+        With ``result`` the residuals are evaluated at that fit's
+        offsets WITHOUT mutating any model (the side-effect-free
+        companion of :meth:`apply`); without it, at the models' current
+        values.  Eager-lane pulsars (correlated-noise models) evaluate
+        through a deep-copied single-pulsar path.  Returns
+        ``{name: (ntoas,) float64}``; steady state is 1 dispatch + 1
+        fetch per chunk, like the fit."""
+        plan = self._ensure_plan()
+        cs = self.chunk_size
+        xs: Dict[int, np.ndarray] = {}
+        if result is not None:
+            xs = {e.index: np.asarray(e.x, np.float64)
+                  for e in result.entries}
+        out: Dict[str, np.ndarray] = {}
+        for ci, (bi, blo) in enumerate(plan["chunk_map"]):
+            b = plan["buckets"][bi]
+            sl = b.slots[blo:blo + cs]
+            if b.eager:
+                for pi in dict.fromkeys(sl):
+                    pu = self._pulsars[pi]
+                    if pu.name in out:
+                        continue
+                    model = pu.model
+                    if xs.get(pi) is not None and \
+                            np.all(np.isfinite(xs[pi])) and np.any(xs[pi]):
+                        model = copy.deepcopy(pu.model)
+                        p2 = model.with_x(pu.resid.pdict,
+                                          xs[pi][:len(pu.names)],
+                                          list(pu.names))
+                        model.apply_deltas(p2)
+                    r = Residuals(pu.toas, model,
+                                  track_mode=pu.resid.track_mode,
+                                  policy=self.policy)
+                    rs = np.asarray(r.time_resids, np.float64)
+                    w = 1.0 / np.asarray(r.get_data_error(),
+                                         np.float64) ** 2
+                    out[pu.name] = rs - np.sum(rs * w) / np.sum(w)
+                continue
+            prog = self._resid_program(b)
+            stacked_p, stacked_b, slots, pmask, rowmask = \
+                self._chunk_args(ci)
+            X = np.zeros((len(sl), b.n_param), np.float64)
+            for j, pi in enumerate(sl):
+                x = xs.get(pi)
+                # a failed fit's x is NaN; evaluate that pulsar at its
+                # current model values rather than poisoning its row
+                if x is not None and np.all(np.isfinite(x)):
+                    X[j, :x.shape[0]] = x
+            with telemetry.span("fleet.resid_chunk", chunk=ci,
+                                n_toa=b.n_toa, n_param=b.n_param):
+                r = np.asarray(prog(stacked_p, stacked_b,
+                                    jnp.asarray(X), slots, pmask,
+                                    rowmask))
+            for j, pi in enumerate(sl):
+                pu = self._pulsars[pi]
+                if pu.name not in out:
+                    out[pu.name] = r[j, :pu.resid.batch.ntoas].copy()
+        return out

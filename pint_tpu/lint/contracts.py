@@ -192,6 +192,7 @@ def _ensure_registered() -> None:
     import pint_tpu.multihost     # noqa: F401
     import pint_tpu.parallel      # noqa: F401
     import pint_tpu.residuals     # noqa: F401
+    import pint_tpu.pta           # noqa: F401
     import pint_tpu.runtime       # noqa: F401
     import pint_tpu.serve         # noqa: F401
 
@@ -313,6 +314,21 @@ class ContractFixture:
                 self._cache["fleet"] = FleetFitter(
                     pulsars, maxiter=3, chunk_size=2)
         return self._cache["fleet"]
+
+    def pta_run(self):
+        """A tiny built PTA scenario (4 pulsars, chunk width 2 -> 2
+        chunks) for the pta_simulate contract: steady state must be 2
+        dispatches + 2 fetches, with only the common-process rows
+        crossing host->device."""
+        if "pta" not in self._cache:
+            from pint_tpu import pta
+
+            sc = pta.Scenario(
+                n_pulsars=4, seed=0, chunk_size=2,
+                cadence=pta.Cadence(span_days=360.0,
+                                    cadence_days=15.0))
+            self._cache["pta"] = pta.build(sc)
+        return self._cache["pta"]
 
     def grid_fitter(self):
         """A WLSFitter with DM frozen, for the grid contracts."""
@@ -488,6 +504,15 @@ def _drv_fleet_fit(fix: ContractFixture):
     return {"call": lambda: ff.fit()}
 
 
+def _drv_pta_simulate(fix: ContractFixture):
+    """Steady-state pta simulation: re-synthesizing the SAME
+    realization (the idempotent-replay idiom serve_request uses) must
+    hit the staged chunk cache — 1 dispatch + 1 fetch per chunk, only
+    the per-realization common-process rows cross host->device."""
+    run = fix.pta_run()
+    return {"call": lambda: run.simulate(realization=0)}
+
+
 def _drv_serve_request(fix: ContractFixture):
     """The serve daemon's steady-state request path: resubmit two
     prepared 8-TOA jobs (one structure/shape bucket -> ONE coalesced
@@ -523,6 +548,7 @@ _DRIVERS: Dict[str, Callable[[ContractFixture], dict]] = {
     "checkpointed_chunk": _drv_checkpointed_chunk,
     "mcmc_step": _drv_mcmc_step,
     "fleet_fit": _drv_fleet_fit,
+    "pta_simulate": _drv_pta_simulate,
     "serve_request": _drv_serve_request,
 }
 

@@ -309,6 +309,38 @@ def _hlo_fleet_fit(fix) -> HloProgram:
     return HloProgram(compiled, mesh, None)
 
 
+def _hlo_pta_simulate(fix) -> HloProgram:
+    """The pta noise-synthesis program lowered on batch-mesh
+    NamedSharding avals: per-pulsar chunk rows shard over the batch
+    axis, the shared frequency grids and common-process spectrum stay
+    replicated.  Like the fleet bucket program, the unconstrained vmap
+    output replicates via budgeted all-gathers."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pint_tpu.parallel import make_batch_mesh
+
+    run = fix.pta_run()
+    args = run._chunk_args(0, 0)
+    sc = run.scenario
+    w_rows = np.zeros((sc.chunk_size, 2 * sc.n_gwb_modes))
+    gwb_ag = np.zeros(2)
+    mesh = make_batch_mesh(2 if len(jax.devices()) >= 2 else 1)
+    sh_b = NamedSharding(mesh, P(mesh.axis_names[0]))
+    sh_r = NamedSharding(mesh, P())
+
+    def aval(x, sh):
+        x = np.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+    avals = ([aval(a, sh_b) for a in args]
+             + [aval(w_rows, sh_b), aval(gwb_ag, sh_r),
+                aval(run.f_red, sh_r), aval(run.f_gwb, sh_r)])
+    compiled = run._prog.fn.lower(*avals).compile()
+    return HloProgram(compiled, mesh, None)
+
+
 #: contract name -> HLO driver; consulted by the CONTRACT004 leg in
 #: :mod:`pint_tpu.lint.contracts` (a comm budget without a driver here
 #: is itself a finding, mirroring the dispatch-driver rule)
@@ -316,6 +348,7 @@ HLO_DRIVERS: Dict[str, Callable] = {
     "sharded_chunk": _hlo_sharded_chunk,
     "multihost_chunk": _hlo_multihost_chunk,
     "fleet_fit": _hlo_fleet_fit,
+    "pta_simulate": _hlo_pta_simulate,
 }
 
 

@@ -612,7 +612,9 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     * ``comm_bytes`` — bounded growth by ``tolerance``;
     * ``all_gather_bytes`` — must not exceed the old value at all (the
       no-implicit-gather invariant as a gate);
-    * ``serve_p99_ms`` — bounded growth by ``p99_tolerance``.
+    * ``serve_p99_ms`` — bounded growth by ``p99_tolerance``;
+    * ``sim_toas_per_sec`` / ``pta_fleet_fits_per_sec`` — PTA-scale
+      throughput may shrink at most ``tolerance``.
 
     An axis absent from either line is skipped — early rounds carry
     only the headline, and a gate that fails on *missing history* would
@@ -654,6 +656,16 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
             fail("serve_p99_ms", op, np_,
                  f"serve p99 grew {np_ / op - 1.0:+.1%} "
                  f"(> +{p99_tolerance:.0%} tolerance)")
+    # PTA-scale throughput axes (ISSUE 15): simulation and whole-array
+    # fit rates may not drop below (1 - tolerance) of the prior round;
+    # rounds predating the pta leg skip via the absent-axis rule
+    for axis in ("sim_toas_per_sec", "pta_fleet_fits_per_sec"):
+        oa, na = _num(old, axis), _num(new, axis)
+        if oa is not None and na is not None and oa > 0:
+            if na < oa * (1.0 - tolerance):
+                fail(axis, oa, na,
+                     f"throughput dropped {na / oa - 1.0:+.1%} "
+                     f"(> -{tolerance:.0%} tolerance)")
     return failures
 
 

@@ -1,0 +1,1042 @@
+"""PTA-scale scenario factory + an end-to-end Hellings-Downs GW workload.
+
+ROADMAP item 6: every fixture before this module was 1-32 pulsars, so
+the fleet/serve/AOT stack had never been exercised at the scale it
+exists for.  This module is the first consumer of the whole foundation
+at 10^3-pulsar scale, in two layers:
+
+**(a) The scenario factory** (:func:`build` -> :class:`ScenarioRun`).
+A :class:`Scenario` describes a synthetic timing array: observing
+cadences with jitter and gap windows, radiometer noise from a
+telescope/backend table (:data:`TELESCOPES`), per-pulsar EFAC/EQUAD/
+ECORR draws, per-pulsar power-law red noise, and a common process
+correlated across pulsars by the Hellings-Downs overlap matrix.  The
+factory is deterministic end to end — every draw comes from a
+``(scenario.seed, stream, pulsar_index[, realization])`` seeded
+generator, so two builds of the same scenario are bit-identical and a
+resumed simulation reproduces the original exactly.
+
+The division of labour follows the framework's host/device split:
+
+* **Host** — cadence grids, the analytic integer-phase arrival-time
+  solve (TOAs land exactly on model phases, like
+  :func:`pint_tpu.simulation.zero_residuals` but closed-form, so N
+  pulsars cost zero compiles), par-driven model construction, and the
+  O(N^2) Hellings-Downs correlation factor.  The common-process draw
+  mixes ``w = L @ z`` with a HOST Cholesky of the correlation matrix —
+  the same range-safety idiom as ``mcmc.hmc_sample`` and
+  ``simulation.calculate_random_models``.
+* **Device** — the per-realization heavy work: ONE jitted, vmapped
+  noise-synthesis program (white + red + HD-correlated + ECORR delays
+  via :func:`pint_tpu.models.noise_model.powerlaw_psd` on a common
+  frequency grid) with fixed padded ``(chunk, T)`` shapes, so the whole
+  fleet rides one compile, zero retraces, and 1 dispatch + 1 fetch per
+  chunk — the ``pta_simulate`` dispatch contract.
+
+Generation rides :func:`pint_tpu.runtime.run_checkpointed_scan`
+(SIGTERM-flushable, resume bit-identical, chunk retry + requeue onto a
+pure-numpy host fallback), with the ``nan_gwb_draw`` and
+``corrupt_sim_chunk`` failpoints driving the degraded legs.
+
+Emitted fleets are **fleet-shaped by construction**: all pulsars share
+one model structure (spin + frozen astrometry + EFAC/EQUAD mask
+params — deliberately NO correlated-noise components, which would route
+everything to the eager GLS lane), and per-pulsar TOA counts are
+quantized to powers of two, so N=1024 pulsars land in a bounded bucket
+set that :class:`pint_tpu.fleet.FleetFitter` and
+``serve.TimingService`` consume directly and ``python -m pint_tpu.aot
+warm --fixtures pta`` can prebuild.
+
+**(b) The end-to-end GW workload** (:func:`run_experiment`): simulate
+-> fleet timing solutions -> bucketed post-fit residuals
+(:meth:`FleetFitter.residuals`) -> per-pair residual cross-correlations
+binned by angular separation -> a Hellings-Downs curve fit with an
+optimal-statistic-style detection S/N, plus a no-injection null leg
+(same seeds, common-process amplitude off) for calibration.  Stage
+walls ride the telemetry spans.
+
+``python -m pint_tpu.pta simulate|experiment`` is the subprocess
+surface (one JSON line, chunk-status provenance included) the tooling
+tests fault-inject from the outside.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import faultinject, profiling, runtime, telemetry
+from pint_tpu import mjd as mjdmod
+from pint_tpu.lint.contracts import dispatch_contract
+from pint_tpu.logging import child as _logchild
+from pint_tpu.models import get_model
+from pint_tpu.models.noise_model import powerlaw_psd
+from pint_tpu.toa import TOAs, get_TOAs_array
+
+_log = _logchild("pta")
+
+__all__ = ["Telescope", "TELESCOPES", "Cadence", "Scenario",
+           "PulsarTruth", "SimulatedPulsar", "Simulation", "ScenarioRun",
+           "build", "hd_curve", "hd_correlation_matrix", "correlate",
+           "run_experiment", "main"]
+
+
+# --- the telescope/backend radiometer table -----------------------------------
+
+class Telescope(NamedTuple):
+    """One telescope/backend row of the radiometer-noise table."""
+
+    name: str
+    sefd_jy: float        #: system equivalent flux density
+    bandwidth_mhz: float
+    t_int_s: float        #: per-TOA integration time
+    freq_mhz: float       #: band centre
+
+
+#: The backend table scenario pulsars draw their observing setup from —
+#: representative L-band/800 MHz/CHIME-class rows, not a calibration.
+TELESCOPES: Dict[str, Telescope] = {
+    "meerkat": Telescope("meerkat", 7.5, 700.0, 1800.0, 1284.0),
+    "gbt": Telescope("gbt", 10.0, 650.0, 1500.0, 1400.0),
+    "chime": Telescope("chime", 45.0, 400.0, 600.0, 600.0),
+}
+
+
+def radiometer_sigma_us(tel: Telescope, flux_mjy: float, period_s: float,
+                        width_frac: float) -> float:
+    """Radiometer-equation TOA uncertainty (microseconds): template
+    matching at S/N = (S/SEFD) sqrt(2 B tau) sqrt((1-W)/W) resolves the
+    pulse to ~W_eff/SNR."""
+    snr = ((flux_mjy * 1e-3 / tel.sefd_jy)
+           * math.sqrt(2.0 * tel.bandwidth_mhz * 1e6 * tel.t_int_s)
+           * math.sqrt(max(1.0 - width_frac, 1e-6) / width_frac))
+    sigma_us = width_frac * period_s * 1e6 / max(snr, 1e-3)
+    return float(np.clip(sigma_us, 0.03, 30.0))
+
+
+# --- scenario configuration ---------------------------------------------------
+
+class Cadence(NamedTuple):
+    """An observing-cadence model: a jittered regular grid with gap
+    windows removed (receiver maintenance / RFI campaigns)."""
+
+    start_mjd: float = 54500.0
+    span_days: float = 3650.0
+    cadence_days: float = 14.0
+    jitter_days: float = 1.0
+    gap_fraction: float = 0.1
+    gap_days: float = 60.0
+
+
+class Scenario(NamedTuple):
+    """A full synthetic-PTA description — everything :func:`build`
+    needs, and nothing else (deterministic given ``seed``)."""
+
+    n_pulsars: int = 8
+    seed: int = 0
+    cadence: Cadence = Cadence()
+    telescopes: Tuple[str, ...] = ("meerkat", "gbt", "chime")
+    nobs_per_epoch: int = 1
+    #: per-pulsar cadence multipliers (draws spread TOA counts over a
+    #: few power-of-two shape classes, exercising the bucket machinery)
+    cadence_tiers: Tuple[int, ...] = (1, 2, 4)
+    # white-noise draws
+    efac_range: Tuple[float, float] = (0.9, 1.3)
+    equad_range_us: Tuple[float, float] = (0.0, 0.5)
+    ecorr_range_us: Tuple[float, float] = (0.0, 0.3)
+    # per-pulsar power-law red noise (log10 amplitude, spectral index)
+    red_log10_amp_range: Tuple[float, float] = (-15.0, -14.0)
+    red_gamma_range: Tuple[float, float] = (1.5, 4.0)
+    n_red_modes: int = 10
+    # the Hellings-Downs-correlated common process (None = no injection)
+    gwb_log10_amp: Optional[float] = -13.3
+    gwb_gamma: float = 13.0 / 3.0
+    n_gwb_modes: int = 10
+    # pulsar-population draws
+    f0_range_hz: Tuple[float, float] = (100.0, 600.0)
+    log10_neg_f1_range: Tuple[float, float] = (-16.0, -14.5)
+    flux_range_mjy: Tuple[float, float] = (0.2, 2.0)
+    width_frac_range: Tuple[float, float] = (0.02, 0.10)
+    # execution shape
+    chunk_size: int = 8
+    min_toas: int = 8
+
+
+#: effective log10 amplitude used for "no injection": the synthesis
+#: program keeps ONE compiled shape for injected and null legs, the
+#: null leg just drives the common-process variance to ~1e-60 s^2
+_NULL_LOG10_AMP = -30.0
+
+#: reference epoch (MJD, integer) all scenario pulsars share
+_PEPOCH = 55000
+
+# deterministic stream tags (seeded as (seed, tag, index...))
+_STREAM_POP = 17       # per-pulsar population draws (build time)
+_STREAM_NOISE = 31     # per-pulsar noise streams (per realization)
+_STREAM_GWB = 29       # the common-process draw (per realization)
+
+
+class PulsarTruth(NamedTuple):
+    """The generating parameters of one scenario pulsar — what a
+    recovery analysis is allowed to compare against."""
+
+    name: str
+    ra_rad: float
+    dec_rad: float
+    f0_hz: float
+    f1_hz_s: float
+    telescope: str
+    efac: float
+    equad_us: float
+    ecorr_us: float
+    red_log10_amp: float
+    red_gamma: float
+    ntoas: int
+    sigma_us: np.ndarray      #: (ntoas,) raw radiometer uncertainties
+    t_mjd: np.ndarray         #: (ntoas,) zero-noise TDB arrival MJDs
+
+
+class SimulatedPulsar(NamedTuple):
+    name: str
+    model: object             #: fit-ready TimingModel (F0/F1 free)
+    toas: TOAs                #: noise-shifted barycentric TOAs
+    truth: PulsarTruth
+
+
+# --- Hellings-Downs -----------------------------------------------------------
+
+def hd_curve(theta_rad) -> np.ndarray:
+    """The Hellings-Downs overlap chi(theta) = 3/2 x ln x - x/4 + 1/2,
+    x = (1-cos theta)/2, with the coincident-pair limit chi(0+) = 1/2
+    (distinct pulsars, no pulsar term)."""
+    x = 0.5 * (1.0 - np.cos(np.asarray(theta_rad, np.float64)))
+    out = np.full(np.shape(x), 0.5)
+    m = x > 1e-15
+    xm = np.asarray(x)[m]
+    out[m] = 1.5 * xm * np.log(xm) - 0.25 * xm + 0.5
+    return out
+
+
+def hd_correlation_matrix(positions: np.ndarray) -> np.ndarray:
+    """The N x N Hellings-Downs correlation factor: chi(theta_ab) off
+    the diagonal, 1 on it (the autocorrelation includes the pulsar
+    term).  This is the O(1)-scaled factor the host Cholesky draws
+    from — amplitudes are applied per-mode on device."""
+    c = np.clip(positions @ positions.T, -1.0, 1.0)
+    g = hd_curve(np.arccos(c))
+    np.fill_diagonal(g, 1.0)
+    return g
+
+
+# --- host-side generation helpers ---------------------------------------------
+
+def _fmt_ra(ra_rad: float) -> str:
+    h = (ra_rad % (2.0 * math.pi)) * 12.0 / math.pi
+    hh = int(h)
+    m = (h - hh) * 60.0
+    mm = int(m)
+    return f"{hh:02d}:{mm:02d}:{(m - mm) * 60.0:09.6f}"
+
+
+def _fmt_dec(dec_rad: float) -> str:
+    sign = "-" if dec_rad < 0 else "+"
+    d = abs(dec_rad) * 180.0 / math.pi
+    dd = int(d)
+    m = (d - dd) * 60.0
+    mm = int(m)
+    return f"{sign}{dd:02d}:{mm:02d}:{(m - mm) * 60.0:08.5f}"
+
+
+_PAR_TEMPLATE = """
+PSR {name}
+RAJ {raj}
+DECJ {decj}
+F0 {f0:.15f} 1
+F1 {f1:.10e} 1
+PEPOCH {pepoch}
+POSEPOCH {pepoch}
+DM 0.0
+EPHEM DE421
+EFAC mjd 30000 80000 {efac:.6f}
+EQUAD mjd 30000 80000 {equad:.6f}
+"""
+
+
+def _epoch_grid(rng, cad: Cadence, tier: int) -> np.ndarray:
+    step = cad.cadence_days * tier
+    ep = cad.start_mjd + np.arange(0.0, cad.span_days, step)
+    ep = ep + rng.uniform(-cad.jitter_days, cad.jitter_days, ep.shape)
+    if cad.gap_fraction > 0.0 and cad.gap_days > 0.0:
+        removed = 0.0
+        keep = np.ones(ep.shape, bool)
+        while removed < cad.gap_fraction * cad.span_days:
+            gs = cad.start_mjd + rng.uniform(0.0, cad.span_days)
+            keep &= ~((ep >= gs) & (ep < gs + cad.gap_days))
+            removed += cad.gap_days
+        ep = ep[keep]
+    return np.sort(ep)
+
+
+def _pow2_floor(n: int, lo: int) -> int:
+    return max(1 << int(math.floor(math.log2(max(n, 1)))), lo)
+
+
+def _solve_arrivals(t_grid_mjd: np.ndarray, f0: float, f1: float):
+    """Closed-form integer-phase arrival times for a spin-only model at
+    the barycenter: snap each grid time to the nearest integer model
+    phase.  The grid day/second split keeps everything exactly
+    representable, so the linearized correction lands the residual at
+    the ~0.1 ns level — far below any scenario noise floor.  Returns
+    ``(MJD pair, t_sec)`` with ``t_sec`` seconds from PEPOCH."""
+    day = np.floor(t_grid_mjd).astype(np.int64)
+    sec = np.round((t_grid_mjd - day) * 86400.0)
+    dt = (day - _PEPOCH).astype(np.float64) * 86400.0 + sec
+    ph = f0 * dt + 0.5 * f1 * dt * dt
+    n = np.round(ph)
+    delta = (n - ph) / (f0 + f1 * dt)
+    t = mjdmod.normalize(day, (sec + delta) / 86400.0)
+    return t, dt + delta
+
+
+def _solar_shapiro_sec(t_mjd: np.ndarray,
+                       psr_dir: np.ndarray) -> np.ndarray:
+    """Host-side solar Shapiro delay, mirroring the device component
+    exactly.  Even barycentric TOAs carry it: ``compute_posvels``
+    attaches the full SSB→Sun vector for a barycenter observatory, so
+    ``SolarSystemShapiro`` contributes a slowly-varying ~46 µs delay
+    that the phase solve must fold into the arrival times (the same
+    ephemeris object/pinning as the TOA path keeps the two in
+    lockstep)."""
+    from pint_tpu import AU, Tsun, c as C
+    from pint_tpu.ephemeris import load_ephemeris
+
+    eph = load_ephemeris("DE421")
+    if hasattr(eph, "pinned_to") and len(t_mjd):
+        eph = eph.pinned_to(t_mjd)
+    sun_ls = eph.posvel("sun", t_mjd).pos / C
+    r = np.linalg.norm(sun_ls, axis=1)
+    rcostheta = sun_ls @ psr_dir
+    return -2.0 * Tsun * np.log((r - rcostheta) / (AU / C))
+
+
+# --- the built run ------------------------------------------------------------
+
+class ScenarioRun:
+    """A built scenario: host-staged generation state + the compiled
+    device synthesis program.  Build once (:func:`build`), simulate any
+    number of realizations — staged chunk inputs are device-resident
+    and cached per ``(chunk, realization)``, so a steady-state
+    :meth:`simulate` is 1 dispatch + 1 fetch per chunk (the
+    ``pta_simulate`` contract)."""
+
+    def __init__(self, scenario: Scenario):
+        sc = self.scenario = scenario
+        if sc.n_pulsars < 2:
+            raise ValueError("a PTA scenario needs >= 2 pulsars")
+        # only draw cadence tiers whose expected epoch count clears the
+        # min_toas floor (sparse tiers drop out of short-span scenarios)
+        cad = sc.cadence
+        tiers = tuple(
+            t for t in (sc.cadence_tiers or (1,))
+            if (cad.span_days / (cad.cadence_days * t))
+            * max(1.0 - cad.gap_fraction, 0.0)
+            * max(int(sc.nobs_per_epoch), 1) >= sc.min_toas
+        ) or (min(sc.cadence_tiers or (1,)),)
+        truths: List[PulsarTruth] = []
+        models = []
+        base_toas: List[TOAs] = []
+        t_sec_rows = []
+        epoch_rows = []
+        n_epochs = []
+        width = len(str(max(sc.n_pulsars - 1, 9)))
+        for i in range(sc.n_pulsars):
+            rng = np.random.default_rng((sc.seed, _STREAM_POP, i))
+            name = f"PTA{i:0{width}d}"
+            ra = rng.uniform(0.0, 2.0 * math.pi)
+            dec = math.asin(rng.uniform(-0.95, 0.95))
+            f0 = rng.uniform(*sc.f0_range_hz)
+            f1 = -10.0 ** rng.uniform(*sc.log10_neg_f1_range)
+            tel = TELESCOPES[sc.telescopes[
+                rng.integers(len(sc.telescopes))]]
+            flux = 10.0 ** rng.uniform(
+                math.log10(sc.flux_range_mjy[0]),
+                math.log10(sc.flux_range_mjy[1]))
+            width_frac = rng.uniform(*sc.width_frac_range)
+            efac = rng.uniform(*sc.efac_range)
+            equad = rng.uniform(*sc.equad_range_us)
+            ecorr = rng.uniform(*sc.ecorr_range_us)
+            red_amp = rng.uniform(*sc.red_log10_amp_range)
+            red_gamma = rng.uniform(*sc.red_gamma_range)
+            tier = int(tiers[rng.integers(len(tiers))])
+
+            ep = _epoch_grid(rng, sc.cadence, tier)
+            nobs = max(int(sc.nobs_per_epoch), 1)
+            tt = (ep[:, None] + np.arange(nobs) * 0.02).ravel()
+            eidx = np.repeat(np.arange(len(ep)), nobs)
+            if len(tt) < sc.min_toas:
+                raise ValueError(
+                    f"cadence yields {len(tt)} TOAs for {name}; "
+                    f"min_toas={sc.min_toas} — widen the span or "
+                    "shorten the cadence")
+            # power-of-two shape quantization: the whole point of the
+            # factory's fleet-shaped promise — TOA counts land in a
+            # bounded set of classes, so bucketing stays bounded at
+            # N=1024
+            nk = _pow2_floor(len(tt), sc.min_toas)
+            sel = np.round(np.linspace(0, len(tt) - 1, nk)).astype(int)
+            tt, eidx = tt[sel], eidx[sel]
+            # re-map surviving epochs onto a dense id range
+            _, eidx = np.unique(eidx, return_inverse=True)
+
+            sig0 = radiometer_sigma_us(tel, flux, 1.0 / f0, width_frac)
+            sigma_us = sig0 * rng.uniform(0.85, 1.25, nk)
+
+            t_pair, t_sec = _solve_arrivals(tt, f0, f1)
+            # arrival = phase solution + model delay: the only delay a
+            # zero-noise barycentric TOA sees is solar Shapiro
+            n_dir = np.asarray([math.cos(dec) * math.cos(ra),
+                                math.cos(dec) * math.sin(ra),
+                                math.sin(dec)])
+            shap = _solar_shapiro_sec(
+                np.asarray(t_pair.day + t_pair.frac, np.float64), n_dir)
+            t_pair = mjdmod.add_sec(t_pair, shap)
+            t_sec = t_sec + shap
+            par = _PAR_TEMPLATE.format(
+                name=name, raj=_fmt_ra(ra), decj=_fmt_dec(dec), f0=f0,
+                f1=f1, pepoch=_PEPOCH, efac=efac, equad=equad)
+            model = get_model(par.strip().splitlines())
+            toas = get_TOAs_array(t_pair, obs="bary",
+                                  errors_us=sigma_us,
+                                  freqs_mhz=tel.freq_mhz, ephem="DE421",
+                                  planets=False)
+            for f in toas.flags:
+                f.setdefault("simulated", "1")
+
+            truths.append(PulsarTruth(
+                name, ra, dec, f0, f1, tel.name, efac, equad, ecorr,
+                red_amp, red_gamma, nk, sigma_us,
+                np.asarray(t_pair.day + t_pair.frac, np.float64)))
+            models.append(model)
+            base_toas.append(toas)
+            t_sec_rows.append(t_sec)
+            epoch_rows.append(eidx)
+            n_epochs.append(int(eidx.max()) + 1)
+
+        N = sc.n_pulsars
+        T = max(tr.ntoas for tr in truths)
+        E = max(n_epochs)
+        self.truths = truths
+        self.models = models
+        self.base_toas = base_toas
+        self.n_toa_max = T
+        self.n_epoch_max = E
+        p = np.asarray([[math.cos(tr.dec_rad) * math.cos(tr.ra_rad),
+                         math.cos(tr.dec_rad) * math.sin(tr.ra_rad),
+                         math.sin(tr.dec_rad)] for tr in truths])
+        self.positions = p
+        # staged host arrays, padded to (N, T): padded rows repeat the
+        # last sample and carry rowmask 0 (exact masking, like the
+        # fleet's bucket padding)
+        self.t_sec = np.zeros((N, T))
+        self.sigma_scaled_s = np.zeros((N, T))
+        self.rowmask = np.zeros((N, T))
+        self.epoch_idx = np.zeros((N, T), np.int32)
+        for i, tr in enumerate(truths):
+            n = tr.ntoas
+            self.t_sec[i, :n] = t_sec_rows[i]
+            self.t_sec[i, n:] = t_sec_rows[i][-1]
+            ss = tr.efac * np.sqrt(tr.sigma_us ** 2
+                                   + tr.equad_us ** 2) * 1e-6
+            self.sigma_scaled_s[i, :n] = ss
+            self.rowmask[i, :n] = 1.0
+            self.epoch_idx[i, :n] = epoch_rows[i]
+            self.epoch_idx[i, n:] = epoch_rows[i][-1]
+        self.red_ag = np.asarray([[tr.red_log10_amp, tr.red_gamma]
+                                  for tr in truths])
+        self.ecorr_s = np.asarray([tr.ecorr_us * 1e-6 for tr in truths])
+        tspan_s = sc.cadence.span_days * 86400.0
+        self.f_red = np.arange(1, sc.n_red_modes + 1) / tspan_s
+        self.f_gwb = np.arange(1, sc.n_gwb_modes + 1) / tspan_s
+        # the O(1) Hellings-Downs correlation factor, Cholesky-factored
+        # ONCE on the true-IEEE host (the hmc_sample range-safety idiom)
+        self._L_hd = np.linalg.cholesky(
+            hd_correlation_matrix(p) + 1e-10 * np.eye(N))
+        self._prog = self._build_program()
+        self._chunk_cache: dict = {}
+        self._dev_cache: dict = {}
+        self._sig = (f"pta|seed={sc.seed}|n={N}|T={T}"
+                     f"|cs={sc.chunk_size}|Kr={sc.n_red_modes}"
+                     f"|Kg={sc.n_gwb_modes}")
+        n_classes = len({tr.ntoas for tr in truths})
+        _log.info("pta scenario: %d pulsar(s), %d TOA shape class(es), "
+                  "T=%d, %d chunk(s) of %d", N, n_classes, T,
+                  self.n_chunks, sc.chunk_size)
+
+    # -- device synthesis ------------------------------------------------------
+
+    @property
+    def n_chunks(self) -> int:
+        cs = self.scenario.chunk_size
+        return (self.scenario.n_pulsars + cs - 1) // cs
+
+    def _build_program(self):
+        from pint_tpu import aot
+
+        def one(ts, sig, rm, ei, zw, zr, ag, ze, ec, wg, gwb_ag,
+                f_red, f_gwb):
+            def basis(f):
+                ph = 2.0 * jnp.pi * ts[:, None] * f[None, :]
+                # alternating sin/cos pairs, like the PLRedNoise basis
+                return jnp.stack([jnp.sin(ph), jnp.cos(ph)],
+                                 axis=2).reshape(ts.shape[0], -1)
+
+            def weights(f, log10a, gamma):
+                psd = powerlaw_psd(f, 10.0 ** log10a, gamma)
+                return jnp.repeat(psd * f[0], 2)
+
+            white = sig * zw
+            red = basis(f_red) @ (
+                jnp.sqrt(weights(f_red, ag[0], ag[1])) * zr)
+            gw = basis(f_gwb) @ (
+                jnp.sqrt(weights(f_gwb, gwb_ag[0], gwb_ag[1])) * wg)
+            ecor = ec * jnp.take(ze, ei)
+            d = (white + red + gw + ecor) * rm
+            rms = jnp.sqrt(jnp.sum(d * d)
+                           / jnp.maximum(jnp.sum(rm), 1.0))
+            return jnp.concatenate([d, rms[None]])
+
+        prog = jax.jit(jax.vmap(
+            one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                          None, None, None)))
+        return aot.serve("pta_noise", prog,
+                         f"{self._sig_static()}")
+
+    def _sig_static(self) -> str:
+        sc = self.scenario
+        return (f"n={sc.n_pulsars}|cs={sc.chunk_size}"
+                f"|Kr={sc.n_red_modes}|Kg={sc.n_gwb_modes}")
+
+    def _chunk_idx(self, ci: int) -> List[int]:
+        cs = self.scenario.chunk_size
+        lo = ci * cs
+        hi = min(lo + cs, self.scenario.n_pulsars)
+        return list(range(lo, hi)) + [hi - 1] * (cs - (hi - lo))
+
+    def _chunk_args(self, ci: int, realization: int):
+        """Device-resident staged inputs for chunk ``ci`` — staged once
+        per (chunk, realization) and cached, the fleet ``_chunk_args``
+        idiom: steady-state simulation pays no host->device staging."""
+        key = (ci, int(realization))
+        args = self._chunk_cache.get(key)
+        if args is not None:
+            return args
+        sc = self.scenario
+        idx = self._chunk_idx(ci)
+        T, E = self.n_toa_max, self.n_epoch_max
+        zw = np.zeros((len(idx), T))
+        zr = np.zeros((len(idx), 2 * sc.n_red_modes))
+        ze = np.zeros((len(idx), E))
+        drawn: dict = {}
+        for j, i in enumerate(idx):
+            if i not in drawn:
+                rng = np.random.default_rng(
+                    (sc.seed, _STREAM_NOISE, i, int(realization)))
+                drawn[i] = (rng.standard_normal(T),
+                            rng.standard_normal(2 * sc.n_red_modes),
+                            rng.standard_normal(E))
+            zw[j], zr[j], ze[j] = drawn[i]
+        args = jax.device_put((
+            jnp.asarray(self.t_sec[idx]),
+            jnp.asarray(self.sigma_scaled_s[idx]),
+            jnp.asarray(self.rowmask[idx]),
+            jnp.asarray(self.epoch_idx[idx]),
+            jnp.asarray(zw), jnp.asarray(zr),
+            jnp.asarray(self.red_ag[idx]),
+            jnp.asarray(ze), jnp.asarray(self.ecorr_s[idx])))
+        self._chunk_cache[key] = args
+        return args
+
+    def _dev_const(self, name: str, value: np.ndarray):
+        d = self._dev_cache.get(name)
+        if d is None:
+            d = self._dev_cache[name] = jax.device_put(
+                jnp.asarray(value))
+        return d
+
+    def _gwb_rows(self, ci: int, w: np.ndarray) -> np.ndarray:
+        """The per-chunk common-process coefficient rows — the
+        ``nan_gwb_draw`` failpoint's hook."""
+        return np.asarray(w[self._chunk_idx(ci)], np.float64)
+
+    def _host_synth(self, idx: Sequence[int], w: np.ndarray,
+                    gwb_ag: np.ndarray, realization: int) -> np.ndarray:
+        """Pure-numpy mirror of the device synthesis — the scan's
+        fallback path when a chunk's dispatch is exhausted (the
+        ``corrupt_sim_chunk`` reroute leg)."""
+        sc = self.scenario
+
+        def w8(f, log10a, gamma):
+            lp = (2.0 * math.log(10.0) * log10a
+                  - math.log(12.0 * math.pi ** 2)
+                  + (gamma - 3.0) * math.log(1.0 / (365.25 * 86400.0))
+                  - gamma * np.log(f))
+            return np.repeat(np.exp(lp) * f[0], 2)
+
+        out = np.zeros((len(idx), self.n_toa_max))
+        for j, i in enumerate(idx):
+            rng = np.random.default_rng(
+                (sc.seed, _STREAM_NOISE, i, int(realization)))
+            zw = rng.standard_normal(self.n_toa_max)
+            zr = rng.standard_normal(2 * sc.n_red_modes)
+            ze = rng.standard_normal(self.n_epoch_max)
+            ts = self.t_sec[i]
+
+            def basis(f):
+                ph = 2.0 * math.pi * ts[:, None] * f[None, :]
+                return np.stack([np.sin(ph), np.cos(ph)],
+                                axis=2).reshape(len(ts), -1)
+
+            d = self.sigma_scaled_s[i] * zw
+            d = d + basis(self.f_red) @ (
+                np.sqrt(w8(self.f_red, *self.red_ag[i])) * zr)
+            d = d + basis(self.f_gwb) @ (
+                np.sqrt(w8(self.f_gwb, gwb_ag[0], gwb_ag[1])) * w[i])
+            d = d + self.ecorr_s[i] * ze[self.epoch_idx[i]]
+            out[j] = d * self.rowmask[i]
+        return out
+
+    # warmup budget: the ONE vmapped synthesis program plus the tiny
+    # staging executables; steady state on the audit fixture (4
+    # pulsars, 2 chunks) is 1 dispatch + 1 result fetch per chunk and
+    # one host->device push of the per-realization common-process rows,
+    # compiles == retraces == 0.  The comm budget is measured on
+    # batch-mesh NamedSharding avals (see hlo_audit._hlo_pta_simulate).
+    @dispatch_contract("pta_simulate", max_compiles=6,
+                       max_dispatches=4, max_transfers=8,
+                       warm_from_store=True,
+                       max_collectives={"all-gather": 2},
+                       max_comm_bytes=16384,
+                       max_device_peak_bytes=1 << 21)
+    def simulate(self, *, realization: int = 0,
+                 gwb_log10_amp: object = "scenario",
+                 checkpoint: Optional[str] = None, resume: bool = False,
+                 max_retries: int = 2,
+                 checkpoint_every: int = 1) -> "Simulation":
+        """Synthesize one noise realization and return the fleet-shaped
+        :class:`Simulation`.
+
+        Dispatch contract ``pta_simulate``: generation rides
+        :func:`pint_tpu.runtime.run_checkpointed_scan` over pulsar
+        chunks — steady state is 1 dispatch + 1 fetch per chunk, zero
+        compiles, zero retraces.  A chunk whose dispatch raises or
+        returns non-finite values is retried ``max_retries`` times and
+        then requeued onto the pure-numpy host fallback
+        (ChunkStatus.REROUTED); a SIGTERM mid-scan flushes the
+        checkpoint and raises ``ScanInterrupted``; a resume restores
+        completed chunks bit-identically (delays for resumed chunks are
+        re-synthesized deterministically from the same seeds).
+
+        ``gwb_log10_amp`` overrides the scenario's common-process
+        amplitude (pass ``None`` for the no-injection null leg — SAME
+        per-pulsar noise streams, correlated process off, so
+        injected/null pairs are directly comparable)."""
+        sc = self.scenario
+        amp = sc.gwb_log10_amp if gwb_log10_amp == "scenario" \
+            else gwb_log10_amp
+        eff_amp = _NULL_LOG10_AMP if amp is None else float(amp)
+        N, T, cs = sc.n_pulsars, self.n_toa_max, sc.chunk_size
+        gwb_ag = np.asarray([eff_amp, sc.gwb_gamma])
+        Z = np.random.default_rng(
+            (sc.seed, _STREAM_GWB, int(realization))
+        ).standard_normal((N, 2 * sc.n_gwb_modes))
+        # host-Cholesky mixing: w rows are HD-correlated across pulsars
+        w = self._L_hd @ Z
+        delays = np.zeros((N, T))
+        have = np.zeros(N, bool)
+
+        def dispatch(ci, args, w_rows):
+            return np.asarray(self._prog(
+                *args, jnp.asarray(w_rows), jnp.asarray(gwb_ag),
+                self._dev_const("f_red", self.f_red),
+                self._dev_const("f_gwb", self.f_gwb)))
+
+        disp = faultinject.wrap("corrupt_sim_chunk", dispatch)
+        rows_fn = faultinject.wrap("nan_gwb_draw", self._gwb_rows)
+
+        def run_chunk(ci, lo, hi):
+            args = self._chunk_args(ci, realization)
+            w_rows = rows_fn(ci, w)
+            profiling.count("pta.chunk_dispatch")
+            with telemetry.span("pta.sim_chunk", chunk=ci, lo=lo,
+                                hi=hi):
+                out = disp(ci, args, w_rows)   # ONE fetch per chunk
+            delays[lo:hi] = out[:hi - lo, :T]
+            have[lo:hi] = True
+            return out[:hi - lo, T]
+
+        def fallback(ci, lo, hi):
+            profiling.count("pta.chunk_fallback")
+            d = self._host_synth(self._chunk_idx(ci), w, gwb_ag,
+                                 realization)[:hi - lo]
+            delays[lo:hi] = d
+            have[lo:hi] = True
+            rm = self.rowmask[lo:hi]
+            return np.sqrt(np.sum(d * d, axis=1)
+                           / np.maximum(np.sum(rm, axis=1), 1.0))
+
+        with telemetry.span("pta.simulate", n_pulsars=N,
+                            realization=int(realization),
+                            gwb_log10_amp=eff_amp):
+            results, summary = runtime.run_checkpointed_scan(
+                N, run_chunk, chunk_size=cs, fallback=fallback,
+                checkpoint=checkpoint, resume=resume,
+                max_retries=max_retries,
+                checkpoint_every=checkpoint_every,
+                signature=(f"{self._sig}|r={int(realization)}"
+                           f"|amp={eff_amp:g}"))
+            # chunks restored from a resume checkpoint never ran this
+            # process's run_chunk: re-synthesize their delays from the
+            # same deterministic streams (bit-identical by seeding)
+            for ci in range(summary.n_chunks):
+                lo, hi = ci * cs, min((ci + 1) * cs, N)
+                if not have[lo:hi].all():
+                    args = self._chunk_args(ci, realization)
+                    out = dispatch(ci, args, self._gwb_rows(ci, w))
+                    delays[lo:hi] = out[:hi - lo, :T]
+                    have[lo:hi] = True
+
+        pulsars = []
+        for i, tr in enumerate(self.truths):
+            toas = copy.deepcopy(self.base_toas[i])
+            toas.utc = mjdmod.add_sec(toas.utc, delays[i, :tr.ntoas])
+            toas.compute_TDBs(ephem=toas.ephem)
+            toas.compute_posvels(ephem=toas.ephem, planets=False)
+            pulsars.append(SimulatedPulsar(
+                tr.name, copy.deepcopy(self.models[i]), toas, tr))
+        return Simulation(tuple(pulsars), summary, delays,
+                          self.positions, np.asarray(results),
+                          float(eff_amp), int(realization), self)
+
+
+class Simulation(NamedTuple):
+    """One realization of a scenario: fleet-shaped pulsars plus the
+    scan provenance and the injected-delay truth."""
+
+    pulsars: Tuple[SimulatedPulsar, ...]
+    scan: runtime.ScanSummary
+    delays_sec: np.ndarray        #: (N, T) injected delays (padded)
+    positions: np.ndarray         #: (N, 3) unit vectors
+    rms_sec: np.ndarray           #: (N,) per-pulsar injected-delay rms
+    gwb_log10_amp: float          #: effective amplitude (incl. null)
+    realization: int
+    run: "ScenarioRun"
+
+    @property
+    def ntoas_total(self) -> int:
+        return int(sum(p.truth.ntoas for p in self.pulsars))
+
+    def fleet(self, **kw):
+        """A :class:`pint_tpu.fleet.FleetFitter` over the whole
+        simulated array — one shared model structure, power-of-two TOA
+        classes, so the bucket set stays bounded by construction."""
+        from pint_tpu.fleet import FleetFitter
+
+        kw.setdefault("track_mode", "nearest")
+        kw.setdefault("chunk_size", min(8, len(self.pulsars)))
+        return FleetFitter([(p.name, p.model, p.toas)
+                            for p in self.pulsars], **kw)
+
+    def serve_jobs(self, svc) -> list:
+        """Prepare every pulsar as a :class:`pint_tpu.serve.
+        TimingService` job — the daemon's realistic heavy-traffic
+        corpus (power-of-two quantization means the jobs reuse the
+        factory's bounded shape classes)."""
+        return [svc.prepare(p.model, p.toas, name=p.name)
+                for p in self.pulsars]
+
+
+def build(scenario: Scenario) -> ScenarioRun:
+    """Build a scenario's host state + device program (deterministic:
+    two builds of the same scenario produce bit-identical TOAs)."""
+    return ScenarioRun(scenario)
+
+
+# --- the correlation / detection stage ----------------------------------------
+
+def correlate(sim: Simulation, resid: Dict[str, np.ndarray], *,
+              bin_days: float = 30.0, n_angle_bins: int = 8,
+              min_common_bins: int = 4,
+              n_scrambles: int = 128) -> Dict[str, object]:
+    """Per-pair residual cross-correlations vs the Hellings-Downs
+    curve.
+
+    Each pulsar's post-fit residuals are averaged onto a common coarse
+    time grid (``bin_days``); every pulsar pair with at least
+    ``min_common_bins`` co-observed bins contributes
+    ``rho_ab = <r_a r_b>`` over the common bins.  A one-parameter
+    least squares fits ``rho_ab = kappa * chi(theta_ab)`` (kappa is
+    the common-process variance scale, the optimal-statistic
+    analogue).  Pairs are also binned by angular separation for the
+    curve-shape consistency check.
+
+    The detection S/N is **sky-scramble calibrated**: pairs share
+    pulsars, so the naive per-pair scatter underestimates Var(kappa)
+    — rho_ab and rho_ac covary through the shared r_a — and
+    ``kappa/sigma_kappa`` runs hot under strong per-pulsar noise (the
+    classic optimal-statistic caveat).  The standard PTA answer is to
+    re-fit kappa against the HD curve of randomly permuted sky
+    positions — same rho vector, same shared-pulsar covariance, no HD
+    alignment — and quote ``snr = (kappa - mean_scramble) /
+    std_scramble`` against that empirical null (the naive number is
+    kept as ``snr_naive``).  Scrambles are deterministic in
+    (scenario seed, realization).  The per-angular-bin uncertainties
+    (``rho_bin_sem``) are delete-one-pulsar jackknife estimates for
+    the same reason — a per-pair sem divides by a pair count whose
+    members are not independent."""
+    N = len(sim.pulsars)
+    t0 = min(float(p.truth.t_mjd[0]) for p in sim.pulsars)
+    t1 = max(float(p.truth.t_mjd[-1]) for p in sim.pulsars)
+    nb = int((t1 - t0) / bin_days) + 1
+    R = np.zeros((N, nb))
+    W = np.zeros((N, nb))
+    for a, p in enumerate(sim.pulsars):
+        tr = p.truth
+        r = np.asarray(resid[p.name], np.float64)
+        sig = tr.efac * np.sqrt(tr.sigma_us ** 2
+                                + tr.equad_us ** 2) * 1e-6
+        iv = 1.0 / (sig * sig)
+        idx = np.clip(((tr.t_mjd - t0) / bin_days).astype(int),
+                      0, nb - 1)
+        np.add.at(W[a], idx, iv)
+        np.add.at(R[a], idx, r * iv)
+    M = W > 0.0
+    R = np.where(M, R / np.maximum(W, 1e-300), 0.0)
+    Mf = M.astype(np.float64)
+    C = R @ R.T
+    Nc = Mf @ Mf.T
+    theta = np.arccos(np.clip(sim.positions @ sim.positions.T,
+                              -1.0, 1.0))
+    iu = np.triu_indices(N, 1)
+    ok = Nc[iu] >= min_common_bins
+    rho = (C[iu] / np.maximum(Nc[iu], 1.0))[ok]
+    th = theta[iu][ok]
+    chi = hd_curve(th)
+    denom = float(np.sum(chi * chi))
+    kappa = float(np.sum(rho * chi) / denom)
+    scatter = rho - kappa * chi
+    kappa_sigma = float(np.sqrt(
+        np.sum(scatter * scatter) / max(len(rho) - 1, 1) / denom))
+    snr_naive = kappa / kappa_sigma if kappa_sigma > 0 else 0.0
+    rng = np.random.default_rng(
+        (sim.run.scenario.seed, 977, sim.realization))
+    ks = np.empty(max(int(n_scrambles), 1))
+    for s in range(len(ks)):
+        perm = rng.permutation(N)
+        chi_s = hd_curve(theta[np.ix_(perm, perm)][iu][ok])
+        d = float(np.sum(chi_s * chi_s))
+        ks[s] = np.sum(rho * chi_s) / d if d > 0.0 else 0.0
+    scr_mu, scr_sd = float(np.mean(ks)), float(np.std(ks))
+    # degenerate at tiny N (few distinct permutations): fall back to
+    # the naive number rather than divide by ~0
+    snr = ((kappa - scr_mu) / scr_sd) if scr_sd > 0.0 \
+        else float(snr_naive)
+    edges = np.linspace(0.0, math.pi, n_angle_bins + 1)
+    bi = np.clip(np.digitize(th, edges) - 1, 0, n_angle_bins - 1)
+    ii, jj = iu[0][ok], iu[1][ok]
+    rho_bin = np.zeros(n_angle_bins)
+    rho_sem = np.zeros(n_angle_bins)
+    n_bin = np.zeros(n_angle_bins, np.int64)
+    for b in range(n_angle_bins):
+        m = bi == b
+        n_bin[b] = int(m.sum())
+        if n_bin[b]:
+            rho_bin[b] = float(np.mean(rho[m]))
+            naive = float(np.std(rho[m])
+                          / math.sqrt(max(n_bin[b], 1)))
+            # pairs in a bin share pulsars, so the per-pair sem
+            # underestimates Var(mean) — a delete-one-pulsar jackknife
+            # sees the shared-r_a covariance the pair count hides
+            S = float(np.sum(rho[m]))
+            Sp = (np.bincount(ii[m], weights=rho[m], minlength=N)
+                  + np.bincount(jj[m], weights=rho[m], minlength=N))
+            cp = (np.bincount(ii[m], minlength=N)
+                  + np.bincount(jj[m], minlength=N))
+            valid = (cp > 0) & (n_bin[b] - cp > 0)
+            if valid.sum() >= 2:
+                mp = (S - Sp[valid]) / (n_bin[b] - cp[valid])
+                k = float(valid.sum())
+                jk = math.sqrt((k - 1.0) / k
+                               * float(np.sum((mp - mp.mean()) ** 2)))
+                rho_sem[b] = max(jk, naive)
+            else:
+                rho_sem[b] = naive
+    centers = 0.5 * (edges[:-1] + edges[1:])
+    return {
+        "kappa": kappa, "kappa_sigma": kappa_sigma,
+        "snr": float(snr), "snr_naive": float(snr_naive),
+        "scramble_mean": scr_mu, "scramble_sigma": scr_sd,
+        "n_scrambles": int(len(ks)), "n_pairs": int(len(rho)),
+        "theta_bin_rad": [float(c) for c in centers],
+        "rho_bin": [float(v) for v in rho_bin],
+        "rho_bin_sem": [float(v) for v in rho_sem],
+        "n_bin": [int(v) for v in n_bin],
+        "hd_bin": [float(v) for v in kappa * hd_curve(centers)],
+    }
+
+
+def run_experiment(scenario: Scenario, *, run: Optional[ScenarioRun]
+                   = None, maxiter: int = 6, bin_days: float = 30.0,
+                   n_angle_bins: int = 8, null: bool = True,
+                   realization: int = 0,
+                   fleet_kwargs: Optional[dict] = None
+                   ) -> Dict[str, object]:
+    """The end-to-end GW workload: simulate -> fleet timing solutions
+    -> bucketed post-fit residuals -> Hellings-Downs correlation fit +
+    detection S/N, with an optional no-injection null leg (same seeds,
+    common process off) for calibration.  Per-stage walls ride the
+    telemetry spans and come back in ``stages``."""
+    t_all = time.monotonic()
+    if run is None:
+        run = build(scenario)
+    stages: Dict[str, float] = {}
+
+    def leg(sim):
+        t0 = time.monotonic()
+        with telemetry.span("pta.stage", stage="fit"):
+            ff = sim.fleet(maxiter=maxiter, **(fleet_kwargs or {}))
+            res = ff.fit()
+        t1 = time.monotonic()
+        with telemetry.span("pta.stage", stage="correlate"):
+            resid = ff.residuals(res)
+            corr = correlate(sim, resid, bin_days=bin_days,
+                             n_angle_bins=n_angle_bins)
+        t2 = time.monotonic()
+        corr["n_ok"] = int(sum(
+            e.status.name in ("CONVERGED", "MAXITER")
+            for e in res.entries))
+        corr["n_buckets"] = res.n_buckets
+        corr["n_programs"] = res.n_programs
+        return corr, t1 - t0, t2 - t1
+
+    with telemetry.span("pta.experiment",
+                        n_pulsars=scenario.n_pulsars):
+        t0 = time.monotonic()
+        sim = run.simulate(realization=realization)
+        stages["simulate_s"] = round(time.monotonic() - t0, 3)
+        hd, fit_s, corr_s = leg(sim)
+        stages["fit_s"] = round(fit_s, 3)
+        stages["correlate_s"] = round(corr_s, 3)
+        out: Dict[str, object] = {
+            "n_pulsars": scenario.n_pulsars,
+            "ntoas_total": sim.ntoas_total,
+            "gwb_log10_amp": sim.gwb_log10_amp,
+            "scan": sim.scan.counts(), "hd": hd,
+        }
+        if null:
+            t0 = time.monotonic()
+            sim0 = run.simulate(realization=realization,
+                                gwb_log10_amp=None)
+            hd0, fit0_s, corr0_s = leg(sim0)
+            stages["null_s"] = round(time.monotonic() - t0, 3)
+            out["null"] = hd0
+    stages["total_s"] = round(time.monotonic() - t_all, 3)
+    out["stages"] = stages
+    return out
+
+
+# --- CLI ----------------------------------------------------------------------
+
+def _scenario_from_args(args) -> Scenario:
+    amp = None if str(args.gwb_amp).lower() in ("none", "off") \
+        else float(args.gwb_amp)
+    return Scenario(
+        n_pulsars=args.n, seed=args.seed, chunk_size=args.chunk_size,
+        cadence=Cadence(span_days=args.span_days,
+                        cadence_days=args.cadence_days),
+        gwb_log10_amp=amp)
+
+
+def main(argv=None) -> int:
+    """``python -m pint_tpu.pta simulate|experiment`` — one JSON line
+    with chunk-status provenance: the subprocess surface the tooling
+    tests drive under ``PINT_TPU_FAULTS`` (``corrupt_sim_chunk`` must
+    show up as a named REROUTED chunk here)."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.pta",
+        description="PTA scenario factory / Hellings-Downs workload")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--n", type=int, default=8)
+        p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--chunk-size", type=int, default=4)
+        p.add_argument("--span-days", type=float, default=360.0)
+        p.add_argument("--cadence-days", type=float, default=15.0)
+        p.add_argument("--gwb-amp", default="-13.3",
+                       help="log10 amplitude, or 'none'")
+
+    psim = sub.add_parser("simulate",
+                          help="factory only -> scan provenance JSON")
+    common(psim)
+    psim.add_argument("--checkpoint", default=None)
+    psim.add_argument("--resume", action="store_true")
+    pexp = sub.add_parser("experiment",
+                          help="simulate -> fit -> correlate JSON")
+    common(pexp)
+    pexp.add_argument("--no-null", action="store_true")
+    pexp.add_argument("--maxiter", type=int, default=6)
+    args = ap.parse_args(argv)
+
+    telemetry.install_excepthook()
+    runtime.acquire_backend()
+    sc = _scenario_from_args(args)
+    if args.cmd == "simulate":
+        run = build(sc)
+        sim = run.simulate(checkpoint=args.checkpoint,
+                           resume=args.resume)
+        statuses = [s.name for s in sim.scan.statuses]
+        line = {
+            "mode": "simulate", "n_pulsars": sc.n_pulsars,
+            "ntoas_total": sim.ntoas_total,
+            "n_chunks": sim.scan.n_chunks,
+            "statuses": sim.scan.counts(),
+            "chunk_statuses": statuses,
+            "retried_chunks": [i for i, s in enumerate(statuses)
+                               if s == "RETRIED"],
+            "rerouted_chunks": [i for i, s in enumerate(statuses)
+                                if s == "REROUTED"],
+            "failures": sim.scan.failures,
+            "rms_us": round(float(np.mean(sim.rms_sec)) * 1e6, 4),
+        }
+        print(json.dumps(line))
+        return 0 if sim.scan.ok else 1
+    out = run_experiment(sc, null=not args.no_null,
+                         maxiter=args.maxiter)
+    line = {"mode": "experiment", "n_pulsars": out["n_pulsars"],
+            "ntoas_total": out["ntoas_total"],
+            "scan": out["scan"], "stages": out["stages"],
+            "hd_snr": round(out["hd"]["snr"], 3),
+            "hd_kappa": out["hd"]["kappa"],
+            "null_snr": round(out["null"]["snr"], 3)
+            if "null" in out else None}
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover
+    # delegate to the canonical module instance so failpoints/counters
+    # registered against `pint_tpu.pta` see the same module state
+    import sys
+
+    from pint_tpu import pta as _canonical
+
+    sys.exit(_canonical.main())

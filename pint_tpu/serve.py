@@ -838,14 +838,36 @@ def main(argv=None) -> int:
     chk.add_argument("--wait-ms", type=float, default=40.0)
     chk.add_argument("--batch-size", type=int, default=2)
     chk.add_argument("--stagger-ms", type=float, default=2.0)
+    chk.add_argument("--corpus", choices=("demo", "pta"),
+                     default="demo",
+                     help="traffic corpus: the 4-pulsar demo set, or "
+                     "a simulated PTA fleet (pint_tpu.pta factory)")
+    chk.add_argument("--pta-n", type=int, default=8,
+                     help="pulsar count for --corpus pta")
     args = ap.parse_args(argv)
 
     # a crashed check leaves a flight recording when
     # PINT_TPU_TELEMETRY_DUMP is set — the black-box subprocess surface
     telemetry.install_excepthook()
     st = runtime.acquire_backend()
-    svc, jobs = _demo_service(batch_size=args.batch_size, maxiter=3,
-                              max_wait_ms=args.wait_ms)
+    if args.corpus == "pta":
+        # the factory's first realistic heavy-traffic corpus: a
+        # simulated fleet whose power-of-two shape classes land in the
+        # daemon's bounded bucket set by construction (ISSUE 15)
+        from pint_tpu import pta
+
+        run = pta.build(pta.Scenario(
+            n_pulsars=args.pta_n, seed=0,
+            chunk_size=min(8, args.pta_n),
+            cadence=pta.Cadence(span_days=360.0, cadence_days=15.0)))
+        sim = run.simulate()
+        svc = TimingService(batch_size=args.batch_size, maxiter=3,
+                            max_wait_ms=args.wait_ms)
+        jobs = sim.serve_jobs(svc)
+    else:
+        svc, jobs = _demo_service(batch_size=args.batch_size,
+                                  maxiter=3,
+                                  max_wait_ms=args.wait_ms)
     # warm the bucket programs inline so the daemon-phase stats measure
     # the serving policy, not first-call compiles; under request_flood
     # the warmup is rejected too — then nothing dispatches and no

@@ -164,6 +164,23 @@ def _assert_schema(d, fast=False):
     # /metrics scrape: None unless PINT_TPU_METRICS_PORT opted in (the
     # slow TestMetricsEndpoint leg exercises the exporter-on path)
     assert sv.get("metrics_scrape") is None, sv.get("metrics_scrape")
+    # PTA axis (ISSUE 15): fleet-scale simulation throughput + the
+    # Hellings-Downs workload numbers ride the series, so a factory or
+    # correlator regression shows up as a bench diff
+    for key in ("sim_toas_per_sec", "pta_fleet_fits_per_sec",
+                "pta_pipeline_wall_s", "hd_snr"):
+        assert isinstance(d.get(key), (int, float)), (key, d.get(key))
+    assert d["sim_toas_per_sec"] > 0
+    assert d["pta_fleet_fits_per_sec"] > 0
+    assert d["pta_pipeline_wall_s"] > 0
+    pta = d["submetrics"].get("pta")
+    assert isinstance(pta, dict) and "error" not in pta, pta
+    assert pta["n_pulsars"] >= 2 and pta["ntoas_total"] > 0
+    assert pta["n_ok"] == pta["n_pulsars"], pta
+    # every simulate chunk completed on the device path
+    assert pta["scan"].get("OK", 0) == sum(pta["scan"].values()) > 0, pta
+    assert d["sim_toas_per_sec"] == pta["sim_toas_per_sec"]
+    assert d["pta_pipeline_wall_s"] == pta["pipeline_wall_s"]
 
 
 def test_quick_steady_state_never_recompiles(quick_line):

@@ -129,6 +129,12 @@ class TestCleanLeg:
         # request recompilation is structurally impossible
         assert reports["serve_request"].steady.dispatches == 1
         assert reports["serve_request"].steady.transfers_h2d == 0
+        # steady-state PTA simulation really is 1 dispatch + 1 fetch
+        # per chunk (the audit fixture is 4 pulsars / chunk width 2),
+        # with only the per-realization common-process rows crossing
+        # host->device
+        assert reports["pta_simulate"].steady.dispatches == 2
+        assert reports["pta_simulate"].steady.compiles == 0
 
 
 class TestSeededRegressions:

@@ -1,0 +1,185 @@
+"""Unit coverage for ``pint_tpu.simulation`` — the zima fake-TOA
+backbone the PTA scenario factory builds on.
+
+Four properties, each load-bearing for the factory:
+
+* **seed determinism** — ``make_fake_toas_uniform`` with the same seed
+  is bit-identical (the PTA factory's rebuild guarantee rests on the
+  same discipline); different seeds differ.
+* **basis conventions** — ``add_correlated_noise`` injects exactly
+  ``U @ (sqrt(phi) * z)`` with U the model's concatenated noise basis,
+  and that basis agrees with the fitter's host-side
+  ``_host_noise_basis`` (the two consumers must never drift apart on
+  component order or column layout).
+* **the white-only raise** — asking for correlated noise from a model
+  with none is a loud ValueError, not a silent no-op.
+* **dispatch shape** — ``calculate_random_models`` evaluates all
+  ``Nmodels`` draws in ONE vmapped device program: the dispatch count
+  of a call is identical across draw counts (a python loop over
+  deep-copied models — the reference implementation — scales
+  linearly).
+
+Every fake build pays its own jit compiles (the TOA batch is a closure
+constant of the residual program), so the module builds exactly four
+datasets and shares them across tests (tier-1 budget).
+"""
+
+import copy
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import WLSFitter, _host_noise_basis
+from pint_tpu.lint.tracehooks import instrument
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import (add_correlated_noise,
+                                 calculate_random_models,
+                                 make_fake_toas_uniform)
+
+PAR_BASE = """
+PSR FAKE
+RAJ 04:37:15.9
+DECJ -47:15:09.1
+F0 173.6879458 1
+F1 -1.7e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 2.64 1
+TZRMJD 55000.1
+TZRFRQ 1400
+TZRSITE gbt
+EPHEM DE421
+"""
+
+NOISE_EXTRA = "ECORR tel gbt 0.5\nTNREDAMP -12.5\nTNREDGAM 3.0\nTNREDC 10\n"
+
+NTOAS = 24
+
+
+def _model(extra=""):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model((PAR_BASE + extra).strip().splitlines())
+
+
+def _fake(model, seed=7):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return make_fake_toas_uniform(
+            54900.0, 55100.0, NTOAS, model, obs="gbt", error_us=1.0,
+            add_noise=True, seed=seed)
+
+
+def _utc_arrays(toas):
+    return (np.asarray(toas.utc.day, np.int64),
+            np.asarray(toas.utc.frac, np.float64))
+
+
+@pytest.fixture(scope="module")
+def noise_setup():
+    """One correlated-noise model + fake dataset shared by the basis
+    tests (tests that shift TOAs deep-copy their own)."""
+    m = _model(NOISE_EXTRA)
+    return m, _fake(m, seed=3)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """One white-noise dataset + converged WLS fit, shared by the
+    random-models tests and the different-seed leg."""
+    m = _model()
+    toas = _fake(m, seed=4)
+    f = WLSFitter(toas, m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f.fit_toas(maxiter=3)
+    return f, toas
+
+
+class TestSeedDeterminism:
+    def test_same_seed_bit_identical(self, fitted):
+        a = _fake(_model(), seed=7)
+        b = _fake(_model(), seed=7)
+        da, fa = _utc_arrays(a)
+        db, fb = _utc_arrays(b)
+        assert np.array_equal(da, db)
+        assert np.array_equal(fa, fb)
+        assert np.array_equal(a.error_us, b.error_us)
+        # a different seed moves the arrival times (same span/grid)
+        _, f4 = _utc_arrays(fitted[1])
+        assert not np.array_equal(fa, f4)
+
+
+class TestCorrelatedNoise:
+    def test_basis_parity_with_host_path(self, noise_setup):
+        """model.noise_basis (the device/GLS path) and the fitter's
+        _host_noise_basis (the exact-covariance host path) read the
+        same pytree leaves in the same component order — bit parity."""
+        m, toas = noise_setup
+        r = Residuals(toas, m)
+        U_dev = np.asarray(m.noise_basis(r.pdict), np.float64)
+        U_host = _host_noise_basis(m, r.pdict)
+        assert U_host is not None
+        assert U_host.shape == U_dev.shape
+        assert np.array_equal(U_host, U_dev)
+
+    def test_injection_lies_in_basis_span(self, noise_setup):
+        """The injected shift is exactly U @ (sqrt(phi) z): projecting
+        the observed per-TOA shift back onto the basis reconstructs it
+        to MJD round-off (~1e-11 s: the shift lives in the day
+        fraction)."""
+        m, base = noise_setup
+        toas = copy.deepcopy(base)
+        r = Residuals(toas, m)
+        U = np.asarray(m.noise_basis(r.pdict), np.float64)
+        day0, frac0 = _utc_arrays(toas)
+        add_correlated_noise(toas, m, seed=11)
+        day1, frac1 = _utc_arrays(toas)
+        d_sec = ((day1 - day0) + (frac1 - frac0)) * 86400.0
+        assert np.max(np.abs(d_sec)) > 1e-8
+        coef, *_ = np.linalg.lstsq(U, d_sec, rcond=None)
+        assert np.allclose(U @ coef, d_sec, rtol=0.0, atol=1e-10)
+
+    def test_injection_seed_determinism(self, noise_setup):
+        m, base = noise_setup
+        shifts = []
+        for _ in range(2):
+            toas = copy.deepcopy(base)
+            day0, frac0 = _utc_arrays(toas)
+            add_correlated_noise(toas, m, seed=5)
+            day1, frac1 = _utc_arrays(toas)
+            shifts.append(((day1 - day0) + (frac1 - frac0)) * 86400.0)
+        assert np.array_equal(shifts[0], shifts[1])
+
+    def test_white_only_model_raises(self, noise_setup):
+        _, toas = noise_setup
+        with pytest.raises(ValueError,
+                           match="no correlated noise components"):
+            add_correlated_noise(copy.deepcopy(toas), _model())
+
+
+class TestRandomModels:
+    def test_single_vmap_dispatch_count(self, fitted):
+        """All Nmodels draws ride ONE vmapped program: the total
+        dispatch count of a call does not move when the draw count
+        quadruples (each call rebuilds its programs, so one-time work
+        is identical on both sides and only a per-draw python loop
+        could break the equality)."""
+        f, toas = fitted
+        counts = {}
+        for k in (8, 32):
+            with instrument() as th:
+                m0 = th.mark()
+                dt, draws = calculate_random_models(f, toas, Nmodels=k,
+                                                    seed=2)
+                m1 = th.mark()
+            assert dt.shape == (k, toas.ntoas)
+            counts[k] = (m1 - m0).dispatches
+        assert counts[8] == counts[32], counts
+        # and the evaluation is deterministic under a fixed seed
+        dt2, draws2 = calculate_random_models(f, toas, Nmodels=32,
+                                              seed=2)
+        assert np.array_equal(np.asarray(dt), np.asarray(dt2))
+        assert np.array_equal(draws, draws2)

@@ -893,3 +893,51 @@ class TestMetricsEndpoint:
         assert ms["agree"] is True, ms
         assert ms["scraped"]["completed"] == out["completed"]
         assert ms["n_samples"] > 0
+
+
+class TestPtaFactoryCLI:
+    """The PTA scenario factory's console/JSON subprocess legs
+    (ISSUE 15): a clean ``python -m pint_tpu.pta simulate`` run emits
+    machine-readable scan provenance, and the ``corrupt_sim_chunk``
+    failpoint — activated ACROSS the process boundary via
+    ``PINT_TPU_FAULTS`` — makes the simulate scan reroute the poisoned
+    chunk to the host-numpy fallback and NAME it in the JSON."""
+
+    @staticmethod
+    def _run(args=(), env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.pta", "simulate",
+             "--n", "4", "--chunk-size", "2", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_clean_simulate_emits_provenance(self):
+        import json
+
+        p = self._run()
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["mode"] == "simulate"
+        assert doc["n_pulsars"] == 4 and doc["n_chunks"] == 2
+        assert doc["chunk_statuses"] == ["OK", "OK"]
+        assert doc["rerouted_chunks"] == []
+        assert doc["rms_us"] > 0
+
+    def test_corrupt_sim_chunk_reroutes_and_names_the_chunk(self):
+        import json
+
+        p = self._run(env_extra={"PINT_TPU_FAULTS": "corrupt_sim_chunk"})
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        # the env-activated failpoint poisons chunk 1 persistently: the
+        # retry ladder exhausts the device path and reroutes THAT chunk
+        # to the deterministic host fallback — by name, not silently
+        assert doc["chunk_statuses"][1] == "REROUTED", doc
+        assert doc["rerouted_chunks"] == [1], doc
+        assert doc["chunk_statuses"][0] == "OK", doc
+        assert doc["rms_us"] > 0
