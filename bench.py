@@ -1038,6 +1038,23 @@ def bench_quick(backend_status=None):
                                 cadence_days=15.0, chunk_size=4)
         except Exception as e:  # keep the quick line alive
             pta_leg = {"error": f"{type(e).__name__}: {e}"}
+    # the precision-flow audit (ISSUE 17): every @precision_contract
+    # entrypoint traced with native x64 AND under disable_x64() +
+    # policy("dd32") must show zero PREC002/PREC003 findings — the
+    # "survives without native f64" claim as a boolean regression axis
+    if fast:
+        precflow = {"skipped": "PINT_TPU_BENCH_FAST=1"}
+    else:
+        try:
+            t1 = time.time()
+            from pint_tpu.lint.precflow import audit_precision
+
+            pf = audit_precision()
+            precflow = {"precflow_clean": not pf,
+                        "findings": [x.format() for x in pf],
+                        "wall_s": round(time.time() - t1, 2)}
+        except Exception as e:  # keep the quick line alive
+            precflow = {"error": f"{type(e).__name__}: {e}"}
     # supervised-acquisition provenance (ISSUE 4): how the backend was
     # obtained — a wedged-probe run shows up as backend_rung
     # "cpu_fallback" with attempts > 1 instead of a null metric
@@ -1106,10 +1123,17 @@ def bench_quick(backend_status=None):
         "pta_fleet_fits_per_sec": pta_leg.get("pta_fleet_fits_per_sec"),
         "pta_pipeline_wall_s": pta_leg.get("pipeline_wall_s"),
         "hd_snr": pta_leg.get("hd_snr"),
+        # precision-flow audit verdict (ISSUE 17): True when every
+        # @precision_contract entrypoint shows zero PREC002/PREC003
+        # findings on both audit legs (native x64, and rebuilt under
+        # disable_x64() + policy("dd32")); null when the leg was
+        # skipped/failed
+        "precflow_clean": precflow.get("precflow_clean"),
         "submetrics": {"fleet": fleet, "aot_cold_start": aot_cold,
                        "comm_profile": comm, "serve": serve,
                        "telemetry": telemetry_cost,
-                       "cost_cards": cost_cards, "pta": pta_leg},
+                       "cost_cards": cost_cards, "pta": pta_leg,
+                       "precflow": precflow},
     }
 
 

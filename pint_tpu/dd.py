@@ -45,6 +45,26 @@ Arrayish = Union[float, np.ndarray]
 _SPLIT_F64 = 134217729.0  # 2^27 + 1
 _SPLIT_F32 = 4097.0  # 2^12 + 1
 
+#: Precision-flow kernel registry (read by pint_tpu/lint/precflow.py).
+#: PAIR_KERNELS: public functions in THIS module whose emitted
+#: equations are pair-preserving transfer functions — their f32 word
+#: arithmetic is error-free (or error-captured) by construction, so a
+#: compensated value passing through them stays compensated.
+#: COLLAPSE_KERNELS: functions whose result genuinely discards the
+#: compensation words; a collapse to a narrow dtype of a value tainted
+#: by phase-critical inputs is exactly what rule PREC002 reports when
+#: it happens at the sanctioned-module boundary.  A new public kernel
+#: MUST be added to one of the two sets: the auditor treats unknown
+#: public dd/qs functions as collapses (conservative-by-default).
+PAIR_KERNELS = frozenset({
+    "two_sum", "quick_two_sum", "split", "two_prod", "from_float",
+    "from_two", "normalize", "add", "add_f", "sub", "mul", "mul_f",
+    "prod_ff", "sum_ff", "div", "neg", "sq", "scale_pow2",
+    "round_nearest", "floor", "horner", "horner_plain", "where",
+    "weighted_mean", "mean", "from_string", "self_check",
+})
+COLLAPSE_KERNELS = frozenset({"to_float", "astype_float"})
+
 
 def _split_const(a):
     dt = getattr(a, "dtype", None)
@@ -402,6 +422,28 @@ def _as_dd(x, like: DD) -> DD:
 def where(cond, x: DD, y: DD) -> DD:
     xp = _xp(x.hi)
     return DD(xp.where(cond, x.hi, y.hi), xp.where(cond, x.lo, y.lo))
+
+
+def weighted_mean(x: DD, w) -> DD:
+    """Weighted mean of a DD vector, as a DD (compensated reduction).
+
+    The hi and lo words are reduced separately and renormalized into a
+    pair: the mean's error is bounded by the f32 summation error of
+    each word stream (~N*eps relative), far below the pair's own
+    resolution at residual scales.  Lives here — not at the call site —
+    so the word arithmetic stays inside the sanctioned kernel modules
+    (the precision-flow auditor treats dd.py/qs.py reductions as
+    pair-preserving; see pint_tpu/lint/precflow.py)."""
+    xp = _xp(x.hi)
+    sw = xp.sum(w)
+    return from_two(xp.sum(x.hi * w) / sw, xp.sum(x.lo * w) / sw)
+
+
+def mean(x: DD) -> DD:
+    """Unweighted mean of a DD vector, as a DD (compensated reduction)."""
+    xp = _xp(x.hi)
+    n = x.hi * 0 + 1
+    return from_two(xp.sum(x.hi) / xp.sum(n), xp.sum(x.lo) / xp.sum(n))
 
 
 def self_check() -> bool:

@@ -51,6 +51,7 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "nonfinite_noise_grad", "corrupt_toa_errors", "corrupt_mjds",
            "wedged_probe", "chunk_nonfinite", "chunk_raise",
            "sigterm_midscan", "corrupt_checkpoint", "retrace_storm",
+           "collapse_dd_pair",
            "chatty_transfer", "chatty_collective", "corrupt_aot_blob",
            "stale_aot_version", "request_flood", "stalled_bucket",
            "recorder_crash", "nan_gwb_draw", "corrupt_sim_chunk"]
@@ -497,6 +498,34 @@ def chatty_transfer() -> Iterator[None]:
         yield
 
 
+def _collapse_dd_pair_factory(fn):
+    """Wrap a dd32 residual program's finishing hook so the returned
+    (hi, lo) pair is recombined with a RAW f32 add and the lo word is
+    zeroed — precision silently destroyed at a phase-critical site
+    while every shape and dtype stays identical.  The precision-flow
+    auditor (:mod:`pint_tpu.lint.precflow`) must fail PREC002 at this
+    equation, with provenance back to the feeding batch words."""
+    def collapsed(out):
+        out = fn(out)
+        hi, lo = out
+        bare = hi + lo
+        return type(out)(bare, bare * 0)
+    return collapsed
+
+
+@contextlib.contextmanager
+def collapse_dd_pair() -> Iterator[None]:
+    """Failpoint ``"collapse_dd_pair"``: dd32 residual programs built
+    inside the context collapse their compensated output pair through
+    bare f32 arithmetic (see :func:`pint_tpu.residuals.build_resid_fn`,
+    which consults this failpoint at build time — build the entrypoint
+    INSIDE the context).  Env-activatable
+    (``PINT_TPU_FAULTS=collapse_dd_pair``) for the
+    ``python -m pint_tpu.lint --precflow`` subprocess leg."""
+    with _registered("collapse_dd_pair", _collapse_dd_pair_factory):
+        yield
+
+
 def _chatty_collective_factory(fn):
     """Wrap the sharded grid's per-shard fit body with one extra
     cross-batch all-reduce per chunk — the "gratuitous collective"
@@ -610,6 +639,7 @@ def stalled_bucket() -> Iterator[None]:
 _ENV_FACTORIES = {
     "wedged_probe": _wedged_probe_factory,
     "retrace_storm": _retrace_storm_factory,
+    "collapse_dd_pair": _collapse_dd_pair_factory,
     "chatty_transfer": _chatty_transfer_factory,
     "chatty_collective": _chatty_collective_factory,
     "stale_aot_version": _stale_aot_version_factory,

@@ -26,6 +26,13 @@ both).  This package makes those conventions *checked properties*:
   — traces the residual/fitter entry points and rejects narrowing
   ``convert_element_type`` equations that are not exact error-free
   splits.
+* Precision-flow audit (:mod:`pint_tpu.lint.precflow`): **PREC002/
+  PREC003** — an abstract interpreter over the traced jaxpr assigns
+  every intermediate a precision-lattice class and proves each
+  ``@precision_contract`` entrypoint keeps its phase-critical chain
+  out of bare f32, both with native x64 and rebuilt under
+  ``disable_x64()`` + ``precision.policy("dd32")``
+  (``--precflow`` / ``--list-precision-contracts``).
 
 Run it::
 
@@ -60,3 +67,9 @@ __all__ = [
     "lint_paths", "scan_suppressions", "load_baseline", "write_baseline",
     "apply_baseline", "default_baseline_path",
 ]
+
+# NOTE: pint_tpu.lint.precflow (audit_precision, analyze_fn, the
+# precision lattice) and pint_tpu.lint.contracts (precision_contract,
+# PRECISION_REGISTRY) import jax at audit time and are deliberately
+# not re-exported here — `import pint_tpu.lint` stays jax-free for the
+# AST-only fast path.

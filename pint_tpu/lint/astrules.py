@@ -111,6 +111,13 @@ RULES = {
               "its body, nested closures, or direct module-local "
               "callees — the hot path is invisible to the flight "
               "recorder",
+    "PREC002": "precision-flow audit: a phase-critical value collapses "
+               "to bare f32 in the traced program (outside the "
+               "sanctioned dd/qs kernels) — the chain does not survive "
+               "without native f64",
+    "PREC003": "precision-flow audit: a double-double pair is broken — "
+               "the hi word is consumed without its lo partner outside "
+               "the sanctioned dd/qs kernels",
 }
 
 PRECISION_MODULES = {

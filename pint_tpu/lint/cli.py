@@ -15,6 +15,12 @@ Modes:
   (:mod:`pint_tpu.lint.contracts`): drive every registered entrypoint
   (or the named subset) on the synthetic fixture and report budget
   breaches (CONTRACT001) and steady-state retraces (CONTRACT002).
+* ``--precflow[=NAME[,NAME]]`` — the precision-flow audit
+  (:mod:`pint_tpu.lint.precflow`): trace every
+  ``@precision_contract`` entrypoint (or the named subset) with
+  native x64 AND under ``disable_x64()`` + ``policy("dd32")``, and
+  report phase-critical bare-f32 collapses (PREC002) and broken dd
+  pairs (PREC003).
 
 Rule filtering: ``--select CODE[,CODE]`` keeps only those codes,
 ``--ignore CODE[,CODE]`` drops them (select wins when both name a
@@ -46,9 +52,10 @@ def _build_parser() -> argparse.ArgumentParser:
         description="Precision & trace-safety static analyzer for pint_tpu "
                     "(AST rules DD001/PREC001/TRACE001/TRACE002/JIT001/"
                     "JIT002/SHARD001/SHARD002, the JAXPR001 runtime jaxpr "
-                    "audit, and the CONTRACT001-CONTRACT004 dispatch-"
+                    "audit, the CONTRACT001-CONTRACT004 dispatch-"
                     "contract audit incl. the warm-from-store cold-start "
-                    "axis and the SPMD collective-communication budgets). "
+                    "axis and the SPMD collective-communication budgets, "
+                    "and the PREC002/PREC003 precision-flow audit). "
                     "Exit codes: 0 clean (always 0 with "
                     "--update-baseline), 1 new findings, 2 usage error.")
     ap.add_argument("paths", nargs="*",
@@ -84,11 +91,21 @@ def _build_parser() -> argparse.ArgumentParser:
                          "(or the named subset) on the synthetic fixture "
                          "and report budget breaches / steady-state "
                          "retraces")
+    ap.add_argument("--precflow", nargs="?", const="all", default=None,
+                    metavar="NAME[,NAME]",
+                    help="run the precision-flow audit instead of the "
+                         "AST rules: trace every @precision_contract "
+                         "entrypoint (or the named subset) with native "
+                         "x64 and under disable_x64()+policy('dd32'), "
+                         "and report PREC002/PREC003 findings")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
     ap.add_argument("--list-contracts", action="store_true",
                     help="print the registered dispatch contracts "
                          "(name, budgets, entrypoint) and exit")
+    ap.add_argument("--list-precision-contracts", action="store_true",
+                    help="print the registered precision contracts "
+                         "(name, chain, entrypoint) and exit")
     return ap
 
 
@@ -124,6 +141,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                   + "".join(" " + e for e in extras))
         return 0
 
+    if args.list_precision_contracts:
+        from pint_tpu.lint import contracts as con
+
+        con._ensure_registered()
+        for name in sorted(con.PRECISION_REGISTRY):
+            pc = con.PRECISION_REGISTRY[name]
+            print(f"{name:20s} {pc.qualname:30s} chain={pc.chain}")
+        return 0
+
     select = ignore = None
     if args.select is not None:
         select = {c.strip().upper() for c in args.select.split(",")
@@ -147,6 +173,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             n.strip() for n in args.contracts.split(",") if n.strip()]
         try:
             findings = con.audit_contracts(names)
+        except KeyError as exc:
+            print(f"pint-tpu-lint: {exc}", file=sys.stderr)
+            return 2
+    elif args.precflow is not None:
+        from pint_tpu.lint.precflow import audit_precision
+
+        names = None if args.precflow == "all" else [
+            n.strip() for n in args.precflow.split(",") if n.strip()]
+        try:
+            findings = audit_precision(names)
         except KeyError as exc:
             print(f"pint-tpu-lint: {exc}", file=sys.stderr)
             return 2

@@ -83,6 +83,7 @@ from pint_tpu.lint.findings import Finding, scan_suppressions
 from pint_tpu.lint.tracehooks import TraceCounters, instrument
 
 __all__ = ["Contract", "ContractReport", "REGISTRY", "dispatch_contract",
+           "PrecisionContract", "PRECISION_REGISTRY", "precision_contract",
            "check", "audit_contracts", "steady_state_counters",
            "ContractFixture", "harvest_cost_cards"]
 
@@ -164,6 +165,56 @@ def dispatch_contract(name: str, *, max_compiles: int,
             None if max_device_peak_bytes is None
             else int(max_device_peak_bytes))
         fn.__dispatch_contract__ = name
+        return fn
+
+    return deco
+
+
+class PrecisionContract(NamedTuple):
+    """One entrypoint's declared precision-critical chain.
+
+    Declares that the values named by ``chain`` (a key of
+    :data:`pint_tpu.lint.precflow.CHAINS`, selecting which program
+    inputs are precision-critical) must never collapse to bare f32
+    (PREC002) or lose a dd pair word outside a sanctioned kernel
+    (PREC003), even when the program is traced under
+    ``jax.experimental.disable_x64()``.  Audited by
+    :func:`pint_tpu.lint.precflow.audit_precision`.
+    """
+
+    name: str
+    chain: str               #: critical-input chain spec (precflow.CHAINS)
+    qualname: str            #: decorated function, for attribution
+    path: str                #: decoration site (suppression lookup)
+    line: int
+
+
+#: precision-contract name -> PrecisionContract, populated at import time
+PRECISION_REGISTRY: Dict[str, PrecisionContract] = {}
+
+
+def precision_contract(name: str, *, chain: str = "phase_critical"):
+    """Register a precision-flow contract for an entrypoint.
+
+    Returns the function unchanged — zero call-time cost, exactly like
+    :func:`dispatch_contract` (the two stack freely).  The precision
+    auditor (:mod:`pint_tpu.lint.precflow`) traces each registered
+    entrypoint twice — native x64 on, and under
+    ``jax.experimental.disable_x64()`` with ``policy("dd32")`` — and
+    proves the declared critical chain survives both regimes.
+    """
+    def deco(fn):
+        import inspect
+
+        try:
+            path = inspect.getsourcefile(fn) or "<unknown>"
+        except TypeError:
+            path = "<unknown>"
+        line = getattr(getattr(fn, "__code__", None), "co_firstlineno", 0)
+        PRECISION_REGISTRY[name] = PrecisionContract(
+            name, str(chain), getattr(fn, "__qualname__", str(fn)),
+            path, line)
+        fn.__precision_contract__ = name
         return fn
 
     return deco

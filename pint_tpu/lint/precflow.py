@@ -1,0 +1,824 @@
+"""Precision-flow auditor: prove the dd chain survives without native f64.
+
+The package's numerical story rests on one claim: every phase-critical
+value travels the device program either in native f64 or inside a
+compensated multi-word representation (the QS quad-single words of
+:mod:`pint_tpu.qs`, the DD pairs of :mod:`pint_tpu.dd`), and never
+passes through *bare* float32 arithmetic.  On hardware with true f64
+the claim is cheap; on TPUs — where f64 is slow emulation or absent —
+it is the whole ballgame, and :func:`pint_tpu.precision.policy`
+("dd32") exists precisely so programs can be built with x64 disabled.
+Until this module the claim was enforced only locally (AST rules over
+source text, JAXPR001 over narrowing conversions); nothing *proved*
+end-to-end that a traced entrypoint keeps its critical dataflow out of
+bare f32.
+
+This module is an abstract interpreter over closed jaxprs.  Every
+intermediate value is assigned a class from a small precision lattice:
+
+* ``EXACT_INT`` — integers, and integer-valued floats below 2^24
+  (day counts): exact in any float width.
+* ``F64`` — native float64: fine wherever it exists.
+* ``DD_PAIR`` — one word of a (hi, lo) pair created by the
+  ``pint_tpu_eft_guard`` primitive; the partner word is tracked through
+  a shared *pair group* so breaking the pair is detectable.
+* ``COMPENSATED_F32`` — an f32 word participating in a compensated
+  representation (QS words, exact-split words, outputs of sanctioned
+  dd/qs kernels).
+* ``BARE_F32`` — plain f32 arithmetic: precision is gone.
+* ``BOTTOM`` — unreached (join identity).
+
+Alongside the class, each value carries a *taint set* (which critical
+inputs feed it — ``F0__qs`` words, ``tdb_frac_w``, the TZR phase
+words…) and a bounded *provenance* chain of the source locations that
+produced it, so a finding names not just the offending equation but
+the path from the feeding input.
+
+**Sanctioned kernels.**  dd.py and qs.py internally do f32 arithmetic
+on purpose — that is what an error-free transformation *is*.  For each
+equation the auditor walks the jax user-frame stack and finds the
+OUTERMOST frame inside dd.py/qs.py.  If that frame's function is a
+declared pair-preserving kernel (``dd.PAIR_KERNELS`` /
+``qs.PAIR_KERNELS``) or a private helper, the equation is
+pair-preserving: f32 outputs are ``COMPENSATED_F32``, never findings.
+If it is a declared collapse kernel (``to_f64``, ``to_float``…) the
+output class follows its dtype — ``F64`` when x64 is on, ``BARE_F32``
+(a PREC002 on tainted data) when it is not.  An *unknown public* dd/qs
+function is treated as a collapse: new kernels must be declared, they
+do not ride in sanctioned.
+
+**Rules.**
+
+* **PREC002** — a tainted value TRANSITIONS into ``BARE_F32``: the
+  equation where phase-critical precision is destroyed (reported once
+  per collapse site, with the provenance chain back to the feeding
+  input).
+* **PREC003** — a tainted ``DD_PAIR`` member is consumed by a
+  non-sanctioned, non-structural equation without its partner among
+  the inputs: the pair is broken even though no individual op narrowed
+  anything.
+
+Structural primitives (broadcast/reshape/transpose/slice/…) propagate
+class, taint and pair membership instead of breaking them;
+``pjit``/``scan``/``while``/``cond``/``custom_*`` sub-jaxprs are
+entered with the caller's states (loop carries are re-run once after
+joining, branch outputs are joined).
+
+**Driving it.**  Entrypoints declare themselves with
+``@precision_contract(name, chain="phase_critical")``
+(:mod:`pint_tpu.lint.contracts`); :func:`audit_precision` traces each
+declared entrypoint TWICE on a small barycentric fixture — once with
+native x64, once rebuilt entirely under
+``jax.experimental.disable_x64()`` with ``precision.policy("dd32")`` —
+and both legs must come back clean.  Run it:
+``python -m pint_tpu.lint --precflow`` (subset:
+``--precflow=name1,name2``; list: ``--list-precision-contracts``).
+The seeded regression proving the auditor catches a real break is
+``faultinject.collapse_dd_pair``, which recombines the residual DD
+pair with a raw f32 add — PREC002 fires at the faultinject site with
+provenance back to ``tdb_frac_w``.
+
+Suppression uses the shared syntax at the reported call site::
+
+    x = qs.to_f64(frac)  # ddlint: disable=PREC002 <why this is fine>
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from pint_tpu.lint.findings import Finding, scan_suppressions
+
+__all__ = [
+    "BOTTOM", "EXACT_INT", "F64", "DD_PAIR", "COMPENSATED_F32", "BARE_F32",
+    "VarState", "join", "join_states", "ChainSpec", "CHAINS",
+    "analyze_closed_jaxpr", "analyze_fn", "audit_precision",
+]
+
+# --- the lattice --------------------------------------------------------------
+
+BOTTOM = "bottom"
+EXACT_INT = "exact_int"
+F64 = "f64"
+DD_PAIR = "dd_pair"
+COMPENSATED_F32 = "compensated_f32"
+BARE_F32 = "bare_f32"
+
+#: every class, in no particular order (the lattice is not a chain)
+CLASSES = (BOTTOM, EXACT_INT, F64, DD_PAIR, COMPENSATED_F32, BARE_F32)
+
+
+def join(a: str, b: str) -> str:
+    """Least-upper-bound of two precision classes (used where control
+    flow merges: cond branches, loop carries).  ``BARE_F32`` absorbs —
+    a value that is bare on ANY path is bare; ``EXACT_INT`` is neutral
+    (exact in every representation); mixing distinct wide
+    representations degrades conservatively to ``COMPENSATED_F32``
+    (still not a finding — only ``BARE_F32`` is)."""
+    if a == b:
+        return a
+    if a == BOTTOM:
+        return b
+    if b == BOTTOM:
+        return a
+    if BARE_F32 in (a, b):
+        return BARE_F32
+    if a == EXACT_INT:
+        return b
+    if b == EXACT_INT:
+        return a
+    # distinct members of {F64, DD_PAIR, COMPENSATED_F32}
+    return COMPENSATED_F32
+
+
+@dataclass(frozen=True)
+class VarState:
+    """Abstract state of one jaxpr value."""
+
+    cls: str = BOTTOM
+    taint: frozenset = frozenset()      #: critical-input labels feeding it
+    group: Optional[int] = None         #: pair-group id (DD_PAIR partners)
+    prov: tuple = ()                    #: bounded provenance (loc strings)
+
+
+_UNTRACKED = VarState(BARE_F32)         # untainted fallback
+
+_PROV_CAP = 8
+
+
+def join_states(a: VarState, b: VarState) -> VarState:
+    return VarState(
+        join(a.cls, b.cls), a.taint | b.taint,
+        a.group if a.group == b.group else None,
+        a.prov if len(a.prov) >= len(b.prov) else b.prov)
+
+
+# --- chains: which inputs are precision-critical ------------------------------
+
+
+class ChainSpec(NamedTuple):
+    """What "critical" means for one declared chain: program inputs
+    whose pytree path matches ``param_pattern``, plus the named TOA
+    batch columns (matched against jaxpr constants by identity, or by
+    bitwise equality for staged copies)."""
+
+    param_pattern: str
+    batch_fields: Tuple[str, ...]
+
+
+#: chain name (the ``chain=`` of ``@precision_contract``) -> spec
+CHAINS: Dict[str, ChainSpec] = {
+    "phase_critical": ChainSpec(
+        param_pattern=r"__qs|__fracqs|__tzrphase__",
+        batch_fields=("tdb_day", "tdb_frac", "tdb_frac_w", "pulse_number"),
+    ),
+}
+
+
+# --- jaxpr plumbing -----------------------------------------------------------
+
+_SANCTIONED_FILES = {"dd.py", "qs.py"}
+
+#: primitives that move values without doing arithmetic on them —
+#: class/taint/pair membership passes straight through
+_STRUCTURAL = {
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "expand_dims",
+    "slice", "dynamic_slice", "dynamic_update_slice", "rev", "copy",
+    "stop_gradient", "gather", "pad", "reduce_precision", "select_n",
+    "concatenate",
+}
+
+#: primitives under which integer-valued-exact floats stay exact
+_INT_EXACT = {"add", "sub", "neg", "mul", "max", "min", "round", "floor",
+              "ceil", "abs", "convert_element_type", "reduce_sum",
+              "reduce_max", "reduce_min"}
+
+_GUARD_PRIM = "pint_tpu_eft_guard"
+
+
+def _float_bits(dtype) -> Optional[int]:
+    name = getattr(dtype, "name", str(dtype))
+    return {"float16": 16, "bfloat16": 16,
+            "float32": 32, "float64": 64}.get(name)
+
+
+def _dtype_kind(dtype) -> str:
+    name = getattr(dtype, "name", str(dtype))
+    if name.startswith(("int", "uint", "bool")):
+        return "int"
+    if name == "float64":
+        return "f64"
+    return "f32"
+
+
+def _user_frames(eqn) -> List[Tuple[str, str, str, int]]:
+    """(basename, function, path, line) per user frame, innermost
+    first."""
+    si = getattr(eqn, "source_info", None)
+    if si is None:
+        return []
+    frames = []
+    try:
+        from jax._src import source_info_util as siu
+
+        frames = list(siu.user_frames(si))
+    except Exception:
+        tb = getattr(si, "traceback", None)
+        if tb is not None and hasattr(tb, "frames"):
+            frames = list(tb.frames)
+    out = []
+    for fr in frames:
+        path = getattr(fr, "file_name", None) or \
+            getattr(fr, "filename", None) or ""
+        line = getattr(fr, "start_line", None) or \
+            getattr(fr, "line_num", None) or getattr(fr, "lineno", 0) or 0
+        func = getattr(fr, "function_name", None) or \
+            getattr(fr, "name", "") or ""
+        if path:
+            out.append((os.path.basename(path), func, path, int(line)))
+    return out
+
+
+_SUPPRESS_CACHE: dict = {}
+_SRC_CACHE: dict = {}
+
+
+def _suppressed(path: Optional[str], line: Optional[int], code: str) -> bool:
+    if not path or not line or not os.path.isfile(path):
+        return False
+    sup = _SUPPRESS_CACHE.get(path)
+    if sup is None:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                sup = scan_suppressions(fh.read())
+        except OSError:
+            sup = scan_suppressions("")
+        _SUPPRESS_CACHE[path] = sup
+    return sup.is_suppressed(code, line)
+
+
+def _src_line(path: Optional[str], line: Optional[int]) -> str:
+    if not path or not line or not os.path.isfile(path):
+        return ""
+    lines = _SRC_CACHE.get(path)
+    if lines is None:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            lines = []
+        _SRC_CACHE[path] = lines
+    return lines[line - 1] if 0 < line <= len(lines) else ""
+
+
+def _as_closed(val):
+    """(open jaxpr, consts) from whatever an eqn param holds."""
+    if hasattr(val, "jaxpr"):                       # ClosedJaxpr
+        return val.jaxpr, list(val.consts)
+    if hasattr(val, "eqns"):                        # open Jaxpr
+        return val, []
+    return None, []
+
+
+# --- the interpreter ----------------------------------------------------------
+
+
+class _Ctx:
+    """Shared analysis state: finding sink, dedup, const classifier,
+    pair-group allocator."""
+
+    def __init__(self, name: str,
+                 classify_const: Callable[[object], Optional[str]]):
+        self.name = name
+        self.classify_const = classify_const
+        self.findings: List[Finding] = []
+        self._emitted: set = set()
+        self._next_group = 0
+
+    def new_group(self) -> int:
+        self._next_group += 1
+        return self._next_group
+
+    def emit(self, code: str, path: Optional[str], line: Optional[int],
+             message: str) -> None:
+        key = (code, path or "", line or 0)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        if _suppressed(path, line, code):
+            return
+        self.findings.append(Finding(
+            code, path or f"<traced {self.name}>", line or 0, 0, message,
+            source=_src_line(path, line), origin="precflow"))
+
+
+def _init_state(aval, label: Optional[str]) -> VarState:
+    """Initial class of a program input/constant from its dtype; a
+    critical f32 input is a compensated word (the exact splits), never
+    bare."""
+    kind = _dtype_kind(getattr(aval, "dtype", None))
+    taint = frozenset([label]) if label else frozenset()
+    if kind == "int":
+        return VarState(EXACT_INT, taint)
+    if kind == "f64":
+        return VarState(F64, taint)
+    return VarState(COMPENSATED_F32 if label else BARE_F32, taint)
+
+
+def _literal_state(var) -> VarState:
+    kind = _dtype_kind(getattr(getattr(var, "aval", None), "dtype", None))
+    if kind == "int":
+        return VarState(EXACT_INT)
+    val = getattr(var, "val", None)
+    try:
+        if val is not None and float(val) == float(int(val)) and \
+                abs(float(val)) < 2 ** 24:
+            return VarState(EXACT_INT)
+    except (TypeError, ValueError, OverflowError):
+        pass
+    return VarState(F64 if kind == "f64" else BARE_F32)
+
+
+def _is_literal(var) -> bool:
+    return not hasattr(var, "count") and hasattr(var, "val")
+
+
+def _literal_is_zero(var) -> bool:
+    try:
+        import numpy as np
+
+        return _is_literal(var) and np.all(np.asarray(var.val) == 0)
+    except Exception:
+        return False
+
+
+def _loc_tag(frames, prim: str) -> str:
+    if frames:
+        base, _fn, _path, line = frames[0]
+        return f"{base}:{line}({prim})"
+    return f"<nowhere>({prim})"
+
+
+def _extend_prov(states: Sequence[VarState], tag: str) -> tuple:
+    best: tuple = ()
+    for s in states:
+        if s.taint and len(s.prov) > len(best):
+            best = s.prov
+    if best and best[-1] == tag:
+        return best
+    return (best + (tag,))[-_PROV_CAP:]
+
+
+def _sanction(frames) -> Tuple[Optional[str], Optional[str], tuple]:
+    """Outermost dd.py/qs.py frame classification.
+
+    Returns ``(verdict, kernel, call_site)`` where verdict is ``None``
+    (not inside dd/qs), ``"pair"`` or ``"collapse"``; call_site is the
+    first frame OUTSIDE the sanctioned region (where the module-boundary
+    call happened — the actionable location for a collapse finding).
+    """
+    idx = None
+    for i, (base, _fn, _path, _line) in enumerate(frames):
+        if base in _SANCTIONED_FILES:
+            idx = i
+    if idx is None:
+        return None, None, ()
+    base, fn, _path, _line = frames[idx]
+    call_site = frames[idx + 1] if idx + 1 < len(frames) else frames[idx]
+    from pint_tpu import dd as _dd
+    from pint_tpu import qs as _qs
+
+    mod = _dd if base == "dd.py" else _qs
+    if fn in mod.PAIR_KERNELS or fn.startswith("_"):
+        return "pair", fn, call_site
+    # declared collapse kernels AND unknown public names both collapse:
+    # a new kernel must be declared in PAIR_KERNELS to ride sanctioned
+    return "collapse", fn, call_site
+
+
+def _taint_msg(taint: frozenset) -> str:
+    return ", ".join(sorted(taint)) or "<untainted>"
+
+
+def _prov_msg(prov: tuple) -> str:
+    return " -> ".join(prov) if prov else "<no provenance>"
+
+
+def _run_jaxpr(jaxpr, in_states: Sequence[VarState],
+               const_states: Sequence[VarState], ctx: _Ctx) -> List[VarState]:
+    env: Dict[object, VarState] = {}
+    for v, s in zip(jaxpr.constvars, const_states):
+        env[v] = s
+    for v, s in zip(jaxpr.invars, in_states):
+        env[v] = s
+
+    def state_of(var) -> VarState:
+        if _is_literal(var):
+            return _literal_state(var)
+        return env.get(var, _UNTRACKED)
+
+    for eqn in jaxpr.eqns:
+        outs = _eval_eqn(eqn, [state_of(v) for v in eqn.invars], ctx,
+                         jaxpr.eqns)
+        for v, s in zip(eqn.outvars, outs):
+            env[v] = s
+    return [state_of(v) for v in jaxpr.outvars]
+
+
+def _consts_states(consts, ctx: _Ctx) -> List[VarState]:
+    out = []
+    for c in consts:
+        out.append(_init_state(
+            type("A", (), {"dtype": getattr(c, "dtype", None)})(),
+            ctx.classify_const(c)))
+    return out
+
+
+def _eval_sub(eqn, states: Sequence[VarState], ctx: _Ctx
+              ) -> Optional[List[VarState]]:
+    """Interprocedural step: run the eqn's sub-jaxpr(s) with the
+    caller's states.  Returns out states, or None if this eqn has no
+    sub-jaxpr (caller falls through to the local transfer functions)."""
+    prim = eqn.primitive.name
+    params = eqn.params
+    if prim == "scan":
+        sub, consts = _as_closed(params["jaxpr"])
+        nc, ncarry = params["num_consts"], params["num_carry"]
+        body_consts = list(states[:nc])
+        carry = list(states[nc:nc + ncarry])
+        xs = list(states[nc + ncarry:])
+        cstates = _consts_states(consts, ctx)
+        outs = _run_jaxpr(sub, body_consts + carry + xs, cstates, ctx)
+        # re-run once with joined carries (bounded fixpoint: one widening
+        # round is enough for a monotone join over a finite lattice of
+        # this depth in practice)
+        carry2 = [join_states(a, b) for a, b in zip(carry, outs[:ncarry])]
+        outs = _run_jaxpr(sub, body_consts + carry2 + xs, cstates, ctx)
+        return outs[:ncarry] + outs[ncarry:]
+    if prim == "while":
+        csub, cconsts = _as_closed(params["cond_jaxpr"])
+        bsub, bconsts = _as_closed(params["body_jaxpr"])
+        cn, bn = params["cond_nconsts"], params["body_nconsts"]
+        cond_consts = list(states[:cn])
+        body_consts = list(states[cn:cn + bn])
+        carry = list(states[cn + bn:])
+        outs = _run_jaxpr(bsub, body_consts + carry,
+                          _consts_states(bconsts, ctx), ctx)
+        carry2 = [join_states(a, b) for a, b in zip(carry, outs)]
+        _run_jaxpr(csub, cond_consts + carry2,
+                   _consts_states(cconsts, ctx), ctx)
+        return _run_jaxpr(bsub, body_consts + carry2,
+                          _consts_states(bconsts, ctx), ctx)
+    if prim in ("cond", "switch"):
+        branches = params["branches"]
+        ops = list(states[1:])
+        merged: Optional[List[VarState]] = None
+        for br in branches:
+            sub, consts = _as_closed(br)
+            outs = _run_jaxpr(sub, ops, _consts_states(consts, ctx), ctx)
+            merged = outs if merged is None else [
+                join_states(a, b) for a, b in zip(merged, outs)]
+        return merged
+    # single-jaxpr wrappers: pjit / remat / custom_jvp / custom_vjp / …
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in params:
+            sub, consts = _as_closed(params[key])
+            if sub is not None:
+                return _run_jaxpr(sub, list(states),
+                                  _consts_states(consts, ctx), ctx)
+    return None
+
+
+def _eval_eqn(eqn, states: Sequence[VarState], ctx: _Ctx,
+              sibling_eqns: Sequence = ()) -> List[VarState]:
+    prim = eqn.primitive.name
+    frames = _user_frames(eqn)
+    tag = _loc_tag(frames, prim)
+    taint = frozenset().union(*[s.taint for s in states]) if states \
+        else frozenset()
+    prov = _extend_prov(states, tag)
+
+    sub_out = _eval_sub(eqn, states, ctx)
+    if sub_out is not None:
+        return sub_out
+
+    # the EFT guard: its (>=2) outputs are a freshly minted dd pair
+    if prim == _GUARD_PRIM:
+        group = ctx.new_group()
+        return [VarState(DD_PAIR, taint, group, prov) for _ in eqn.outvars]
+
+    # x * 0 (literal) is a constant, not a flow of x's precision
+    if prim == "mul" and any(_literal_is_zero(v) for v in eqn.invars):
+        return [VarState(EXACT_INT) for _ in eqn.outvars]
+
+    verdict, kernel, call_site = _sanction(frames)
+    if verdict == "pair":
+        groups = {s.group for s in states if s.group is not None}
+        group = groups.pop() if len(groups) == 1 else None
+        out = []
+        for v in eqn.outvars:
+            kind = _dtype_kind(getattr(getattr(v, "aval", None), "dtype",
+                                       None))
+            cls = {"int": EXACT_INT, "f64": F64}.get(kind, COMPENSATED_F32)
+            out.append(VarState(cls, taint,
+                                group if cls == COMPENSATED_F32 else None,
+                                prov))
+        return out
+    if verdict == "collapse":
+        out = []
+        for v in eqn.outvars:
+            kind = _dtype_kind(getattr(getattr(v, "aval", None), "dtype",
+                                       None))
+            if kind == "int":
+                out.append(VarState(EXACT_INT, taint, None, prov))
+            elif kind == "f64":
+                out.append(VarState(F64, taint, None, prov))
+            else:
+                if taint:
+                    _base, _fn, path, line = call_site
+                    ctx.emit(
+                        "PREC002", path, line,
+                        f"phase-critical value collapses to bare f32 in "
+                        f"'{kernel}' (traced '{ctx.name}'): fed by "
+                        f"{_taint_msg(taint)}; chain {_prov_msg(prov)} — "
+                        "the program does not survive without native f64")
+                out.append(VarState(BARE_F32, taint, None, prov))
+        return out
+
+    # structural data movement: pass class/taint/pair membership through
+    if prim in _STRUCTURAL:
+        data = [s for s in states if s.cls != BOTTOM] or [_UNTRACKED]
+        merged = data[0]
+        for s in data[1:]:
+            merged = join_states(merged, s)
+        return [VarState(merged.cls, taint, merged.group, prov)
+                for _ in eqn.outvars]
+
+    if prim == "convert_element_type":
+        src = states[0] if states else _UNTRACKED
+        new = eqn.params.get("new_dtype")
+        kind = _dtype_kind(new)
+        if kind == "int" or src.cls == EXACT_INT:
+            return [VarState(EXACT_INT, taint, None, prov)]
+        if kind == "f64":
+            return [VarState(F64, taint, None, prov)]
+        old_bits = _float_bits(getattr(getattr(eqn.invars[0], "aval", None),
+                                       "dtype", None))
+        if old_bits == 64:        # narrowing f64 -> f32
+            # an exact split (sibling upcast + error-capturing subtract)
+            # starts a compensated representation; anything else is a
+            # plain demotion
+            from pint_tpu.lint.jaxpr_audit import _is_exact_split
+
+            if _is_exact_split(eqn, sibling_eqns):
+                return [VarState(COMPENSATED_F32, taint, None, prov)]
+            if taint and src.cls != BARE_F32:
+                _emit_collapse(ctx, eqn, frames, prim, taint, prov)
+            return [VarState(BARE_F32, taint, None, prov)]
+        return [VarState(src.cls if src.cls != BOTTOM else BARE_F32,
+                         taint, src.group, prov)]
+
+    # generic numeric equation outside the sanctioned kernels
+    out: List[VarState] = []
+    fired_003 = False
+    for s in states:
+        if s.group is None or not s.taint or \
+                s.cls not in (DD_PAIR, COMPENSATED_F32):
+            continue
+        partner = any(o is not s and o.group == s.group for o in states)
+        if not partner:
+            _base, _fn, path, line = frames[0] if frames else ("", "", None,
+                                                               None)
+            ctx.emit(
+                "PREC003", path, line,
+                f"dd pair broken in '{prim}' (traced '{ctx.name}'): the "
+                f"hi/lo word is consumed without its partner outside the "
+                f"sanctioned dd/qs kernels; fed by {_taint_msg(s.taint)}; "
+                f"chain {_prov_msg(s.prov)}")
+            fired_003 = True
+            break
+    all_exact = all(s.cls in (EXACT_INT, BOTTOM) for s in states) \
+        if states else False
+    for v in eqn.outvars:
+        kind = _dtype_kind(getattr(getattr(v, "aval", None), "dtype", None))
+        if kind == "int":
+            out.append(VarState(EXACT_INT, taint, None, prov))
+        elif kind == "f64":
+            out.append(VarState(F64, taint, None, prov))
+        elif all_exact and prim in _INT_EXACT:
+            out.append(VarState(EXACT_INT, taint, None, prov))
+        else:
+            if taint and not fired_003 and any(
+                    s.cls not in (BARE_F32, BOTTOM) for s in states):
+                _emit_collapse(ctx, eqn, frames, prim, taint, prov)
+            out.append(VarState(BARE_F32, taint, None, prov))
+    return out
+
+
+def _emit_collapse(ctx: _Ctx, eqn, frames, prim: str, taint: frozenset,
+                   prov: tuple) -> None:
+    _base, _fn, path, line = frames[0] if frames else ("", "", None, None)
+    ctx.emit(
+        "PREC002", path, line,
+        f"phase-critical value collapses to bare f32 in '{prim}' "
+        f"(traced '{ctx.name}'): fed by {_taint_msg(taint)}; chain "
+        f"{_prov_msg(prov)} — the program does not survive without "
+        "native f64")
+
+
+# --- entry points -------------------------------------------------------------
+
+
+def analyze_closed_jaxpr(closed, invar_labels: Sequence[Optional[str]],
+                         classify_const: Callable[[object], Optional[str]]
+                         = lambda c: None,
+                         name: str = "<traced fn>") -> List[Finding]:
+    """Run the abstract interpreter over a closed jaxpr.
+
+    ``invar_labels`` marks the critical program inputs (parallel to
+    ``closed.jaxpr.invars``; ``None`` = not critical);
+    ``classify_const`` maps closure constants (at any sub-jaxpr depth)
+    to a critical label or ``None``.
+    """
+    ctx = _Ctx(name, classify_const)
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    consts = list(getattr(closed, "consts", []) or [])
+    in_states = [
+        _init_state(getattr(v, "aval", None), lab)
+        for v, lab in zip(jaxpr.invars, invar_labels)]
+    _run_jaxpr(jaxpr, in_states, _consts_states(consts, ctx), ctx)
+    return ctx.findings
+
+
+def analyze_fn(fn, *args, pattern: str = "", invar_labels=None,
+               critical_consts: Optional[Dict[str, object]] = None,
+               name: Optional[str] = None) -> List[Finding]:
+    """Trace ``fn(*args)`` and analyze it.
+
+    Critical inputs are named either explicitly (``invar_labels``,
+    parallel to the flattened args) or by regex over the argument
+    pytree paths (``pattern``); ``critical_consts`` maps labels to
+    arrays matched against closure constants by identity or bitwise
+    equality.
+    """
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    if invar_labels is None:
+        leaves = jax.tree_util.tree_flatten_with_path(args)[0]
+        rx = re.compile(pattern) if pattern else None
+        invar_labels = []
+        for path, _leaf in leaves:
+            key = jax.tree_util.keystr(path)
+            if rx and rx.search(key):
+                parts = re.findall(r"\[['\"]?([^'\"\]]+)['\"]?\]", key)
+                invar_labels.append(".".join(parts[1:] or parts) or key)
+            else:
+                invar_labels.append(None)
+    crit = dict(critical_consts or {})
+
+    def classify(c):
+        import numpy as np
+
+        for label, arr in crit.items():
+            if c is arr:
+                return label
+            try:
+                if getattr(c, "shape", None) == getattr(arr, "shape", ()) \
+                        and getattr(c, "dtype", None) == \
+                        getattr(arr, "dtype", None) \
+                        and np.array_equal(np.asarray(c), np.asarray(arr)):
+                    return label
+            except Exception:
+                continue
+        return None
+
+    return analyze_closed_jaxpr(
+        closed, invar_labels, classify,
+        name=name or getattr(fn, "__name__", "<traced fn>"))
+
+
+# --- the audit driver ---------------------------------------------------------
+
+# Spindown-only barycentric fixture: delays are identically zero, so
+# the whole phase-critical chain is the QS/DD time axis — exactly what
+# the dd32 policy must carry.  (Validated: the dd32 residuals of this
+# fixture agree with the f64 path to <0.1 ns.)
+_PREC_PAR = """
+PSR PRECFLOW
+F0 300.0 1
+F1 -1.0e-15 1
+PEPOCH 55000
+TZRMJD 55000.05
+TZRFRQ 0
+TZRSITE bary
+"""
+
+
+def _fixture(ntoas: int = 12):
+    """(model, toas) under the CURRENT x64/policy context — legs must
+    build their own so staged dtypes match the regime under test."""
+    import warnings
+
+    import numpy as np
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.models import get_model
+        from pint_tpu.toa import get_TOAs_array
+
+        model = get_model(_PREC_PAR.strip().splitlines())
+        t = 55000.0 + np.linspace(0.0, 10.0, ntoas)
+        toas = get_TOAs_array(t, obs="bary", freqs_mhz=np.inf)
+    return model, toas
+
+
+def _drv_residuals(ntoas: int):
+    """(fn, args, batch) for the 'residuals' precision contract."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.residuals import Residuals
+
+        model, toas = _fixture(ntoas)
+        resid = Residuals(toas, model)
+    return resid._fn, (resid.pdict,), resid.batch
+
+
+#: contract name -> fixture driver (a registered contract with no
+#: driver is itself a finding — audits cannot silently rot)
+_DRIVERS: Dict[str, Callable] = {
+    "residuals": _drv_residuals,
+}
+
+
+def _audit_leg(name: str, chain: ChainSpec, leg: str,
+               ntoas: int) -> List[Finding]:
+    fn, args, batch = _DRIVERS[name](ntoas)
+    crit = {}
+    for f in chain.batch_fields:
+        arr = getattr(batch, f, None)
+        if arr is not None:
+            crit[f"batch.{f}"] = arr
+    findings = analyze_fn(fn, *args, pattern=chain.param_pattern,
+                          critical_consts=crit, name=f"{name}[{leg}]")
+    return findings
+
+
+def audit_precision(names: Optional[Sequence[str]] = None,
+                    ntoas: int = 12) -> List[Finding]:
+    """Audit every ``@precision_contract`` entrypoint (or the named
+    subset), each traced twice: native x64, and rebuilt under
+    ``jax.experimental.disable_x64()`` + ``precision.policy("dd32")``.
+
+    Raises ``KeyError`` for an unknown name (the CLI maps it to exit
+    2, matching ``--contracts``).  ``PINT_TPU_SKIP_PRECFLOW=1`` skips
+    the audit entirely (returns no findings).
+    """
+    if os.environ.get("PINT_TPU_SKIP_PRECFLOW") == "1":
+        return []
+    import jax
+
+    from pint_tpu import precision
+    from pint_tpu.lint.contracts import PRECISION_REGISTRY, \
+        _ensure_registered
+
+    _ensure_registered()
+    selected = sorted(PRECISION_REGISTRY)
+    if names:
+        unknown = sorted(set(names) - set(selected))
+        if unknown:
+            raise KeyError(
+                f"unknown precision contract(s): {', '.join(unknown)} "
+                f"(declared: {', '.join(selected) or '<none>'})")
+        selected = sorted(names)
+    findings: List[Finding] = []
+    for name in selected:
+        pc = PRECISION_REGISTRY[name]
+        if name not in _DRIVERS:
+            findings.append(Finding(
+                "PREC002", pc.path, pc.line, 0,
+                f"precision contract '{name}' has no audit driver in "
+                "pint_tpu.lint.precflow._DRIVERS — the declared chain "
+                "is not being proven", origin="precflow"))
+            continue
+        if pc.chain not in CHAINS:
+            findings.append(Finding(
+                "PREC002", pc.path, pc.line, 0,
+                f"precision contract '{name}' names unknown chain "
+                f"'{pc.chain}' (known: {', '.join(sorted(CHAINS))})",
+                origin="precflow"))
+            continue
+        chain = CHAINS[pc.chain]
+        # leg 1: native x64, default policy — f64 collapses are real f64
+        findings += _audit_leg(name, chain, "x64", ntoas)
+        # leg 2: the TPU-realistic regime — no wide dtype exists, the
+        # dd32 policy must carry the chain in compensated pairs
+        with jax.experimental.disable_x64():
+            with precision.policy("dd32"):
+                findings += _audit_leg(name, chain, "x64_off+dd32", ntoas)
+    return findings

@@ -28,17 +28,29 @@ from pint_tpu.utils import taylor_horner
 SECS_PER_DAY = 86400.0
 
 
-def dt_seconds_qs(p: dict, batch: TOABatch, delay, epoch_name: str):
-    """(t_TDB - epoch - delay) in seconds, as (QS, f64) views.
+def dt_seconds_qs(p: dict, batch: TOABatch, delay, epoch_name: str,
+                  view: str = "f64"):
+    """(t_TDB - epoch - delay) in seconds, as (QS, side-view) pairs.
 
     The QS path: integer-day difference (exact in f32: |Δday| < 2^24) +
-    exact frac words - epoch frac words - delay, all error-free; the f64
-    view is the collapse for delay-level consumers.
+    exact frac words - epoch frac words - delay, all error-free.  The
+    side view for delay-level consumers is ``view="f64"`` (native-f64
+    collapse, the default) or ``view="dd"`` (compensated two-float
+    pair via :func:`pint_tpu.qs.to_dd` — the dd32-policy path, which
+    never touches a wide dtype and so survives
+    ``jax.experimental.disable_x64()`` intact).
     """
     day0, frac0_qs, ddays = mjd_parts(p, epoch_name)
-    # integer day count, |Δday| < 2^24: the f32 cast is exact
-    dday = (batch.tdb_day.astype(jnp.float64)
-            - day0).astype(jnp.float32)  # ddlint: disable=JAXPR001
+    # integer day count, |Δday| < 2^24: the f32 cast is exact.  Under
+    # view="dd" the wide leg is skipped entirely (no f64 request with
+    # x64 disabled); the difference of exact-in-f32 integer days is
+    # itself exact
+    if view == "dd":
+        dday = (batch.tdb_day.astype(jnp.float32)  # ddlint: disable=PREC002
+                - day0.astype(jnp.float32))
+    else:
+        dday = (batch.tdb_day.astype(jnp.float64)
+                - day0).astype(jnp.float32)  # ddlint: disable=JAXPR001,PREC002
     w = batch.tdb_frac_w
     dt_days = qs.QS(dday, w[:, 0], w[:, 1], jnp.zeros_like(dday))
     dt_days = qs.add(dt_days, qs.QS(w[:, 2], *[jnp.zeros_like(dday)] * 3))
@@ -49,6 +61,8 @@ def dt_seconds_qs(p: dict, batch: TOABatch, delay, epoch_name: str):
     # f64 precision, ample at their scales
     shift = -delay - ddays * SECS_PER_DAY
     dt_sec = qs.add(dt_sec, qs.from_f64_device(shift))
+    if view == "dd":
+        return dt_sec, qs.to_dd(dt_sec)
     return dt_sec, qs.to_f64(dt_sec)
 
 
@@ -104,30 +118,47 @@ class Spindown(PhaseComponent):
         return None
 
     def phase(self, p: dict, batch: TOABatch, delay, is_tzr=False):
+        from pint_tpu import precision
         from pint_tpu.models.timing_model import dv, pqs
 
         names = self.f_names()
+        view = precision.phase_view()
         if self.PEPOCH.value is not None:
-            dt_qs, dt64 = dt_seconds_qs(p, batch, delay, "PEPOCH")
+            dt_qs, dt64 = dt_seconds_qs(p, batch, delay, "PEPOCH",
+                                        view=view)
         else:
             # no epoch: time measured from MJD given by the data itself is
             # not meaningful for higher derivatives; validate() forbids it
-            day0 = batch.tdb_day[0].astype(jnp.float64)
             # exact: integer day count < 2^24
-            dday = (batch.tdb_day.astype(jnp.float64)
-                    - day0).astype(jnp.float32)  # ddlint: disable=JAXPR001
+            if view == "dd":
+                day0 = batch.tdb_day[0].astype(jnp.float32)
+                dday = batch.tdb_day.astype(jnp.float32) \
+                    - day0  # ddlint: disable=PREC002
+            else:
+                day0 = batch.tdb_day[0].astype(jnp.float64)
+                dday = (batch.tdb_day.astype(jnp.float64) - day0) \
+                    .astype(jnp.float32)  # ddlint: disable=JAXPR001,PREC002
             w = batch.tdb_frac_w
             dt_days = qs.QS(dday, w[:, 0], w[:, 1], w[:, 2])
             dt_qs = qs.mul_w(dt_days, jnp.float32(SECS_PER_DAY))
             dt_qs = qs.add(dt_qs, qs.from_f64_device(-delay))
-            dt64 = qs.to_f64(dt_qs)
+            dt64 = qs.to_dd(dt_qs) if view == "dd" else qs.to_f64(dt_qs)
 
         zero32 = jnp.zeros_like(dt_qs.w0)
         coeffs_qs = [qs.zeros_like(zero32)] + [
             qs.QS(*[jnp.broadcast_to(x, zero32.shape)
                     for x in pqs(p, n).words]) for n in names]
         ph = qs.horner_taylor(dt_qs, coeffs_qs)
-        # differentiable correction from the fit offsets, exact at f64
+        # differentiable correction from the fit offsets: exact at f64
+        # under the default policy; under dd32 the same Taylor sum runs
+        # in compensated DD so it survives without a wide dtype (the
+        # dt collapse to bare f32 here is what PREC002 would report)
+        if view == "dd":
+            from pint_tpu import dd as ddm
+
+            dph_dd = ddm.horner(dt64, [dt64.hi * 0] +
+                                [dv(p, n) for n in names])
+            return qs.add(ph, qs.from_dd_device(dph_dd))
         dph = taylor_horner(dt64, [jnp.float64(0.0)] +
                             [dv(p, n) for n in names])
         return qs.add(ph, qs.from_f64_device(dph))

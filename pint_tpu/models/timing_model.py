@@ -96,7 +96,8 @@ def pv(p: dict, name: str):
 
 def dv(p: dict, name: str):
     """Just the (traced, differentiable) offset of a parameter."""
-    return p["delta"].get(name, jnp.float64(0.0))
+    # weak-typed zero: f64 normally, f32 under disable_x64 (dd32 runs)
+    return p["delta"].get(name, jnp.asarray(0.0))
 
 
 def pqs(p: dict, name: str):

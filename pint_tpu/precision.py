@@ -1,0 +1,87 @@
+"""Dtype-policy layer: how precision-critical values are represented.
+
+The phase pipeline has two representation regimes:
+
+* ``"f64"`` (default) — delay-level quantities and the final residual
+  collapse use native float64.  Correct wherever f64 is true IEEE
+  (host, XLA:CPU) and acceptable on TPU's ~48-bit emulation for
+  delay-scale values.
+* ``"dd32"`` — the f64-less regime (real TPUs emulate f64 slowly or
+  lack it outright): every phase-critical value stays in a compensated
+  two-float f32 representation end to end.  Residual programs return a
+  :class:`pint_tpu.dd.DD` (hi, lo) pair that is combined to true f64
+  on the host; the spindown fit-offset correction runs its Taylor sum
+  in DD instead of collapsing ``dt`` to (emulated) f64.
+
+The policy is a context, captured at *build* time by the program
+builders (:func:`pint_tpu.residuals.build_resid_fn`) and re-asserted at
+trace time, so a program built under ``policy("dd32")`` stays dd32 no
+matter where it is first dispatched::
+
+    with precision.policy("dd32"):
+        r = Residuals(toas, model)     # dd32 program
+    r.phase_resids                     # combined on host, true f64
+
+Whether a dd32 program *actually* avoids bare-f32 arithmetic on the
+critical chain is not taken on faith: the precision-flow auditor
+(:mod:`pint_tpu.lint.precflow`) traces every ``@precision_contract``
+entrypoint under ``jax.experimental.disable_x64()`` and proves the
+chain never passes through the ``BARE_F32`` lattice class (rules
+PREC002/PREC003).  Residual parity of the dd32 path against the f64
+path is asserted to <=10 ns in ``tests/test_precflow.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+from typing import Iterator
+
+__all__ = ["POLICIES", "policy", "active_policy", "float_dtype",
+           "phase_view"]
+
+#: recognized dtype policies
+POLICIES = ("f64", "dd32")
+
+_POLICY: ContextVar = ContextVar("pint_tpu_precision_policy",
+                                 default="f64")
+
+
+@contextlib.contextmanager
+def policy(name: str) -> Iterator[None]:
+    """Context manager selecting the precision policy for programs
+    *built* inside it (builders capture the active policy; evaluation
+    later, outside the context, keeps the captured policy)."""
+    if name not in POLICIES:
+        raise ValueError(
+            f"unknown precision policy {name!r} (one of {POLICIES})")
+    token = _POLICY.set(name)
+    try:
+        yield
+    finally:
+        _POLICY.reset(token)
+
+
+def active_policy() -> str:
+    """The policy in effect ("f64" unless inside :func:`policy`)."""
+    return _POLICY.get()
+
+
+def float_dtype():
+    """The staging dtype for delay-level batch columns under the active
+    policy: f64 normally; f32 under "dd32", where phase-critical
+    precision rides the exact f32 word splits (``tdb_frac_w``) instead
+    of a wide scalar column.  Requesting f64 under
+    ``jax.experimental.disable_x64()`` would silently (with a warning)
+    stage f32 anyway — dd32 makes the narrow staging explicit."""
+    import jax.numpy as jnp
+
+    return jnp.float32 if _POLICY.get() == "dd32" else jnp.float64
+
+
+def phase_view() -> str:
+    """The representation phase components use for delay/offset-scale
+    side values derived from the QS time axis: "f64" (collapse to
+    native f64) or "dd" (compensated two-float pair) — see
+    :func:`pint_tpu.models.spindown.dt_seconds_qs`."""
+    return "dd" if _POLICY.get() == "dd32" else "f64"

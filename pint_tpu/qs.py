@@ -35,6 +35,22 @@ from pint_tpu.dd import two_prod, two_sum
 
 _NW = 4  # words
 
+#: Precision-flow kernel registry (read by pint_tpu/lint/precflow.py;
+#: same contract as pint_tpu.dd.PAIR_KERNELS): pair-preserving QS
+#: kernels vs genuine collapses.  ``to_f64`` is the collapse — under
+#: ``jax.experimental.disable_x64()`` its "wide" sum silently runs at
+#: f32, which is exactly the hazard rule PREC002 exists to catch;
+#: ``to_dd`` is its pair-preserving dd32-policy replacement.  Internal
+#: uses of a collapse from inside a pair kernel (round_nearest's
+#: integer-decision collapse) are sanctioned: the auditor keys on the
+#: OUTERMOST dd/qs frame at each equation.
+PAIR_KERNELS = frozenset({
+    "zeros_like", "from_words", "from_f64_host", "from_dd_host",
+    "from_f64_device", "to_dd", "from_dd_device", "add_w", "add",
+    "neg", "sub", "mul_w", "mul", "horner_taylor", "round_nearest",
+})
+COLLAPSE_KERNELS = frozenset({"to_f64"})
+
 
 class QS(NamedTuple):
     """A quad-single value = w0 + w1 + w2 + w3 (decreasing, non-overlapping)."""
@@ -149,8 +165,44 @@ def from_f64_device(x) -> QS:
     return _renorm([w0, w1, w2, jnp.zeros_like(w2)])
 
 
+def to_dd(q: QS):
+    """Compensated collapse to a two-float pair (:class:`pint_tpu.dd.DD`
+    of f32 words on device): hi = fl(w0+w1), lo carries the remaining
+    words — ~2^-48 relative, with NO wide dtype involved.  This is the
+    dd32-policy output representation (:mod:`pint_tpu.precision`): the
+    pair is combined to true f64 on the host instead of collapsing
+    in-graph through (possibly absent) native f64."""
+    from pint_tpu import dd as ddm
+
+    s, e = two_sum(q.w0, q.w1)
+    lo = e + (q.w2 + q.w3)
+    s, e = two_sum(s, lo)
+    return ddm.DD(s, e)
+
+
+def from_dd_device(d) -> QS:
+    """QS from an on-device two-float pair (inverse of :func:`to_dd`):
+    the pair's words are already f32-representable, so renormalization
+    into graded QS words is error-free."""
+    return from_words(d.hi, d.lo)
+
+
+def _widest():
+    """The widest float dtype jax will actually provide: f64, or f32
+    when x64 is disabled (requesting f64 then would stage f32 anyway,
+    with a warning per cast — this makes the narrowing explicit; the
+    precision-flow auditor reports the resulting bare-f32 collapse on
+    critical chains as PREC002)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
 def to_f64(q: QS):
-    """Collapse to float64 (true f64 on host; ~48-bit emulated on TPU)."""
+    """Collapse to float64 (true f64 on host; ~48-bit emulated on TPU;
+    bare f32 under ``disable_x64`` — use :func:`to_dd` to survive that
+    regime)."""
     if isinstance(q.w0, np.ndarray) or np.isscalar(q.w0):
         return (
             np.asarray(q.w0, np.float64)
@@ -158,13 +210,12 @@ def to_f64(q: QS):
             + np.asarray(q.w2, np.float64)
             + np.asarray(q.w3, np.float64)
         )
-    import jax.numpy as jnp
-
+    wide = _widest()
     return (
-        q.w0.astype(jnp.float64)
-        + q.w1.astype(jnp.float64)
-        + q.w2.astype(jnp.float64)
-        + q.w3.astype(jnp.float64)
+        q.w0.astype(wide)
+        + q.w1.astype(wide)
+        + q.w2.astype(wide)
+        + q.w3.astype(wide)
     )
 
 
@@ -283,9 +334,10 @@ def round_nearest(q: QS):
 def _to64(x):
     if isinstance(x, np.ndarray) or np.isscalar(x):
         return np.asarray(x, np.float64)
-    import jax.numpy as jnp
-
-    return x.astype(jnp.float64)
+    # integer-valued accumulator: exact in f32 below 2^24, so the
+    # x64-off narrowing only matters for huge pulse numbers (which the
+    # dd32 "nearest" path discards anyway)
+    return x.astype(_widest())
 
 
 def _to32(x):

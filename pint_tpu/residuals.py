@@ -20,8 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu import qs
-from pint_tpu.lint.contracts import dispatch_contract
+from pint_tpu import dd, precision, qs
+from pint_tpu.lint.contracts import dispatch_contract, precision_contract
 from pint_tpu.models.timing_model import TimingModel, pv
 from pint_tpu.toabatch import TOABatch
 
@@ -31,8 +31,9 @@ __all__ = ["Residuals", "WidebandTOAResiduals", "raw_phase_resids",
 
 def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
                      track_mode: str, subtract_mean: bool,
-                     use_weights: bool, sigma_us=None) -> jnp.ndarray:
-    """Phase residuals [cycles, f64], jit-pure.
+                     use_weights: bool, sigma_us=None,
+                     output: str = "f64"):
+    """Phase residuals [cycles], jit-pure.
 
     ``track_mode``: "nearest" drops the integer pulse number per TOA
     (non-differentiable; the rounding is excluded from gradients);
@@ -40,11 +41,24 @@ def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
     (reference `calc_phase_resids`, `/root/reference/src/pint/residuals.py:334-446`).
     The TZR reference phase is subtracted as pytree data
     (``p["const"]["__tzrphase__"]``; see ``PhaseCalc.phase``).
+
+    ``output``: "f64" collapses the QS fraction to native float64 (the
+    default); "dd32" (the :mod:`pint_tpu.precision` policy) returns a
+    compensated :class:`pint_tpu.dd.DD` (hi, lo) f32 pair instead —
+    the whole chain then involves no wide dtype, so it is exact under
+    ``jax.experimental.disable_x64()`` too, and the mean subtraction
+    runs as a compensated DD reduction.
     """
     ph = model_calc.phase(p, batch)
     # phase-flag offsets from the tim file ride in pulse_number handling in
     # the reference; here "nearest" removes any integer anyway.
     if track_mode == "use_pulse_numbers":
+        if output == "dd32":
+            # pulse numbers (~1e12) reach the device as a plain f64
+            # column today; a dd32 batch needs them as exact word
+            # splits first (ROADMAP item 4's next slice)
+            raise NotImplementedError(
+                'policy("dd32") supports track_mode="nearest" only')
         pn = batch.pulse_number
         pn = jnp.where(jnp.isnan(pn), 0.0, pn)
         # subtract the (integer-valued, f64) pulse numbers exactly: the
@@ -57,7 +71,7 @@ def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
         # gradient is exactly d(phase)/d(params) — the non-differentiable
         # integer assignment stays out of grad paths (SURVEY §7 hard-part 5)
         _, frac = qs.round_nearest(ph)
-        out = qs.to_f64(frac)
+        out = qs.to_dd(frac) if output == "dd32" else qs.to_f64(frac)
     else:
         raise ValueError(f"unknown track_mode {track_mode!r}")
     if subtract_mean:
@@ -67,14 +81,28 @@ def raw_phase_resids(model_calc, p: dict, batch: TOABatch,
             # reports (reference residuals.py:442 uses get_data_error)
             s = batch.error_us if sigma_us is None else sigma_us
             w = 1.0 / (s ** 2)
-            out = out - jnp.sum(out * w) / jnp.sum(w)
+            if output == "dd32":
+                out = dd.sub(out, dd.weighted_mean(out, w))
+            else:
+                out = out - jnp.sum(out * w) / jnp.sum(w)
+        elif output == "dd32":
+            out = dd.sub(out, dd.mean(out))
         else:
             out = out - jnp.mean(out)
     return out
 
 
+def _dd_finish(out):
+    """Identity hook on the dd32 residual pair — the build-time
+    attachment point for the ``collapse_dd_pair`` failpoint
+    (:mod:`pint_tpu.faultinject`), which replaces it with a raw f32
+    recombination that the precision-flow auditor must catch."""
+    return out
+
+
 @dispatch_contract("residuals", max_compiles=30, max_dispatches=1,
                    max_transfers=1, warm_from_store=True)
+@precision_contract("residuals", chain="phase_critical")
 # ddlint: disable=OBS001 returns a bare jitted (aot.serve-wrapped) closure — a host span wrapper would break the exported-program identity; spanned by every driver that dispatches it
 def build_resid_fn(model: TimingModel, batch: TOABatch,
                    track_mode: str, subtract_mean: bool, use_weights: bool):
@@ -96,17 +124,28 @@ def build_resid_fn(model: TimingModel, batch: TOABatch,
 
     calc = model.calc
     noise = bool(model.noise_components)
+    # the precision policy is a BUILD-time property of the program
+    # (pint_tpu.precision): capture it here and re-assert it at trace
+    # time, so a dd32 program stays dd32 no matter where the deferred
+    # first dispatch happens
+    pol = precision.active_policy()
+    finish = faultinject.wrap("collapse_dd_pair", _dd_finish)
 
     @jax.jit
     def fn(p):
-        sigma = model.scaled_toa_uncertainty(p, batch) if noise else None
-        return raw_phase_resids(calc, p, batch, track_mode,
-                                subtract_mean, use_weights, sigma_us=sigma)
+        with precision.policy(pol):
+            sigma = model.scaled_toa_uncertainty(p, batch) \
+                if noise else None
+            out = raw_phase_resids(calc, p, batch, track_mode,
+                                   subtract_mean, use_weights,
+                                   sigma_us=sigma, output=pol)
+        return finish(out) if pol == "dd32" else out
 
     served = aot.serve(
         "residuals", fn,
         aot.model_fingerprint(model, batch, track_mode, subtract_mean,
-                              use_weights, f"noise={noise}"))
+                              use_weights, f"noise={noise}",
+                              f"policy={pol}"))
     return faultinject.wrap(
         "retrace_storm", faultinject.wrap("chatty_transfer", served))
 
@@ -150,7 +189,14 @@ class Residuals:
     def phase_resids(self) -> np.ndarray:
         """Residuals in cycles."""
         if self._phase_resids is None:
-            self._phase_resids = np.asarray(self._fn(self.pdict))
+            out = self._fn(self.pdict)
+            if isinstance(out, dd.DD):
+                # dd32 policy: the program returns a compensated f32
+                # pair; the words are combined in TRUE f64 here on the
+                # host (exact: both words are f64-representable)
+                out = np.asarray(out.hi, np.float64) + \
+                    np.asarray(out.lo, np.float64)
+            self._phase_resids = np.asarray(out)
         return self._phase_resids
 
     @property

@@ -28,6 +28,7 @@ from typing import Dict, NamedTuple, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from pint_tpu import precision
 from pint_tpu.exceptions import InvalidTOAs, PintTpuWarning
 
 
@@ -235,28 +236,36 @@ def make_batch(
             obs_planet_pos_ls = {k: np.asarray(v)[keep]
                                  for k, v in obs_planet_pos_ls.items()}
     error_us = error
-    tdb_day = jnp.asarray(np.asarray(day_f, np.int64), dtype=jnp.int64)
-    tdb_frac = jnp.asarray(frac64, dtype=jnp.float64)
+    # staging dtypes follow the active precision policy: f64 by default,
+    # f32 under "dd32" where the phase-critical precision rides the
+    # exact tdb_frac_w word splits instead of a wide scalar column
+    # (requesting f64 with x64 disabled would stage f32 anyway, with a
+    # warning per column — dd32 makes the narrow staging explicit)
+    fdt = precision.float_dtype()
+    idt = jnp.int64 if fdt == jnp.float64 else jnp.int32
+    tdb_day = jnp.asarray(np.asarray(day_f, np.int64), dtype=idt)
+    tdb_frac = jnp.asarray(frac64, dtype=fdt)
     n = tdb_day.shape[0]
-    z3 = jnp.zeros((n, 3), dtype=jnp.float64)
+    z3 = jnp.zeros((n, 3), dtype=fdt)
 
     def _arr(x, default):
-        return default if x is None else jnp.asarray(x, dtype=jnp.float64)
+        return default if x is None else jnp.asarray(x, dtype=fdt)
 
     return TOABatch(
         tdb_day=tdb_day,
         tdb_frac=tdb_frac,
         tdb_frac_w=jnp.asarray(split_f64_words(frac64), dtype=jnp.float32),
-        error_us=jnp.asarray(error_us, dtype=jnp.float64),
-        freq_mhz=jnp.asarray(freq_mhz, dtype=jnp.float64),
+        error_us=jnp.asarray(error_us, dtype=fdt),
+        freq_mhz=jnp.asarray(freq_mhz, dtype=fdt),
         ssb_obs_pos_ls=_arr(ssb_obs_pos_ls, z3),
         ssb_obs_vel_c=_arr(ssb_obs_vel_c, z3),
         obs_sun_pos_ls=_arr(obs_sun_pos_ls, z3),
-        pulse_number=_arr(pulse_number, jnp.full((n,), jnp.nan)),
+        pulse_number=_arr(pulse_number, jnp.full((n,), jnp.nan, dtype=fdt)),
         obs_planet_pos_ls=(
             {}
             if obs_planet_pos_ls is None
-            else {k: jnp.asarray(v, dtype=jnp.float64) for k, v in obs_planet_pos_ls.items()}
+            else {k: jnp.asarray(v, dtype=fdt)
+                  for k, v in obs_planet_pos_ls.items()}
         ),
     )
 

@@ -181,6 +181,16 @@ def _assert_schema(d, fast=False):
     assert pta["scan"].get("OK", 0) == sum(pta["scan"].values()) > 0, pta
     assert d["sim_toas_per_sec"] == pta["sim_toas_per_sec"]
     assert d["pta_pipeline_wall_s"] == pta["pipeline_wall_s"]
+    # precision-flow axis (ISSUE 17): the "dd chain survives without
+    # native f64" claim rides the bench series as a boolean — a
+    # PREC002/PREC003 regression flips it to False with the findings
+    # enumerated in the submetric
+    assert d.get("precflow_clean") is True, \
+        d["submetrics"].get("precflow")
+    pf = d["submetrics"].get("precflow")
+    assert isinstance(pf, dict) and "error" not in pf, pf
+    assert pf["precflow_clean"] is True and pf["findings"] == [], pf
+    assert pf["wall_s"] >= 0
 
 
 def test_quick_steady_state_never_recompiles(quick_line):

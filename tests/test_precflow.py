@@ -1,0 +1,352 @@
+"""The precision-flow auditor (ISSUE 17): prove the dd chain survives
+without native f64.
+
+Three layers of evidence:
+
+* **Lattice units** — the join is commutative/idempotent, ``BARE_F32``
+  absorbs, ``EXACT_INT`` is neutral, distinct wide representations
+  degrade to ``COMPENSATED_F32`` (never silently to bare).
+* **Synthetic jaxprs** — ``analyze_fn`` on tiny functions: each rule
+  has a fire leg, a clean leg and a suppressed leg, and the
+  interprocedural step is exercised through ``scan``/``while``/``cond``
+  (including a dd pair surviving a ``lax.cond`` join).
+* **The shipped program** — the ``residuals`` contract's dd32 leg
+  (rebuilt under ``disable_x64()`` + ``policy("dd32")``) must come back
+  with ZERO findings, and the dd32 residuals must agree with the
+  native-f64 residuals to <= 10 ns: the auditor's verdict and the
+  numerics say the same thing.
+
+The subprocess CLI legs (seeded ``collapse_dd_pair`` flips the audit to
+exit 1 with eqn-level provenance) ride the slow ``test_tooling.py``.
+Skip the whole gate on WIP branches with ``PINT_TPU_SKIP_PRECFLOW=1``.
+"""
+
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pint_tpu import dd, precision
+from pint_tpu.lint import precflow
+from pint_tpu.lint.precflow import (
+    BARE_F32, BOTTOM, CHAINS, COMPENSATED_F32, DD_PAIR, EXACT_INT, F64,
+    VarState, analyze_fn, audit_precision, join, join_states,
+)
+
+_CLASSES = (BOTTOM, EXACT_INT, F64, DD_PAIR, COMPENSATED_F32, BARE_F32)
+
+
+class TestLattice:
+    def test_join_idempotent_and_commutative(self):
+        for a, b in itertools.product(_CLASSES, repeat=2):
+            assert join(a, a) == a
+            assert join(a, b) == join(b, a)
+
+    def test_bottom_is_identity(self):
+        for c in _CLASSES:
+            assert join(BOTTOM, c) == c
+
+    def test_bare_absorbs(self):
+        for c in _CLASSES:
+            if c != BOTTOM:
+                assert join(BARE_F32, c) == BARE_F32
+
+    def test_exact_int_is_neutral(self):
+        for c in _CLASSES:
+            if c not in (BOTTOM, EXACT_INT):
+                assert join(EXACT_INT, c) == c
+
+    def test_distinct_wide_reps_degrade_to_compensated(self):
+        assert join(F64, DD_PAIR) == COMPENSATED_F32
+        assert join(F64, COMPENSATED_F32) == COMPENSATED_F32
+        assert join(DD_PAIR, COMPENSATED_F32) == COMPENSATED_F32
+
+    def test_join_states_merges_taint_and_groups(self):
+        a = VarState(DD_PAIR, frozenset({"x"}), group=3)
+        b = VarState(DD_PAIR, frozenset({"y"}), group=3)
+        m = join_states(a, b)
+        assert m.cls == DD_PAIR and m.group == 3
+        assert m.taint == frozenset({"x", "y"})
+        # divergent pair groups cannot be trusted after a merge
+        assert join_states(a, VarState(DD_PAIR, group=4)).group is None
+
+
+def _x32(n=4):
+    return jnp.linspace(0.0, 1.0, n).astype(jnp.float32)
+
+
+class TestSyntheticRules:
+    """Each rule on tiny hand-built programs, critical inputs named
+    explicitly via ``invar_labels``."""
+
+    def test_prec002_fires_on_bare_mul(self):
+        def f(x):
+            return x * np.float32(1.5)
+
+        out = analyze_fn(f, _x32(), invar_labels=["x"])
+        assert [g.code for g in out] == ["PREC002"]
+        assert out[0].path.endswith("test_precflow.py")
+        assert "x" in out[0].message and "chain" in out[0].message
+
+    def test_prec002_clean_without_taint(self):
+        # the same arithmetic on a non-critical input is not a finding
+        def f(x):
+            return x * np.float32(1.5)
+
+        assert analyze_fn(f, _x32(), invar_labels=[None]) == []
+
+    def test_prec002_suppressed_at_site(self):
+        def f(x):
+            return x * np.float32(1.5)  # ddlint: disable=PREC002 test leg
+
+        assert analyze_fn(f, _x32(), invar_labels=["x"]) == []
+
+    def test_prec003_fires_on_broken_pair(self):
+        def f(x):
+            hi, lo = dd.two_sum(x, np.float32(0.125))
+            return hi * np.float32(3.0)
+
+        out = analyze_fn(f, _x32(), invar_labels=["x"])
+        assert [g.code for g in out] == ["PREC003"]
+        assert "without its partner" in out[0].message
+
+    def test_prec003_clean_when_pair_stays_sanctioned(self):
+        def f(x):
+            pair = dd.DD(*dd.two_sum(x, np.float32(0.125)))
+            return dd.add(pair, dd.from_float(np.float32(1.0)))
+
+        assert analyze_fn(f, _x32(), invar_labels=["x"]) == []
+
+    def test_exact_int_day_count_chain_is_clean(self):
+        # the day-count idiom: integer subtract, cast to f32, scale by
+        # an integer-valued constant — exact in any float width
+        def f(day):
+            dday = (day - day[0]).astype(jnp.float32)
+            return dday * np.float32(2.0)
+
+        day = jnp.arange(50000, 50004, dtype=jnp.int64)
+        assert analyze_fn(f, day, invar_labels=["day"]) == []
+
+    def test_mul_by_literal_zero_is_not_a_flow(self):
+        def f(x):
+            return x * np.float32(0.0)
+
+        assert analyze_fn(f, _x32(), invar_labels=["x"]) == []
+
+
+class TestControlFlow:
+    """The interprocedural step: findings inside sub-jaxprs surface,
+    and pair/class state survives loop carries and branch joins."""
+
+    def test_scan_body_collapse_surfaces(self):
+        def f(x):
+            def body(c, _):
+                return c * np.float32(1.5), None
+
+            c, _ = jax.lax.scan(body, x, None, length=3)
+            return c
+
+        out = analyze_fn(f, _x32(), invar_labels=["x"])
+        assert [g.code for g in out] == ["PREC002"]
+        assert out[0].path.endswith("test_precflow.py")
+
+    def test_while_body_collapse_surfaces(self):
+        def f(x):
+            def body(c):
+                return c[0] * np.float32(1.5), c[1] + 1
+
+            out = jax.lax.while_loop(lambda c: c[1] < 3, body, (x, 0))
+            return out[0]
+
+        out = analyze_fn(f, _x32(), invar_labels=["x"])
+        codes = {g.code for g in out}
+        assert codes == {"PREC002"}, out
+
+    def test_scan_carrying_dd_pair_is_clean(self):
+        def f(x):
+            def body(c, _):
+                p = dd.add_f(dd.DD(*c), np.float32(1.0))
+                return (p.hi, p.lo), None
+
+            pair = tuple(dd.two_sum(x, np.float32(0.125)))
+            c, _ = jax.lax.scan(body, pair, None, length=3)
+            return c
+
+        assert analyze_fn(f, _x32(), invar_labels=["x"]) == []
+
+    def test_cond_branch_collapse_surfaces(self):
+        def f(x, pred):
+            def t(v):
+                return v * np.float32(1.5)
+
+            def g(v):
+                return v + np.float32(0.25)
+
+            return jax.lax.cond(pred, t, g, x)
+
+        out = analyze_fn(f, _x32(), jnp.asarray(True),
+                         invar_labels=["x", None])
+        assert out and all(g.code == "PREC002" for g in out)
+
+    def test_dd_pair_survives_lax_cond(self):
+        """The edge case the pair-group join exists for: a dd pair
+        routed through both branches of a ``lax.cond`` keeps its group
+        (structural ops only), so a sanctioned consumer downstream is
+        clean while a raw consumer still breaks the pair."""
+        def routed(x, pred):
+            hi, lo = dd.two_sum(x, np.float32(0.125))
+            return jax.lax.cond(
+                pred,
+                lambda h, l: (jnp.flip(h), jnp.flip(l)),
+                lambda h, l: (h, l),
+                hi, lo)
+
+        def clean(x, pred):
+            hi2, lo2 = routed(x, pred)
+            return dd.add(dd.DD(hi2, lo2), dd.from_float(np.float32(1.0)))
+
+        def broken(x, pred):
+            hi2, _lo2 = routed(x, pred)
+            return hi2 * np.float32(3.0)
+
+        args = (_x32(), jnp.asarray(True))
+        labels = ["x", None]
+        assert analyze_fn(clean, *args, invar_labels=labels) == []
+        out = analyze_fn(broken, *args, invar_labels=labels)
+        assert [g.code for g in out] == ["PREC003"]
+
+
+class TestSplitConstWeakType:
+    """Regression for the dd32 enabling fix: ``dd._split_const`` must
+    return dtype-anchored numpy scalars, never a weak Python float —
+    a weak split constant lets JAX demote the Dekker split to the other
+    operand's (narrower) dtype and the EFT silently stops being exact."""
+
+    def test_anchored_dtypes(self):
+        c64 = dd._split_const(np.float64(2.0))
+        assert isinstance(c64, np.float64) and c64 == 134217729.0
+        c32 = dd._split_const(np.ones(3, np.float32))
+        assert isinstance(c32, np.float32) and c32 == 4097.0
+
+    def test_traced_split_stays_f64(self):
+        closed = jax.make_jaxpr(dd.split)(jnp.asarray(1.1, jnp.float64))
+        dts = {str(v.aval.dtype)
+               for eqn in closed.jaxpr.eqns for v in eqn.outvars}
+        assert dts == {"float64"}, dts
+
+    def test_traced_split_stays_f32_without_upcast(self):
+        # the f32 branch must not smuggle an f64 constant into the graph
+        closed = jax.make_jaxpr(dd.split)(jnp.asarray(1.1, jnp.float32))
+        dts = {str(v.aval.dtype)
+               for eqn in closed.jaxpr.eqns for v in eqn.outvars}
+        assert dts == {"float32"}, dts
+
+
+class TestRegistry:
+    def test_residuals_contract_is_declared(self):
+        from pint_tpu.lint import contracts as con
+
+        con._ensure_registered()
+        pc = con.PRECISION_REGISTRY.get("residuals")
+        assert pc is not None and pc.chain == "phase_critical"
+        assert pc.path.endswith("residuals.py") and pc.line > 0
+
+    def test_unknown_name_raises_key_error(self, monkeypatch):
+        monkeypatch.delenv("PINT_TPU_SKIP_PRECFLOW", raising=False)
+        with pytest.raises(KeyError, match="not_a_contract"):
+            audit_precision(["not_a_contract"])
+
+    def test_skip_env_short_circuits(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_SKIP_PRECFLOW", "1")
+        assert audit_precision(["not_a_contract"]) == []
+
+    def test_driverless_contract_is_a_finding(self):
+        from pint_tpu.lint import contracts as con
+
+        @con.precision_contract("tmp_driverless")
+        def dummy():
+            pass
+
+        try:
+            out = audit_precision(["tmp_driverless"])
+        finally:
+            con.PRECISION_REGISTRY.pop("tmp_driverless", None)
+        assert [f.code for f in out] == ["PREC002"]
+        assert "no audit driver" in out[0].message
+
+    def test_unknown_chain_is_a_finding(self, monkeypatch):
+        from pint_tpu.lint import contracts as con
+
+        @con.precision_contract("tmp_badchain", chain="no_such_chain")
+        def dummy():
+            pass
+
+        monkeypatch.setitem(precflow._DRIVERS, "tmp_badchain",
+                            lambda ntoas: None)
+        try:
+            out = audit_precision(["tmp_badchain"])
+        finally:
+            con.PRECISION_REGISTRY.pop("tmp_badchain", None)
+        assert [f.code for f in out] == ["PREC002"]
+        assert "unknown chain" in out[0].message
+
+
+def _fixture_resids(ntoas=12):
+    from pint_tpu.residuals import Residuals
+
+    model, toas = precflow._fixture(ntoas)
+    return np.asarray(Residuals(toas, model).phase_resids, np.float64)
+
+
+class TestShippedProgram:
+    """The acceptance bar on the real residual program: the dd32 leg
+    audits clean AND its numbers match native f64 to <= 10 ns."""
+
+    def test_dd32_leg_has_zero_findings(self):
+        with jax.experimental.disable_x64():
+            with precision.policy("dd32"):
+                out = precflow._audit_leg(
+                    "residuals", CHAINS["phase_critical"],
+                    "x64_off+dd32", 12)
+        assert out == [], [f.format() for f in out]
+
+    def test_dd32_residuals_match_f64_within_10ns(self):
+        r64 = _fixture_resids()
+        with jax.experimental.disable_x64():
+            with precision.policy("dd32"):
+                r32 = _fixture_resids()
+        # phase -> seconds at F0 = 300 Hz; the paper-level bar is 10 ns
+        worst_s = float(np.max(np.abs(r64 - r32))) / 300.0
+        assert worst_s <= 10e-9, f"dd32 vs f64 disagree: {worst_s:.3e} s"
+
+    @pytest.mark.slow
+    def test_full_audit_both_legs_clean(self):
+        """Depth: the whole registry, both legs per contract (native
+        x64 + rebuilt under disable_x64()+dd32), exactly what
+        ``python -m pint_tpu.lint --precflow`` gates in CI."""
+        out = audit_precision()
+        assert out == [], [f.format() for f in out]
+
+    @pytest.mark.slow
+    def test_seeded_collapse_fires_in_process(self):
+        """Depth twin of the test_tooling.py subprocess leg: the
+        collapse_dd_pair failpoint recombines the residual dd pair with
+        a raw f32 add, and the auditor pins PREC002 on the faultinject
+        site with provenance through the dd guard eqns."""
+        from pint_tpu import faultinject
+
+        with faultinject.collapse_dd_pair():
+            with jax.experimental.disable_x64():
+                with precision.policy("dd32"):
+                    out = precflow._audit_leg(
+                        "residuals", CHAINS["phase_critical"],
+                        "seeded", 12)
+        hits = [f for f in out if f.code == "PREC002"]
+        assert hits, [f.format() for f in out]
+        assert hits[0].path.endswith("faultinject.py")
+        assert "hi + lo" in hits[0].source
+        assert "dd.py" in hits[0].message  # provenance walks the guards

@@ -470,6 +470,83 @@ class TestContractsGate:
             assert name in proc.stdout, proc.stdout
 
 
+class TestPrecflowGate:
+    """The ``--precflow`` console/JSON subprocess leg (ISSUE 17; the
+    in-process gate rides tier-1 in tests/test_precflow.py): the
+    precision-flow audit must exit 0 clean on the shipped package (both
+    legs — native x64 and rebuilt under disable_x64()+policy('dd32')),
+    and exit 1 with eqn-level provenance when the seeded
+    ``collapse_dd_pair`` failpoint (crossing the process boundary via
+    ``PINT_TPU_FAULTS``) recombines the residual dd pair with a raw
+    f32 add."""
+
+    pytestmark = pytest.mark.skipif(
+        __import__("os").environ.get("PINT_TPU_SKIP_PRECFLOW") == "1",
+        reason="PINT_TPU_SKIP_PRECFLOW=1")
+
+    @staticmethod
+    def _run(args, env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.lint", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_clean_exits_zero_json(self):
+        import json
+
+        proc = self._run(["--precflow", "--format=json"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["findings"] == []
+
+    def test_seeded_collapse_exits_one_with_provenance(self):
+        """ISSUE 17 acceptance: the seeded pair collapse flips the
+        audit to exit 1, the PREC002 finding names the faultinject
+        site (file + line + source), and the message carries the
+        provenance chain from the critical inputs through the dd guard
+        eqns to the raw add."""
+        import json
+
+        proc = self._run(["--precflow=residuals", "--format=json"],
+                         {"PINT_TPU_FAULTS": "collapse_dd_pair"})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        hits = [f for f in doc["findings"] if f["code"] == "PREC002"]
+        assert hits, doc["findings"]
+        f = hits[0]
+        assert f["path"].endswith("faultinject.py"), f
+        assert f["line"] > 0 and "hi + lo" in (f.get("source") or ""), f
+        # eqn-level provenance: the chain walks dd.py guard eqns into
+        # the collapse site, and names the feeding critical inputs
+        assert "chain" in f["message"] and "dd.py" in f["message"], f
+        assert "batch." in f["message"] or "__qs" in f["message"], f
+
+    def test_seeded_collapse_github_annotation(self):
+        proc = self._run(["--precflow=residuals", "--format=github"],
+                         {"PINT_TPU_FAULTS": "collapse_dd_pair"})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        lines = proc.stdout.splitlines()
+        errs = [ln for ln in lines if ln.startswith(
+            "::error file=pint_tpu/faultinject.py")]
+        assert errs and any("PREC002" in ln for ln in errs), lines
+
+    def test_unknown_precision_contract_is_a_usage_error(self):
+        proc = self._run(["--precflow=not_a_contract"])
+        assert proc.returncode == 2
+        assert "not_a_contract" in proc.stderr
+
+    def test_list_precision_contracts(self):
+        proc = self._run(["--list-precision-contracts"])
+        assert proc.returncode == 0, proc.stderr
+        assert "residuals" in proc.stdout, proc.stdout
+        assert "phase_critical" in proc.stdout, proc.stdout
+
+
 class TestAotColdStart:
     """The REAL two-process cold-start proof (ISSUE 7 acceptance):
     process A prebuilds the AOT store (``python -m pint_tpu.aot warm``
