@@ -577,6 +577,13 @@ def bench_serve(n_requests: int = 24, batch_size: int = 2,
         "max_wait_ms": max_wait_ms, "batch_size": batch_size,
         "n_buckets": st["n_buckets"], "compile_s": round(compile_s, 2),
         "wall_s": round(wall, 4),
+        # blast-radius containment axes (ISSUE 18): all must be quiet
+        # on the healthy bench path — a nonzero quarantine count or an
+        # open breaker here is a regression, and `metrics compare`
+        # treats them as must-be-zero axes
+        "quarantined": st["quarantined"],
+        "deadline_miss_fraction": st["deadline_miss_fraction"],
+        "breaker_state": st["breaker_state"],
         # last stats-file snapshot the daemon wrote while serving
         # (ISSUE 12 live-metrics leg; schema-checked in
         # tests/test_bench_quick.py)
@@ -1109,6 +1116,12 @@ def bench_quick(backend_status=None):
         "serve_p99_ms": serve.get("p99_ms"),
         "serve_fits_per_sec": serve.get("fits_per_sec"),
         "serve_batch_occupancy": serve.get("batch_occupancy"),
+        # blast-radius containment (ISSUE 18): must-be-zero axes on the
+        # healthy bench path — quarantines or deadline misses here mean
+        # the fault machinery fired on clean traffic
+        "serve_quarantined": serve.get("quarantined"),
+        "serve_deadline_miss_fraction":
+            serve.get("deadline_miss_fraction"),
         # per-program cost cards (ISSUE 13): {entry: {flops,
         # bytes_accessed, peak_bytes, ...}}; null when the leg was
         # skipped/failed (schema-checked in tests/test_bench_quick.py
@@ -1334,6 +1347,13 @@ def main(argv=None):
             "fits_per_sec"),
         "serve_batch_occupancy": (submetrics.get("serve") or {}).get(
             "batch_occupancy"),
+        # blast-radius containment (ISSUE 18): must-be-zero on the
+        # healthy bench path (`metrics compare` gates on both)
+        "serve_quarantined": (submetrics.get("serve") or {}).get(
+            "quarantined"),
+        "serve_deadline_miss_fraction": (submetrics.get("serve")
+                                         or {}).get(
+            "deadline_miss_fraction"),
         # analytic solve-FLOP floor / measured wall (profiling.solve_flops)
         "solve_utilization": headline_util,
         # steady-state XLA-boundary counters (ISSUE 5): the regression

@@ -188,6 +188,64 @@ class ServeDrained(ServeError):
         super().__init__(msg)
 
 
+class ServePoisoned(ServeError):
+    """A job was isolated as the poison member of a coalesced bucket
+    batch: its bucket dispatch failed (or produced a non-finite row) and
+    the solo eager-lane confirmation fit (the PR 3 degradation chain)
+    also failed to produce a finite result.  The batch-mates were
+    re-served bit-identically; only this job carries the error.  A
+    flight-recorder dump (reason ``"ServePoisoned"``) was written when a
+    dump path is configured.
+
+    Attributes: ``job`` (request name), ``bucket`` (structure key), and
+    ``cause`` (the underlying exception, or None for a non-finite
+    result with no raise)."""
+
+    def __init__(self, msg="", job=None, bucket=None, cause=None):
+        self.job = job
+        self.bucket = bucket
+        self.cause = cause
+        super().__init__(msg)
+
+
+class ServeDeadlineExceeded(ServeError):
+    """The job's deadline expired while it was still queued, before its
+    bucket was staged for dispatch — deadlines are only checked at
+    admission and batch-take time, never mid-dispatch, so an expired
+    job costs zero device work.
+
+    Attributes: ``deadline_s`` (the relative deadline the job was
+    submitted with), ``waited_s`` (how long it actually queued)."""
+
+    def __init__(self, msg="", deadline_s=None, waited_s=None):
+        self.deadline_s = deadline_s
+        self.waited_s = waited_s
+        super().__init__(msg)
+
+
+class ServeOverCapacity(ServeError):
+    """Admitting this job would push the predicted device peak bytes
+    (from the compiled bucket program's cost card, or a conservative
+    shape-based estimate when no card exists yet) past the service's
+    configured ``max_device_bytes`` — the job is rejected *before* it
+    can OOM the device.  A job whose own bucket can never fit is
+    rejected immediately; one that could fit once in-flight batches
+    drain is rejected only after a bounded wait.
+
+    Attributes: ``predicted_bytes``, ``limit_bytes``."""
+
+    def __init__(self, msg="", predicted_bytes=None, limit_bytes=None):
+        self.predicted_bytes = predicted_bytes
+        self.limit_bytes = limit_bytes
+        super().__init__(msg)
+
+
+class ServeCancelled(ServeError):
+    """The job was cancelled via ``ServeFuture.cancel()`` while still
+    queued (cancellation is only possible before staging; an in-flight
+    job cannot be cancelled)."""
+
+
 class MultihostTimeoutError(PintTpuError):
     """A multi-host rendezvous (``multihost.init``) or collective barrier
     did not complete within its deadline — a peer process is likely dead

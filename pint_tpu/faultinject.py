@@ -54,7 +54,9 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "collapse_dd_pair",
            "chatty_transfer", "chatty_collective", "corrupt_aot_blob",
            "stale_aot_version", "request_flood", "stalled_bucket",
-           "recorder_crash", "nan_gwb_draw", "corrupt_sim_chunk"]
+           "recorder_crash", "nan_gwb_draw", "corrupt_sim_chunk",
+           "poison_batch_member", "oom_dispatch", "slow_dispatch",
+           "silent_result_bias", "kill_daemon", "main"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -633,6 +635,158 @@ def stalled_bucket() -> Iterator[None]:
         yield
 
 
+# --- serve blast-radius failpoints (drive the containment layer, ISSUE 18) ----
+
+#: shared state for ``poison_batch_member``: the victim name lives at
+#: module level (not in the wrapper closure) because the failpoint is
+#: consulted at TWO sites — the bucket dispatch (NaN the victim's output
+#: row) and the eager confirmation fit (force the victim non-finite so
+#: it resolves to ServePoisoned) — and both must agree on one victim
+#: even though each ``wrap()`` call builds a fresh wrapper.
+_poison_state: dict = {}
+
+
+def _poison_batch_member_factory(fn):
+    """Predicate over job names: the FIRST name consulted becomes the
+    victim (deterministic under the serve daemon's FIFO batch order),
+    and stays the victim for the rest of the activation — the poison
+    follows the JOB through bisection re-dispatches and the eager
+    confirmation, exactly like a genuinely pathological model would."""
+    def poison(name):
+        victim = _poison_state.setdefault("victim", str(name))
+        return str(name) == victim
+    return poison
+
+
+@contextlib.contextmanager
+def poison_batch_member(victim: Optional[str] = None) -> Iterator[None]:
+    """Failpoint ``"poison_batch_member"``: one member of every
+    coalesced serve batch that contains it yields a NaN output row (see
+    ``TimingService._dispatch_inner``), and its solo eager confirmation
+    is forced non-finite too, so quarantine must resolve it to
+    ``ServePoisoned`` while every batch-mate is re-served bit-identical
+    to a solo run.  ``victim`` pins a job name; default poisons the
+    first job the daemon dispatches.  Env-activatable
+    (``PINT_TPU_FAULTS=poison_batch_member``) for the
+    ``python -m pint_tpu.serve check`` / chaos-sweep subprocess legs."""
+    _poison_state.clear()
+    if victim is not None:
+        _poison_state["victim"] = str(victim)
+    try:
+        with _registered("poison_batch_member",
+                         _poison_batch_member_factory):
+            yield
+    finally:
+        _poison_state.clear()
+
+
+def _oom_dispatch_factory(fn):
+    """Every bucket dispatch raises the resource-exhausted shape a
+    device OOM produces.  Containment must bisect (the raise persists
+    down to singletons), resolve every member on the eager lane (loud
+    degradation, never a lost job), and the per-bucket circuit breaker
+    must count the consecutive failures."""
+    def oom(*args, **kwargs):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: out of memory allocating bucket batch "
+            "(oom_dispatch failpoint)")
+    return oom
+
+
+@contextlib.contextmanager
+def oom_dispatch() -> Iterator[None]:
+    """Failpoint ``"oom_dispatch"``: every serve bucket dispatch raises
+    a resource-exhausted error (see ``TimingService._dispatch_inner``).
+    Env-activatable (``PINT_TPU_FAULTS=oom_dispatch``)."""
+    with _registered("oom_dispatch", _oom_dispatch_factory):
+        yield
+
+
+def _slow_dispatch_factory(fn):
+    """Stall every bucket dispatch by PINT_TPU_SLOW_DISPATCH_S seconds
+    (default 0.2) — the wedged-interconnect latency shape.  Queued jobs
+    with deadlines must expire with typed ``ServeDeadlineExceeded`` at
+    batch-take time (never mid-dispatch), and jobs without deadlines
+    must still complete bit-identically."""
+    def slow(*args, **kwargs):
+        import os
+        import time as _time
+
+        _time.sleep(float(os.environ.get("PINT_TPU_SLOW_DISPATCH_S",
+                                         "0.2")))
+        return fn(*args, **kwargs)
+    return slow
+
+
+@contextlib.contextmanager
+def slow_dispatch() -> Iterator[None]:
+    """Failpoint ``"slow_dispatch"``: every serve bucket dispatch is
+    delayed (see ``TimingService._dispatch_inner``) so per-request
+    deadlines can be tripped deterministically.  Env-activatable
+    (``PINT_TPU_FAULTS=slow_dispatch``; tune with
+    ``PINT_TPU_SLOW_DISPATCH_S``)."""
+    with _registered("slow_dispatch", _slow_dispatch_factory):
+        yield
+
+
+def _silent_result_bias_factory(fn):
+    """Scale the fetched host results by (1 + 1e-9) — a silent
+    wrong answer: no raise, no NaN, no counter, every shape and status
+    intact, only the low bits of chi2 move.  This is the NEGATIVE
+    CONTROL for the chaos sweep's global invariant: the sweep judge
+    must catch the unflagged bit-level divergence from the baseline leg
+    and exit 1 with attribution.  Deliberately NOT in the sweep's
+    default fault set — only ``sweep --inject silent_result_bias``
+    (or an explicit env activation) turns it on."""
+    def biased(out):
+        return np.asarray(fn(out), np.float64) * (1.0 + 1e-9)
+    return biased
+
+
+@contextlib.contextmanager
+def silent_result_bias() -> Iterator[None]:
+    """Failpoint ``"silent_result_bias"``: serve bucket results are
+    silently biased in their last bits (see
+    ``TimingService._dispatch_inner``).  Env-activatable
+    (``PINT_TPU_FAULTS=silent_result_bias``) so the sweep's
+    self-test can prove the judge catches silent corruption."""
+    with _registered("silent_result_bias", _silent_result_bias_factory):
+        yield
+
+
+def _kill_daemon_factory(fn):
+    """One-shot SIGTERM gated on a token file: when the file named by
+    PINT_TPU_KILL_TOKEN exists, unlink it and deliver SIGTERM to this
+    process — the mid-flight daemon crash the ``serve supervise``
+    wrapper must survive.  The restarted child inherits
+    ``PINT_TPU_FAULTS=kill_daemon`` but the token is gone, so the
+    resume run is clean (exactly one kill per token)."""
+    def killer(*args, **kwargs):
+        import os
+        import signal as _signal
+
+        token = os.environ.get("PINT_TPU_KILL_TOKEN")
+        if token and os.path.exists(token):
+            try:
+                os.unlink(token)
+            except OSError:
+                pass
+            os.kill(os.getpid(), _signal.SIGTERM)
+        return fn(*args, **kwargs)
+    return killer
+
+
+@contextlib.contextmanager
+def kill_daemon() -> Iterator[None]:
+    """Failpoint ``"kill_daemon"``: the serve daemon SIGTERMs itself
+    after the next completed batch, once per PINT_TPU_KILL_TOKEN file
+    (see ``TimingService._loop``).  Env-activatable
+    (``PINT_TPU_FAULTS=kill_daemon``) for the supervised-restart
+    subprocess leg."""
+    with _registered("kill_daemon", _kill_daemon_factory):
+        yield
+
+
 #: failpoints activatable across a process boundary via the
 #: PINT_TPU_FAULTS env var (comma-separated names; process-lifetime,
 #: no context manager to exit) — the bench/CLI-subprocess test leg
@@ -648,6 +802,11 @@ _ENV_FACTORIES = {
     "recorder_crash": _recorder_crash_factory,
     "nan_gwb_draw": _nan_gwb_factory,
     "corrupt_sim_chunk": _corrupt_sim_chunk_factory,
+    "poison_batch_member": _poison_batch_member_factory,
+    "oom_dispatch": _oom_dispatch_factory,
+    "slow_dispatch": _slow_dispatch_factory,
+    "silent_result_bias": _silent_result_bias_factory,
+    "kill_daemon": _kill_daemon_factory,
 }
 
 
@@ -702,3 +861,237 @@ def corrupt_mjds(toas, rows: Sequence[int]) -> Iterator[None]:
         yield
     finally:
         frac[list(rows)] = saved
+
+
+# --- chaos sweep (``python -m pint_tpu.faultinject sweep``, ISSUE 18) ---------
+
+#: the serve-plane failpoints the chaos sweep drives by default — the
+#: env-activatable subset that perturbs a ``serve check`` run.  The
+#: silent-corruption negative control (``silent_result_bias``) and the
+#: supervise-leg kill switch (``kill_daemon``) are deliberately
+#: excluded: the first exists to prove the judge CATCHES silent
+#: corruption (``--inject`` adds it), the second needs a token file.
+_SWEEP_FAULTS = ("request_flood", "stalled_bucket", "recorder_crash",
+                 "poison_batch_member", "oom_dispatch", "slow_dispatch")
+
+
+def _sweep_run_leg(faults, args):
+    """One ``serve check`` subprocess under PINT_TPU_FAULTS=<faults>.
+    Returns (rc, parsed JSON line or None, stderr)."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PINT_TPU_TELEMETRY_DUMP", None)   # legs judge JSON, not dumps
+    env["PINT_TPU_FAULTS"] = ",".join(faults)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "pint_tpu.serve", "check",
+           "--jobs", str(args.jobs), "--wait-ms", str(args.wait_ms)]
+    if args.deadline_ms > 0:
+        cmd += ["--deadline-ms", str(args.deadline_ms)]
+    p = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=args.timeout_s, env=env)
+    doc = None
+    for ln in reversed(p.stdout.strip().splitlines()):
+        try:
+            doc = _json.loads(ln)
+            break
+        except ValueError:
+            continue
+    return p.returncode, doc, p.stderr
+
+
+def _sweep_judge(leg, faults, rc, doc, stderr, base_by_name):
+    """The global containment invariant, applied to every leg: a fault
+    may surface ONLY as a typed error or a loudly-flagged degradation —
+    an untyped crash, an unaccounted job, or an UNFLAGGED result whose
+    chi2 bits differ from the clean baseline is a sweep failure, with
+    the leg's fault set named in the attribution."""
+    problems = []
+    if doc is None:
+        tail = (stderr or "").strip().splitlines()[-3:]
+        problems.append(
+            f"[{leg}] UNTYPED CRASH: serve check emitted no JSON line "
+            f"(rc={rc}); stderr tail: {' | '.join(tail)}")
+        return problems
+    if rc != 0:
+        problems.append(
+            f"[{leg}] rc={rc}: jobs unaccounted for — a fault must "
+            "surface as a typed per-job error, not a failed run")
+    for key, ent in (doc.get("results") or {}).items():
+        if ent.get("flagged"):
+            continue   # typed error or loud degradation: exempt
+        name = key.split(":", 1)[1] if ":" in key else key
+        base = base_by_name.get(name)
+        if base is None:
+            continue
+        if ent.get("chi2_hex") != base:
+            problems.append(
+                f"[{leg}] SILENT WRONG ANSWER on {name}: unflagged "
+                f"chi2 {ent.get('chi2_hex')} != baseline {base}")
+    return problems
+
+
+def _sweep_expect_single(fault, doc):
+    """Per-fault expectations, single-fault legs only: beyond 'no
+    silent wrong answer', each shipped failpoint has a KNOWN containment
+    story the sweep pins down."""
+    problems = []
+    res = doc.get("results") or {}
+    errors = {k: e["error"] for k, e in res.items() if e.get("error")}
+    rungs = {k: e.get("rung") for k, e in res.items() if e.get("rung")}
+    if fault == "request_flood":
+        if doc.get("completed") != 0 or \
+                doc.get("rejected") != doc.get("jobs"):
+            problems.append(
+                f"[{fault}] expected every job rejected with typed "
+                f"backpressure, got completed={doc.get('completed')} "
+                f"rejected={doc.get('rejected')}")
+    elif fault == "poison_batch_member":
+        poisoned = {k for k, e in errors.items() if e == "ServePoisoned"}
+        names = {k.split(":", 1)[-1] for k in poisoned}
+        if not poisoned or len(names) != 1:
+            problems.append(
+                f"[{fault}] expected exactly one poisoned job name "
+                f"(ServePoisoned), got {sorted(poisoned)}")
+        other = {k: e for k, e in errors.items()
+                 if e != "ServePoisoned"}
+        if other:
+            problems.append(
+                f"[{fault}] batch-mates must be re-served, not "
+                f"errored: {other}")
+    elif fault in ("oom_dispatch", "recorder_crash"):
+        if errors:
+            problems.append(
+                f"[{fault}] expected full containment onto the eager "
+                f"lane (no per-job errors), got {errors}")
+        stuck = [k for k, r in rungs.items() if r == "bucket"]
+        if stuck:
+            problems.append(
+                f"[{fault}] bucket dispatch raises unconditionally — "
+                f"no job can resolve on the bucket rung, yet {stuck} did")
+    elif fault == "slow_dispatch":
+        other = {k: e for k, e in errors.items()
+                 if e != "ServeDeadlineExceeded"}
+        if other:
+            problems.append(
+                f"[{fault}] only deadline expiry is an acceptable "
+                f"error under latency injection, got {other}")
+    elif fault == "stalled_bucket":
+        if errors:
+            problems.append(
+                f"[{fault}] timer flushes must serve every job "
+                f"normally, got errors {errors}")
+    return problems
+
+
+def main(argv=None) -> int:
+    """``python -m pint_tpu.faultinject sweep``: seeded randomized
+    chaos scheduler over the env-activatable serve failpoints.  Drives
+    one clean baseline ``serve check`` leg, one leg per fault, and
+    ``--pairs`` seeded fault pairs, and enforces the blast-radius
+    invariant on every leg: a failure is a typed error or a loud
+    degradation, NEVER a silent wrong answer.  Exits 0 when the
+    invariant holds everywhere, 1 with per-leg attribution otherwise."""
+    import argparse
+    import itertools
+    import json as _json
+    import random
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.faultinject",
+        description="fault-injection tooling")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sw = sub.add_parser(
+        "sweep",
+        help="chaos sweep: serve check under every env failpoint "
+             "(and sampled pairs) -> typed-error-only invariant")
+    sw.add_argument("--seed", type=int, default=0)
+    sw.add_argument("--jobs", type=int, default=6)
+    sw.add_argument("--wait-ms", type=float, default=40.0)
+    sw.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request deadline for every leg "
+                         "(0 = no deadlines)")
+    sw.add_argument("--pairs", type=int, default=2,
+                    help="number of seeded two-fault legs")
+    sw.add_argument("--inject", action="append", default=[],
+                    help="extra failpoint(s) to sweep as single-fault "
+                         "legs (e.g. the silent_result_bias negative "
+                         "control)")
+    sw.add_argument("--timeout-s", type=float, default=240.0)
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    singles = list(_SWEEP_FAULTS) + [f for f in args.inject
+                                     if f not in _SWEEP_FAULTS]
+    unknown = [f for f in singles if f not in _ENV_FACTORIES]
+    if unknown:
+        print(f"sweep: unknown or non-env-activatable failpoint(s) "
+              f"{unknown}", file=sys.stderr)
+        return 2
+    pair_pool = list(itertools.combinations(_SWEEP_FAULTS, 2))
+    pairs = rng.sample(pair_pool, min(args.pairs, len(pair_pool)))
+    legs = [()] + [(f,) for f in singles] + [tuple(p) for p in pairs]
+
+    problems = []
+    summaries = []
+    base_by_name = {}
+    for faults in legs:
+        leg = "+".join(faults) or "baseline"
+        print(f"sweep: leg {leg} ...", file=sys.stderr)
+        try:
+            rc, doc, err = _sweep_run_leg(faults, args)
+        except Exception as exc:   # timeout/spawn failure = leg failure
+            problems.append(f"[{leg}] leg did not finish: {exc}")
+            summaries.append({"leg": leg, "rc": None})
+            continue
+        if not faults:
+            # the baseline leg defines ground truth: per-name chi2
+            # bits, which must be self-consistent across resubmissions
+            # of the same job before anything else is judged
+            if doc is None or rc != 0:
+                print(_json.dumps({"mode": "sweep", "seed": args.seed,
+                                   "ok": False,
+                                   "problems": ["baseline leg failed "
+                                                f"(rc={rc})"]}))
+                return 1
+            for key, ent in (doc.get("results") or {}).items():
+                if ent.get("flagged") or "chi2_hex" not in ent:
+                    continue
+                name = key.split(":", 1)[-1]
+                prev = base_by_name.setdefault(name, ent["chi2_hex"])
+                if prev != ent["chi2_hex"]:
+                    problems.append(
+                        f"[baseline] {name} not deterministic across "
+                        f"resubmission: {prev} != {ent['chi2_hex']}")
+        else:
+            problems += _sweep_judge(leg, faults, rc, doc, err,
+                                     base_by_name)
+            if len(faults) == 1 and doc is not None:
+                problems += _sweep_expect_single(faults[0], doc)
+        summaries.append({
+            "leg": leg, "rc": rc,
+            "completed": None if doc is None else doc.get("completed"),
+            "rejected": None if doc is None else doc.get("rejected")})
+
+    ok = not problems
+    for p in problems:
+        print(f"sweep: FAIL {p}", file=sys.stderr)
+    print(_json.dumps({"mode": "sweep", "seed": args.seed,
+                       "jobs": args.jobs, "legs": summaries,
+                       "n_legs": len(legs), "ok": ok,
+                       "problems": problems}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":   # pragma: no cover
+    # canonical-module delegation (the serve/aot idiom): running as
+    # __main__ must share the registry the package instance owns
+    import sys as _sys
+
+    from pint_tpu.faultinject import main as _main
+
+    _sys.exit(_main())

@@ -361,6 +361,22 @@ def render_prometheus(extra_stats: Optional[Dict[str, Any]] = None
                 continue
             out.append('pint_tpu_serve_stat{name="%s"} %s'
                        % (_esc_label(key), _fmt(v)))
+        # the per-bucket circuit breaker (ISSUE 18): the stats() map
+        # {bucket repr: "closed"|"open"|"half_open"} is not a scalar,
+        # so it renders as its own labelled gauge (0/1/2) — what a
+        # dashboard alerts on when a bucket is thrown onto eager
+        breaker = extra_stats.get("breaker_state")
+        if isinstance(breaker, dict) and breaker:
+            fam("pint_tpu_serve_breaker", "gauge",
+                "per-bucket circuit breaker (0=closed, 1=half_open, "
+                "2=open)")
+            code = {"closed": 0, "half_open": 1, "open": 2}
+            for bucket in sorted(breaker):
+                v = code.get(str(breaker[bucket]))
+                if v is None:
+                    continue
+                out.append('pint_tpu_serve_breaker{bucket="%s"} %d'
+                           % (_esc_label(str(bucket)), v))
     return "\n".join(out) + "\n"
 
 
@@ -614,7 +630,12 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
       no-implicit-gather invariant as a gate);
     * ``serve_p99_ms`` — bounded growth by ``p99_tolerance``;
     * ``sim_toas_per_sec`` / ``pta_fleet_fits_per_sec`` — PTA-scale
-      throughput may shrink at most ``tolerance``.
+      throughput may shrink at most ``tolerance``;
+    * ``serve_quarantined`` / ``serve_deadline_miss_fraction`` — must
+      be ZERO whenever the new line carries them (absolute, like the
+      compile axes): the healthy-path bench has no poison jobs and no
+      expiring deadlines, so any nonzero value means containment fired
+      on clean traffic — a regression, not noise.
 
     An axis absent from either line is skipped — early rounds carry
     only the headline, and a gate that fails on *missing history* would
@@ -666,6 +687,15 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
                 fail(axis, oa, na,
                      f"throughput dropped {na / oa - 1.0:+.1%} "
                      f"(> -{tolerance:.0%} tolerance)")
+    # serve containment axes (ISSUE 18): the healthy-path bench must
+    # never quarantine a job or miss a deadline — nonzero means the
+    # blast-radius machinery fired on clean traffic.  Absolute (like
+    # the compile axes); absent on pre-containment rounds -> skipped
+    for axis in ("serve_quarantined", "serve_deadline_miss_fraction"):
+        na = _num(new, axis)
+        if na is not None and na != 0:
+            fail(axis, _num(old, axis), na,
+                 f"healthy-path {axis} must stay 0 (got {na:g})")
     return failures
 
 

@@ -66,7 +66,7 @@ _log = _logchild("runtime")
 __all__ = ["BackendStatus", "acquire_backend", "configure_compile_cache",
            "write_checkpoint", "load_checkpoint", "scan_signature",
            "ChunkStatus", "ScanSummary", "run_checkpointed_scan",
-           "call_with_deadline", "SignalFlush"]
+           "call_with_deadline", "SignalFlush", "run_supervised"]
 
 
 # --- supervised backend acquisition -------------------------------------------
@@ -452,6 +452,41 @@ class _SignalFlush:
 #: every long-running entrypoint (flush state, raise typed, resume
 #: bit-identically)
 SignalFlush = _SignalFlush
+
+
+def run_supervised(argv, *, max_restarts: int = 3,
+                   backoff_s: float = 0.5, backoff_cap_s: float = 30.0,
+                   clean_rcs=(0,), env=None, timeout_s: float = 600.0):
+    """Run a subprocess under a restart supervisor: a clean exit
+    (``rc in clean_rcs``) ends the loop; anything else — a crash, a
+    SIGTERM death, a typed drained exit — is retried up to
+    ``max_restarts`` times with exponential backoff (``backoff_s * 2**k``,
+    capped).  ``argv`` may be a callable of the attempt index so the
+    caller can change the command between attempts (the serve
+    supervisor adds ``--resume`` once a spool exists).
+
+    Returns the list of per-attempt ``(rc, stdout, stderr)`` tuples —
+    the caller judges totals across attempts (e.g. "no lost or
+    duplicated jobs").  This is the process-level rung of the PR 4
+    resilience ladder: chunk retries inside a scan, spool/resume across
+    one restart, and this loop across repeated crashes."""
+    import subprocess
+
+    attempts = []
+    for attempt in range(int(max_restarts) + 1):
+        if attempt:
+            delay = min(float(backoff_s) * (2 ** (attempt - 1)),
+                        float(backoff_cap_s))
+            telemetry.event("supervise.restart", attempt=attempt,
+                            delay_s=delay)
+            time.sleep(delay)
+        cmd = argv(attempt) if callable(argv) else list(argv)
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           env=env, timeout=timeout_s)
+        attempts.append((p.returncode, p.stdout, p.stderr))
+        if p.returncode in tuple(clean_rcs):
+            break
+    return attempts
 
 
 @dispatch_contract("checkpointed_chunk", max_compiles=40,

@@ -54,11 +54,14 @@ subprocess surface the tooling tests drive under the failpoints.
 
 from __future__ import annotations
 
+import copy
 import itertools
 import json
 import os
 import threading
 import time
+import warnings
+import zlib
 from collections import OrderedDict, deque
 from typing import Dict, List, NamedTuple, Optional
 
@@ -68,9 +71,11 @@ import numpy as np
 
 from pint_tpu import (aot, faultinject, metrics, profiling, runtime,
                       telemetry)
-from pint_tpu.exceptions import (CorrelatedErrors, ServeDrained,
-                                 ServeSaturated)
-from pint_tpu.fitter import FitStatus, _default_wls_kernel
+from pint_tpu.exceptions import (CheckpointCorruptError, CorrelatedErrors,
+                                 ServeCancelled, ServeDeadlineExceeded,
+                                 ServeDrained, ServeOverCapacity,
+                                 ServePoisoned, ServeSaturated)
+from pint_tpu.fitter import FitStatus, WLSFitter, _default_wls_kernel
 from pint_tpu.fleet import (_COL_CHI2, _COL_ITERS, _COL_STATUS,
                             FleetFitter, _build_bucket_fit, _pad_pdict,
                             _Pulsar)
@@ -106,6 +111,14 @@ def _pow2_at_least(n: int, floor: int) -> int:
     return v
 
 
+def _bucket_label(key: tuple) -> str:
+    """Compact, restart-stable bucket id for stats keys / Prometheus
+    labels / incident attrs: the pad shape plus a CRC32 of the full
+    structure key (whose repr is unbounded)."""
+    return (f"ntoa{key[1]}xnp{key[2]}-"
+            f"{zlib.crc32(repr(key[0]).encode()) & 0xffffffff:08x}")
+
+
 class ServeResult(NamedTuple):
     """One resolved timing request (the fleet entry shape minus requeue
     provenance — the daemon path is the vmapped bucket program only)."""
@@ -117,6 +130,11 @@ class ServeResult(NamedTuple):
     iterations: int
     x: np.ndarray          #: fitted offsets (device units), len(fit_names)
     fit_names: tuple
+    #: which lane produced the numbers: "bucket" (the compiled
+    #: coalesced program — the steady-state path) or "eager" (solo
+    #: host-driven recovery after quarantine/bisection/breaker — a
+    #: LOUD degradation, never a silent one)
+    rung: str = "bucket"
 
     @property
     def ok(self) -> bool:
@@ -129,9 +147,10 @@ class ServeFuture:
     spooled instead of fitted)."""
 
     __slots__ = ("name", "trace_id", "submitted_at", "resolved_at",
-                 "_ev", "_result", "_exc")
+                 "deadline_at", "_ev", "_result", "_exc", "_service")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, service=None,
+                 deadline_s: Optional[float] = None):
         self.name = name
         #: per-request telemetry id, threaded from admission through the
         #: bucket dispatch span (ISSUE 12) — what a flight-recorder dump
@@ -139,12 +158,27 @@ class ServeFuture:
         self.trace_id = telemetry.new_trace_id()
         self.submitted_at = time.monotonic()
         self.resolved_at: Optional[float] = None
+        #: monotonic instant past which the queued job expires with
+        #: ``ServeDeadlineExceeded`` (checked strictly BEFORE staging —
+        #: an in-flight batch is never interrupted); None = no deadline
+        self.deadline_at = None if deadline_s is None \
+            else self.submitted_at + float(deadline_s)
         self._ev = threading.Event()
         self._result: Optional[ServeResult] = None
         self._exc: Optional[BaseException] = None
+        self._service = service
 
     def done(self) -> bool:
         return self._ev.is_set()
+
+    def cancel(self) -> bool:
+        """Withdraw the job if it is still queued (not yet staged into
+        a dispatch): the future rejects with ``ServeCancelled`` and
+        True is returned.  Returns False when the job already resolved,
+        was already taken for dispatch, or has no owning service."""
+        if self._service is None or self.done():
+            return False
+        return self._service._cancel_future(self)
 
     def result(self, timeout: Optional[float] = None) -> ServeResult:
         if not self._ev.wait(timeout):
@@ -199,7 +233,8 @@ class _ServeBucket:
     """One (structure key, pad shape) queue + its compiled program."""
 
     __slots__ = ("key", "skey", "n_toa", "n_param", "rep", "dkeys",
-                 "include_offset", "pending")
+                 "include_offset", "pending", "fails", "state",
+                 "opened_at")
 
     def __init__(self, key: tuple, job: PreparedJob):
         self.key = key
@@ -211,6 +246,12 @@ class _ServeBucket:
             if np.ndim(v) == 0))
         self.include_offset = "PhaseOffset" not in job.model.components
         self.pending: deque = deque()   # (PreparedJob, ServeFuture)
+        # per-bucket circuit breaker (ISSUE 18): consecutive dispatch
+        # failures open the bucket onto the eager lane; a half-open
+        # probe after the cooldown restores the compiled path
+        self.fails = 0
+        self.state = "closed"           # closed | open | half_open
+        self.opened_at = 0.0
 
 
 class TimingService:
@@ -247,6 +288,7 @@ class TimingService:
                  max_wait_ms: Optional[float] = None,
                  max_pending: int = 64,
                  spool: Optional[str] = None,
+                 max_device_bytes: Optional[int] = None,
                  args_cache_size: int = 8,
                  program_cache: Optional[dict] = None,
                  stats_path: Optional[str] = None):
@@ -271,6 +313,19 @@ class TimingService:
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
         self.max_pending = int(max_pending)
         self.spool = spool
+        # blast-radius containment knobs (ISSUE 18).  The admission
+        # guard is OFF unless a byte limit is configured — the healthy
+        # steady-state path is untouched by default.
+        if max_device_bytes is None:
+            max_device_bytes = int(float(os.environ.get(
+                "PINT_TPU_SERVE_MAX_DEVICE_BYTES", "0")))
+        self.max_device_bytes = int(max_device_bytes) or None
+        self._breaker_n = max(int(os.environ.get(
+            "PINT_TPU_SERVE_BREAKER_N", "3")), 1)
+        self._breaker_cooldown_s = float(os.environ.get(
+            "PINT_TPU_SERVE_BREAKER_COOLDOWN_S", "5.0"))
+        self._inflight_bytes = 0
+        self._bucket_bytes: dict = {}
         self.args_cache_size = max(int(args_cache_size), 1)
         # live metrics (ISSUE 12): daemon mode writes stats() to this
         # atomic file every stats-interval so an operator (or the
@@ -305,7 +360,12 @@ class TimingService:
         return {"submitted": 0, "completed": 0, "rejected": 0,
                 "spooled": 0, "dispatches": 0, "full_flushes": 0,
                 "timer_flushes": 0, "drain_flushes": 0,
-                "flush_flushes": 0, "occupancy_jobs": 0}
+                "flush_flushes": 0, "occupancy_jobs": 0,
+                # blast-radius containment counters (ISSUE 18)
+                "deadline_misses": 0, "cancelled": 0,
+                "over_capacity": 0, "quarantined": 0,
+                "eager_served": 0, "breaker_opens": 0,
+                "spool_skipped": 0}
 
     def reset_stats(self) -> None:
         """Zero the counters + latency samples (e.g. after a warmup
@@ -370,10 +430,17 @@ class TimingService:
             self._buckets[key] = b
         return b
 
-    def submit_prepared(self, job: PreparedJob) -> ServeFuture:
+    def submit_prepared(self, job: PreparedJob,
+                        deadline_s: Optional[float] = None) -> ServeFuture:
         """Admit a prepared job into its bucket's queue (bounded:
         overflow raises :class:`ServeSaturated`, the backpressure path
-        driven by the ``request_flood`` failpoint)."""
+        driven by the ``request_flood`` failpoint).  ``deadline_s``
+        (optional) expires the job with typed
+        :class:`ServeDeadlineExceeded` if it is still queued — never
+        mid-dispatch — that long after submission.  With a device-byte
+        limit configured, admission also rides the cost-card guard
+        (:meth:`_admit_capacity_locked`, typed
+        :class:`ServeOverCapacity`)."""
         admit = faultinject.wrap("request_flood", self._has_capacity)
         with self._cond:
             if self._draining or self._stop:
@@ -386,7 +453,16 @@ class TimingService:
                     f"request queue is full "
                     f"({self._n_pending}/{self.max_pending} pending); "
                     f"retry after in-flight batches drain")
-            fut = ServeFuture(job.name)
+            if deadline_s is not None and float(deadline_s) <= 0.0:
+                self._stats["deadline_misses"] += 1
+                profiling.count("serve.deadline_miss")
+                raise ServeDeadlineExceeded(
+                    f"job {job.name!r} deadline {deadline_s} s was "
+                    f"already expired at admission",
+                    deadline_s=float(deadline_s), waited_s=0.0)
+            self._admit_capacity_locked(job)
+            fut = ServeFuture(job.name, service=self,
+                              deadline_s=deadline_s)
             self._bucket_for(job).pending.append((job, fut))
             self._n_pending += 1
             self._stats["submitted"] += 1
@@ -398,8 +474,10 @@ class TimingService:
                         trace_id=fut.trace_id)
         return fut
 
-    def submit(self, model, toas, name: Optional[str] = None) -> ServeFuture:
-        return self.submit_prepared(self.prepare(model, toas, name=name))
+    def submit(self, model, toas, name: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> ServeFuture:
+        return self.submit_prepared(self.prepare(model, toas, name=name),
+                                    deadline_s=deadline_s)
 
     # -- programs + staged device inputs ---------------------------------------
 
@@ -462,6 +540,253 @@ class TimingService:
             profiling.count("serve.args_donate")
         return args
 
+    # -- blast-radius containment (ISSUE 18) -----------------------------------
+
+    def _estimate_bytes(self, job: PreparedJob) -> int:
+        """Shape-based floor for one bucket dispatch's device footprint:
+        staged inputs across the vmap width, with 3x headroom for the
+        output row and solver transients."""
+        n = 0
+        for leaf in (jax.tree_util.tree_leaves(job.staged_p)
+                     + jax.tree_util.tree_leaves(job.staged_b)):
+            n += np.asarray(leaf).nbytes  # ddlint: disable=TRACE002 admission-time size probe, runs once per bucket key (cached in _bucket_bytes), never per dispatch
+        n += (job.slot_row.nbytes + job.pmask_row.nbytes
+              + job.rowmask_row.nbytes)
+        return 3 * self.batch_size * n
+
+    def _predict_job_bytes(self, job: PreparedJob) -> int:
+        """Predicted per-dispatch device peak for the job's bucket: the
+        harvested ``serve_bucket`` cost cards (the PR 11 metrics plane)
+        when any exist, floored by the shape-based estimate.  Cached per
+        bucket key — admission stays queue-ops cheap."""
+        key = (job.skey, job.n_toa, job.n_param)
+        got = self._bucket_bytes.get(key)
+        if got is None:
+            got = self._estimate_bytes(job)
+            for card in metrics.cost_cards():
+                if card.get("entry") != "serve_bucket":
+                    continue
+                peak = card.get("peak_bytes") or card.get("bytes_accessed")
+                if peak:
+                    got = max(got, int(peak))
+            self._bucket_bytes[key] = got
+        return got
+
+    def _admit_capacity_locked(self, job: PreparedJob) -> None:
+        """Cost-card admission guard (called under ``self._cond``):
+        predict the job's bucket footprint and either briefly wait for
+        in-flight bytes to drain or reject with typed
+        ``ServeOverCapacity`` — the daemon refuses work instead of
+        OOMing the device.  No-op unless ``max_device_bytes`` (or
+        ``PINT_TPU_SERVE_MAX_DEVICE_BYTES``) is configured."""
+        if self.max_device_bytes is None:
+            return
+        need = self._predict_job_bytes(job)
+        if need > self.max_device_bytes:
+            self._stats["over_capacity"] += 1
+            profiling.count("serve.over_capacity")
+            raise ServeOverCapacity(
+                f"job {job.name!r} bucket is predicted to need {need} "
+                f"device bytes > limit {self.max_device_bytes}; "
+                f"refusing admission (would OOM)",
+                predicted_bytes=need, limit_bytes=self.max_device_bytes)
+        deadline = time.monotonic() + max(self.max_wait_s, 1e-3)
+        while self._inflight_bytes + need > self.max_device_bytes:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                self._stats["over_capacity"] += 1
+                profiling.count("serve.over_capacity")
+                raise ServeOverCapacity(
+                    f"job {job.name!r} needs {need} device bytes but "
+                    f"{self._inflight_bytes} are in flight (limit "
+                    f"{self.max_device_bytes}); not admitted within "
+                    f"{self.max_wait_s:.3f} s",
+                    predicted_bytes=need,
+                    limit_bytes=self.max_device_bytes)
+            self._cond.wait(left)
+
+    def _cancel_future(self, fut: ServeFuture) -> bool:
+        removed = False
+        with self._cond:
+            if fut.done():
+                return False
+            for bucket in self._buckets.values():
+                kept = deque(p for p in bucket.pending
+                             if p[1] is not fut)
+                if len(kept) != len(bucket.pending):
+                    bucket.pending = kept
+                    removed = True
+            if removed:
+                self._n_pending -= 1
+                self._stats["cancelled"] += 1
+        if removed:
+            profiling.count("serve.cancelled")
+            fut._reject(ServeCancelled(
+                f"job {fut.name!r} cancelled before staging"))
+        return removed
+
+    def _expire_locked(self, now: float) -> None:
+        """Expire queued jobs past their deadline (called under
+        ``self._cond``, strictly BEFORE batch selection — an in-flight
+        batch is never interrupted, and an expired job costs zero
+        device work)."""
+        expired = []
+        for bucket in self._buckets.values():
+            if not bucket.pending:
+                continue
+            keep: deque = deque()
+            for job, fut in bucket.pending:
+                if fut.deadline_at is not None \
+                        and now >= fut.deadline_at:
+                    expired.append((job, fut))
+                else:
+                    keep.append((job, fut))
+            if len(keep) != len(bucket.pending):
+                bucket.pending = keep
+        if not expired:
+            return
+        self._n_pending -= len(expired)
+        self._stats["deadline_misses"] += len(expired)
+        for job, fut in expired:
+            waited = now - fut.submitted_at
+            limit = fut.deadline_at - fut.submitted_at
+            profiling.count("serve.deadline_miss")
+            telemetry.warn("serve.deadline_miss", job=job.name,
+                           trace_id=fut.trace_id, waited_s=waited)
+            fut._reject(ServeDeadlineExceeded(
+                f"job {job.name!r} expired in queue after "
+                f"{waited:.3f} s (deadline {limit:.3f} s); never "
+                f"staged", deadline_s=limit, waited_s=waited))
+
+    def _breaker_admit(self, bucket: _ServeBucket) -> bool:
+        """True when the bucket's compiled program may be tried: breaker
+        closed, or open past its cooldown (=> half-open probe)."""
+        with self._cond:
+            if bucket.state != "open":
+                return True
+            if time.monotonic() - bucket.opened_at \
+                    >= self._breaker_cooldown_s:
+                bucket.state = "half_open"
+                telemetry.event("serve.breaker_probe",
+                                bucket=_bucket_label(bucket.key))
+                return True
+            return False
+
+    def _breaker_ok(self, bucket: _ServeBucket) -> None:
+        closed = False
+        with self._cond:
+            if bucket.state != "closed":
+                closed = True
+            bucket.fails = 0
+            bucket.state = "closed"
+        if closed:
+            telemetry.event("serve.breaker_close",
+                            bucket=_bucket_label(bucket.key))
+
+    def _breaker_fail(self, bucket: _ServeBucket) -> None:
+        opened = False
+        with self._cond:
+            bucket.fails += 1
+            if bucket.fails >= self._breaker_n \
+                    and bucket.state != "open":
+                bucket.state = "open"
+                bucket.opened_at = time.monotonic()
+                self._stats["breaker_opens"] += 1
+                opened = True
+        if opened:
+            profiling.count("serve.breaker_open")
+            telemetry.incident("serve.breaker_open",
+                               bucket=_bucket_label(bucket.key),
+                               fails=bucket.fails)
+            _log.warning("bucket %s breaker OPEN after %d consecutive "
+                         "dispatch failures; serving on the eager lane "
+                         "until a half-open probe succeeds",
+                         _bucket_label(bucket.key), bucket.fails)
+
+    def _eager_fit(self, job: PreparedJob) -> ServeResult:
+        """Solo host-driven fit on the PR 3 guarded engine — the lane
+        quarantine/bisection/breaker recovery resolves through.  The
+        job's model is deep-copied so the staged request is never
+        mutated; raises ``ConvergenceFailure`` upward."""
+        model = copy.deepcopy(job.model)
+        f = WLSFitter(job.resid.toas, model,
+                      track_mode=job.resid.track_mode,
+                      policy=self.policy)
+        chi2 = float(f.fit_toas(maxiter=self.maxiter,
+                                tol_chi2=self.tol_chi2,
+                                threshold=self.threshold))
+        fr = f.fitresult
+        x = np.asarray([
+            float(np.sum(np.asarray(model[n].device_value, np.float64)
+                         - np.asarray(job.model[n].device_value,
+                                      np.float64)))
+            for n in job.names], np.float64)
+        status = getattr(fr, "status", FitStatus.CONVERGED)
+        iters = int(getattr(fr, "iterations", 0) or 0)
+        return ServeResult(job.name, chi2, job.dof, status, iters, x,
+                           job.names, rung="eager")
+
+    def _eager_confirm(self, bucket: _ServeBucket, pair,
+                       cause=None) -> None:
+        """Serve one suspect/orphaned job solo on the eager lane.  A
+        job that still comes back non-finite is quarantined: typed
+        ``ServePoisoned`` + a flight-recorder incident — never a
+        silently wrong number, and never a batch-mate's problem."""
+        job, fut = pair
+        poisoned = faultinject.wrap(
+            "poison_batch_member", lambda n: False)(job.name)
+        res = None
+        err = None
+        if not poisoned:
+            try:
+                res = self._eager_fit(job)
+            except Exception as e:
+                err = e
+        if (res is not None and np.isfinite(res.chi2)
+                and np.all(np.isfinite(res.x))
+                and res.status != FitStatus.NONFINITE):
+            with self._cond:
+                self._stats["eager_served"] += 1
+            profiling.count("serve.eager_served")
+            telemetry.warn("serve.quarantine_recovered", job=job.name,
+                           trace_id=fut.trace_id,
+                           bucket=_bucket_label(bucket.key))
+            fut._resolve(res)
+            return
+        with self._cond:
+            self._stats["quarantined"] += 1
+        profiling.count("serve.quarantined")
+        why = type(cause or err).__name__ if (cause or err) \
+            else "non-finite result"
+        telemetry.incident("ServePoisoned", job=job.name,
+                           trace_id=fut.trace_id,
+                           bucket=_bucket_label(bucket.key), cause=why)
+        fut._reject(ServePoisoned(
+            f"job {job.name!r} poisoned bucket {bucket.key!r}: "
+            f"quarantined after eager-lane confirmation ({why})",
+            job=job.name, bucket=_bucket_label(bucket.key), cause=cause or err))
+
+    def _bisect(self, bucket: _ServeBucket, pairs, cause) -> None:
+        """Isolate poison members after a failed dispatch by re-running
+        the batch halves through the SAME compiled program.  vmap rows
+        are independent, so a healthy mate's sub-batch row is
+        bit-identical to its full-batch row — healthy jobs lose nothing
+        to a poisoned neighbour."""
+        if len(pairs) == 1:
+            self._eager_confirm(bucket, pairs[0], cause=cause)
+            return
+        profiling.count("serve.bisect")
+        mid = len(pairs) // 2
+        for half in (pairs[:mid], pairs[mid:]):
+            try:
+                out = self._run_bucket(bucket, half)
+            except Exception as exc:
+                self._bisect(bucket, half, exc)
+                continue
+            _, suspects = self._resolve_rows(bucket, half, out)
+            for pair in suspects:
+                self._eager_confirm(bucket, pair, cause=cause)
+
     # -- dispatch --------------------------------------------------------------
 
     def _dispatch(self, bucket: _ServeBucket, pairs, reason: str) -> None:
@@ -474,33 +799,131 @@ class TimingService:
 
     def _dispatch_inner(self, bucket: _ServeBucket, pairs,
                         reason: str) -> None:
+        """One contained batch: try the compiled program; a dispatch
+        failure bisects onto the eager lane (never crashes the flush),
+        a non-finite row quarantines its job only.  The healthy path is
+        byte-for-byte the pre-containment one: 0 compiles, 0 retraces,
+        1 dispatch + 1 result fetch per coalesced batch."""
+        if not self._breaker_admit(bucket):
+            # breaker open: the bucket's program is suspect — every job
+            # goes solo on the eager lane (rung "eager" or typed
+            # ServePoisoned; loud either way) until the half-open probe
+            for pair in pairs:
+                self._eager_confirm(bucket, pair)
+            self._finish_batch(bucket, pairs, reason, dispatched=False)
+            return
+        try:
+            out = self._run_bucket(bucket, pairs)
+        except Exception as exc:
+            # containment, not propagation: one breaker failure count
+            # per top-level dispatch, an incident dump with the failing
+            # bucket's span + trace ids, then bisection isolates the
+            # poison member(s) while healthy mates are re-served
+            # bit-identically through the same program
+            self._breaker_fail(bucket)
+            telemetry.incident(
+                "serve_bucket_failure", err=type(exc).__name__,
+                bucket=_bucket_label(bucket.key),
+                jobs=[j.name for j, _ in pairs],
+                traces=[f.trace_id for _, f in pairs])
+            _log.warning(
+                "bucket %s dispatch failed (%s: %s); bisecting %d "
+                "job(s) onto the eager lane",
+                _bucket_label(bucket.key),
+                type(exc).__name__, exc, len(pairs))
+            self._bisect(bucket, pairs, exc)
+            self._finish_batch(bucket, pairs, reason, dispatched=False)
+            return
+        self._breaker_ok(bucket)
+        _, suspects = self._resolve_rows(bucket, pairs, out)
+        for pair in suspects:
+            self._eager_confirm(bucket, pair)
+        self._finish_batch(bucket, pairs, reason, dispatched=True)
+
+    def _run_bucket(self, bucket: _ServeBucket, pairs) -> np.ndarray:
+        """The raw compiled-program primitive: pad, stage, 1 dispatch +
+        1 result fetch.  Raises on dispatch failure (contained by
+        :meth:`_dispatch_inner`); a poisoned member's row comes back
+        non-finite."""
         # the recorder_crash failpoint fires HERE — inside the open
         # bucket span, after the admit events — so the flight recorder's
-        # crash dump provably carries the failing bucket's span and the
-        # admitting requests' trace ids (ISSUE 12's black-box proof)
+        # incident dump provably carries the failing bucket's span and
+        # the admitting requests' trace ids (ISSUE 12's black-box proof)
         faultinject.wrap("recorder_crash", lambda: None)()
+        # a dispatch-time allocator failure (RESOURCE_EXHAUSTED)
+        faultinject.wrap("oom_dispatch", lambda: None)()
+        # scheduler latency on the device path (drives deadline misses)
+        faultinject.wrap("slow_dispatch", lambda: None)()
         jobs = [j for j, _ in pairs]
         padded = jobs + [jobs[-1]] * (self.batch_size - len(jobs))
         prog = self._bucket_program(bucket)
         args = self._batch_args(bucket, padded)
-        profiling.count("serve.dispatch")
-        out = np.asarray(prog(*args))   # 1 dispatch + 1 result fetch
-        P = bucket.n_param
-        for row, (job, fut) in enumerate(pairs):
-            st = int(out[row, P + _COL_STATUS])
-            fut._resolve(ServeResult(
-                job.name, float(out[row, P + _COL_CHI2]), job.dof,  # ddlint: disable=TRACE002 `out` is the host array fetched once above — no per-row device sync
-                FitStatus(st) if 0 <= st <= 3 else FitStatus.NONFINITE,
-                int(out[row, P + _COL_ITERS]),
-                out[row, :len(job.names)].copy(), job.names))
+        need = self._predict_job_bytes(jobs[0]) \
+            if self.max_device_bytes is not None else 0
         with self._cond:
-            self._stats["dispatches"] += 1
+            self._inflight_bytes += need
+        try:
+            profiling.count("serve.dispatch")
+            out = np.asarray(prog(*args))   # 1 dispatch + 1 result fetch
+        finally:
+            with self._cond:
+                self._inflight_bytes -= need
+                self._cond.notify_all()
+        # the chaos sweep's negative control: a seeded silent
+        # corruption the sweep judge MUST catch (never in the default
+        # fault set — tier-1 proves both directions)
+        out = faultinject.wrap("silent_result_bias", lambda o: o)(out)
+        pois = faultinject.wrap("poison_batch_member", lambda n: False)
+        if any(pois(j.name) for j in jobs[:len(pairs)]):
+            out = out.copy()
+            for row in range(len(pairs)):
+                if pois(jobs[row].name):
+                    out[row, :] = np.nan
+        return out
+
+    def _resolve_rows(self, bucket: _ServeBucket, pairs, out) -> tuple:
+        """Resolve each real row of a dispatch output; returns
+        ``(resolved_pairs, suspect_pairs)``.  A suspect row (non-finite
+        chi2/step, or NONFINITE status) is NOT resolved — it goes to
+        the eager lane for confirmation instead of surfacing a bad
+        number as if it were a fit."""
+        P = bucket.n_param
+        resolved, suspects = [], []
+        for row, (job, fut) in enumerate(pairs):
+            chi2 = float(out[row, P + _COL_CHI2])  # ddlint: disable=TRACE002 `out` is the host array fetched once above — no per-row device sync
+            sval = float(out[row, P + _COL_STATUS])
+            status = FitStatus(int(sval)) \
+                if np.isfinite(sval) and 0 <= sval <= 3 \
+                else FitStatus.NONFINITE
+            x = out[row, :len(job.names)].copy()
+            if (not np.isfinite(chi2) or not np.all(np.isfinite(x))
+                    or status == FitStatus.NONFINITE):
+                suspects.append((job, fut))
+                continue
+            fut._resolve(ServeResult(
+                job.name, chi2, job.dof, status,
+                int(out[row, P + _COL_ITERS]), x, job.names))
+            resolved.append((job, fut))
+        return resolved, suspects
+
+    def _finish_batch(self, bucket: _ServeBucket, pairs, reason: str,
+                      dispatched: bool) -> None:
+        """Batch bookkeeping: the healthy path's numbers are unchanged
+        (dispatches/occupancy count only real program dispatches;
+        completed counts resolved futures)."""
+        with self._cond:
+            if dispatched:
+                self._stats["dispatches"] += 1
+                self._stats["occupancy_jobs"] += len(pairs)
             self._stats[f"{reason}_flushes"] += 1
-            self._stats["completed"] += len(pairs)
-            self._stats["occupancy_jobs"] += len(pairs)
+            done = 0
             for _, fut in pairs:
-                self._latencies.append(fut.resolved_at - fut.submitted_at)
-        profiling.count("serve.jobs_done", len(pairs))
+                if fut.done() and fut._exc is None:
+                    done += 1
+                    self._latencies.append(
+                        fut.resolved_at - fut.submitted_at)
+            self._stats["completed"] += done
+        profiling.count("serve.jobs_done", done)
 
     def _take_batch_locked(self, bucket: _ServeBucket) -> list:
         pairs = []
@@ -548,6 +971,7 @@ class TimingService:
                 runtime.SignalFlush() as sigs:
             while True:
                 with self._cond:
+                    self._expire_locked(time.monotonic())
                     nxt = self._next_batch_locked()
                 if nxt is None:
                     break
@@ -603,17 +1027,42 @@ class TimingService:
         telemetry.dump_on_failure("ServeDrained")
         raise err
 
+    def _spool_skip(self, name: str, reason: str, detail: str) -> None:
+        with self._cond:
+            self._stats["spool_skipped"] += 1
+        profiling.count("serve.spool_skip")
+        telemetry.warn("serve.spool_skip", job=name, reason=reason,
+                       spool=self.spool)
+        warnings.warn(f"serve spool {self.spool!r}: skipping job "
+                      f"{name!r} ({detail})", RuntimeWarning,
+                      stacklevel=3)
+
     def resume_spool(self, jobs) -> List[ServeFuture]:
         """Readmit the jobs a drained service spooled.  The spool stores
         identity + a CRC32 of each job's staged arrays, not the (model,
         TOAs) objects, so the caller supplies re-:meth:`prepare`-d jobs
         covering the spooled names; each is verified BIT-identical to
         what was queued (same staged params/batch/mask bytes) before
-        admission — a mismatch raises ``ValueError``, never a silently
-        different fit."""
+        admission.
+
+        A blemished spool no longer takes the whole resume down (ISSUE
+        18): a CRC-mismatched resubmission or a spooled name with no
+        matching prepared job is SKIPPED — with a ``RuntimeWarning`` +
+        a ``serve.spool_skip`` telemetry event, never silently refit
+        from different data — and the remainder is readmitted.  A
+        corrupt spool container (the ``runtime.load_checkpoint`` CRC)
+        likewise warns and resumes nothing.  A file that is not a serve
+        spool at all is still a hard ``ValueError`` (caller error, not
+        rot)."""
         if self.spool is None:
             raise ValueError("this service has no spool path configured")
-        data = runtime.load_checkpoint(self.spool)   # CRC-verified
+        try:
+            data = runtime.load_checkpoint(self.spool)   # CRC-verified
+        except CheckpointCorruptError as exc:
+            self._spool_skip("*", "corrupt_container",
+                             f"corrupt spool container, resuming "
+                             f"nothing: {exc}")
+            return []
         sig = bytes(np.asarray(data["signature"], np.uint8)).decode(
             errors="replace")
         if sig != _SPOOL_SIG:
@@ -630,14 +1079,15 @@ class TimingService:
                                    np.uint8)).decode()
             job = by_name.get(name)
             if job is None:
-                raise ValueError(
-                    f"spool {self.spool!r} names job {name!r} but no "
-                    f"matching prepared job was supplied")
+                self._spool_skip(name, "no_matching_prepared",
+                                 "no matching prepared job supplied")
+                continue
             if job.crc != crc:
-                raise ValueError(
-                    f"resubmitted job {name!r} does not match the "
-                    f"spooled data (crc {job.crc} != spooled {crc}); "
+                self._spool_skip(
+                    name, "crc_mismatch",
+                    f"resubmitted crc {job.crc} != spooled {crc}; "
                     f"refusing to resume a different fit")
+                continue
             futs.append(self.submit_prepared(job))
         profiling.count("serve.spool_resume", len(futs))
         return futs
@@ -666,6 +1116,7 @@ class TimingService:
             "stalled_bucket",
             lambda b: len(b.pending) >= self.batch_size)
         now = time.monotonic()
+        self._expire_locked(now)
         for bucket in self._buckets.values():
             if not bucket.pending:
                 continue
@@ -683,6 +1134,12 @@ class TimingService:
             return None
         deadline = min(b.pending[0][1].submitted_at + self.max_wait_s
                        for b in self._buckets.values() if b.pending)
+        # wake for request deadlines too, so expiry is prompt even when
+        # the max-latency timer is far out
+        for b in self._buckets.values():
+            for _, fut in b.pending:
+                if fut.deadline_at is not None:
+                    deadline = min(deadline, fut.deadline_at)
         return max(deadline - time.monotonic(), 0.0) + 1e-3
 
     def _loop(self) -> None:
@@ -700,7 +1157,12 @@ class TimingService:
                 self._dispatch(bucket, pairs, reason)
             except Exception as e:   # futures must always resolve
                 for _, fut in pairs:
-                    fut._reject(e)
+                    if not fut.done():
+                        fut._reject(e)
+            # supervised-restart failpoint: a one-shot SIGTERM between
+            # dispatches (the crash window `serve supervise` recovers
+            # from with a backoff restart + spool resume)
+            faultinject.wrap("kill_daemon", lambda: None)()
             self._maybe_write_stats()
 
     def _maybe_write_stats(self, force: bool = False) -> None:
@@ -771,11 +1233,18 @@ class TimingService:
             s["n_buckets"] = len(self._buckets)
             s["n_programs"] = len(self._programs)
             s["stats_file_writes"] = self._stats_file_writes
+            # per-bucket breaker map (ISSUE 18): rides /healthz (this
+            # dict IS the healthz body) and the labelled
+            # pint_tpu_serve_breaker Prometheus gauge
+            s["breaker_state"] = {_bucket_label(b.key): b.state
+                                  for b in self._buckets.values()}
         s.update(profiling.latency_stats(lat))
         d = s["dispatches"]
         s["batch_occupancy"] = \
             (s["occupancy_jobs"] / (d * self.batch_size)) if d else 0.0
         s["timer_flush_fraction"] = (s["timer_flushes"] / d) if d else 0.0
+        s["deadline_miss_fraction"] = \
+            s["deadline_misses"] / max(s["submitted"], 1)
         return s
 
 
@@ -821,35 +1290,18 @@ def _demo_service(*, batch_size: int = 2, maxiter: int = 3,
     return svc, jobs
 
 
-def main(argv=None) -> int:
-    """``python -m pint_tpu.serve check``: drive the demo service
-    through the daemon path and print one JSON line of stats — the
-    subprocess surface the tooling tests exercise under the
-    ``request_flood`` / ``stalled_bucket`` failpoints."""
-    import argparse
-
-    ap = argparse.ArgumentParser(
-        prog="python -m pint_tpu.serve",
-        description="continuous-batching timing daemon")
-    sub = ap.add_subparsers(dest="cmd", required=True)
-    chk = sub.add_parser(
-        "check", help="daemon self-exercise -> one JSON line of stats")
-    chk.add_argument("--jobs", type=int, default=12)
-    chk.add_argument("--wait-ms", type=float, default=40.0)
-    chk.add_argument("--batch-size", type=int, default=2)
-    chk.add_argument("--stagger-ms", type=float, default=2.0)
-    chk.add_argument("--corpus", choices=("demo", "pta"),
-                     default="demo",
-                     help="traffic corpus: the 4-pulsar demo set, or "
-                     "a simulated PTA fleet (pint_tpu.pta factory)")
-    chk.add_argument("--pta-n", type=int, default=8,
-                     help="pulsar count for --corpus pta")
-    args = ap.parse_args(argv)
+def _check(args) -> int:
+    """The ``check`` subcommand body: demo/pta corpus through the
+    daemon path -> one JSON line with per-job results (chi2 as
+    ``float.hex`` for bit-exact comparison — the chaos-sweep judge's
+    ground truth)."""
+    from pint_tpu.exceptions import ServeError
 
     # a crashed check leaves a flight recording when
     # PINT_TPU_TELEMETRY_DUMP is set — the black-box subprocess surface
     telemetry.install_excepthook()
     st = runtime.acquire_backend()
+    deadline_s = (args.deadline_ms / 1e3) if args.deadline_ms else None
     if args.corpus == "pta":
         # the factory's first realistic heavy-traffic corpus: a
         # simulated fleet whose power-of-two shape classes land in the
@@ -862,22 +1314,27 @@ def main(argv=None) -> int:
             cadence=pta.Cadence(span_days=360.0, cadence_days=15.0)))
         sim = run.simulate()
         svc = TimingService(batch_size=args.batch_size, maxiter=3,
-                            max_wait_ms=args.wait_ms)
+                            max_wait_ms=args.wait_ms, spool=args.spool)
         jobs = sim.serve_jobs(svc)
     else:
         svc, jobs = _demo_service(batch_size=args.batch_size,
                                   maxiter=3,
-                                  max_wait_ms=args.wait_ms)
+                                  max_wait_ms=args.wait_ms,
+                                  spool=args.spool)
     # warm the bucket programs inline so the daemon-phase stats measure
     # the serving policy, not first-call compiles; under request_flood
     # the warmup is rejected too — then nothing dispatches and no
-    # program is needed
+    # program is needed.  Containment applies here too: a warm future
+    # may reject typed (e.g. a poisoned member) without aborting the run
     warmed = True
     try:
         wf = [svc.submit_prepared(j) for j in jobs]
         svc.flush()
         for f in wf:
-            f.result(timeout=600.0)
+            try:
+                f.result(timeout=600.0)
+            except ServeError:
+                pass
     except ServeSaturated:
         warmed = False
     svc.reset_stats()
@@ -886,36 +1343,195 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     futs = []
     rejected = 0
-    for i in range(args.jobs):
-        try:
-            futs.append(svc.submit_prepared(jobs[i % len(jobs)]))
-        except ServeSaturated:
-            rejected += 1
-        time.sleep(args.stagger_ms / 1e3)
-    # let partial buckets hit their max-latency deadline (the timer
-    # path) before drain would flush them
-    time.sleep(3.0 * svc.max_wait_s)
+    interrupted = None
+    spooled = 0
+    resumed = None
+    sigs = runtime.SignalFlush() if args.spool else None
+    try:
+        if sigs is not None:
+            sigs.__enter__()
+        if args.resume:
+            # restarted-daemon half of `supervise`: NO fresh
+            # submissions — readmit exactly what the killed daemon
+            # spooled, so no job is lost and none is fit twice
+            futs = svc.resume_spool(jobs)
+            resumed = len(futs)
+        else:
+            for i in range(args.jobs):
+                if sigs is not None and sigs.fired is not None:
+                    break
+                try:
+                    futs.append(svc.submit_prepared(
+                        jobs[i % len(jobs)], deadline_s=deadline_s))
+                except (ServeSaturated, ServeOverCapacity,
+                        ServeDeadlineExceeded):
+                    rejected += 1
+                time.sleep(args.stagger_ms / 1e3)
+        # let partial buckets hit their max-latency deadline (the timer
+        # path) before drain would flush them
+        if sigs is None or sigs.fired is None:
+            time.sleep(3.0 * svc.max_wait_s)
+        if sigs is not None and sigs.fired is not None:
+            try:
+                svc._spool_pending(sigs.fired)
+            except ServeDrained as e:
+                interrupted = sigs.fired
+                spooled = e.n_spooled
+    finally:
+        if sigs is not None:
+            sigs.__exit__(None, None, None)
     s = svc.drain(timeout=600.0)
     statuses: Dict[str, int] = {}
+    errors: Dict[str, int] = {}
+    results: Dict[str, dict] = {}
     ok = 0
-    for f in futs:
-        r = f.result(timeout=600.0)
+    completed = 0
+    for i, f in enumerate(futs):
+        key = f"{i}:{f.name}"
+        try:
+            r = f.result(timeout=600.0)
+        except Exception as e:
+            errors[type(e).__name__] = \
+                errors.get(type(e).__name__, 0) + 1
+            results[key] = {"error": type(e).__name__, "flagged": True}
+            continue
+        completed += 1
         statuses[r.status.name] = statuses.get(r.status.name, 0) + 1
         ok += bool(r.ok)
+        # chi2 as float.hex(): the sweep judge compares un-flagged
+        # results bit-exactly against the clean baseline — "flagged"
+        # (typed error or a non-bucket rung) is the loud-degradation
+        # exemption
+        results[key] = {"chi2_hex": float(r.chi2).hex(),
+                        "status": r.status.name, "rung": r.rung,
+                        "iterations": int(r.iterations),
+                        "flagged": r.rung != "bucket"}
     wall = time.monotonic() - t0
     line = {"mode": "check", "backend": st.rung, "warmed": warmed,
-            "jobs": args.jobs, "completed": len(futs),
-            "rejected": rejected, "converged_or_maxiter": ok,
-            "statuses": statuses, "wall_s": round(wall, 3),
-            "fits_per_sec": round(len(futs) / wall, 3) if wall > 0
+            "jobs": args.jobs, "submitted": len(futs),
+            "completed": completed, "rejected": rejected,
+            "converged_or_maxiter": ok, "statuses": statuses,
+            "errors": errors, "results": results,
+            "interrupted": interrupted, "spooled": spooled,
+            "jobs_resumed": resumed, "wall_s": round(wall, 3),
+            "fits_per_sec": round(completed / wall, 3) if wall > 0
             else 0.0}
     for k in ("dispatches", "full_flushes", "timer_flushes",
               "drain_flushes", "batch_occupancy",
-              "timer_flush_fraction", "p50_ms", "p99_ms"):
+              "timer_flush_fraction", "p50_ms", "p99_ms",
+              "quarantined", "eager_served", "deadline_misses",
+              "deadline_miss_fraction", "cancelled", "over_capacity",
+              "breaker_opens", "breaker_state", "spool_skipped"):
         v = s[k]
         line[k] = round(v, 3) if isinstance(v, float) else v
     print(json.dumps(line))
+    if interrupted is not None:
+        # graceful drain-under-signal: distinct rc so a supervisor can
+        # tell "killed with a spool to resume" from clean/broken
+        return 3
+    if args.resume:
+        return 0 if completed == len(futs) else 1
     return 0 if len(futs) + rejected == args.jobs else 1
+
+
+def _supervise(args) -> int:
+    """``supervise``: run the check daemon under
+    :func:`runtime.run_supervised` — a crashed/killed daemon restarts
+    with exponential backoff and resumes its spool, so no admitted job
+    is lost and none is fit twice."""
+    import sys
+
+    def argv(attempt: int) -> list:
+        cmd = [sys.executable, "-m", "pint_tpu.serve", "check",
+               "--jobs", str(args.jobs),
+               "--wait-ms", str(args.wait_ms),
+               "--batch-size", str(args.batch_size),
+               "--stagger-ms", str(args.stagger_ms),
+               "--spool", args.spool]
+        if attempt > 0 and os.path.exists(args.spool):
+            cmd.append("--resume")
+        return cmd
+
+    attempts = runtime.run_supervised(
+        argv, max_restarts=args.max_restarts, backoff_s=args.backoff_s,
+        clean_rcs=(0,), timeout_s=args.timeout_s)
+    parsed = []
+    for rc, stdout, stderr in attempts:
+        doc = {}
+        for ln in reversed([x for x in stdout.splitlines()
+                            if x.strip()]):
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+        parsed.append({"rc": rc,
+                       "submitted": doc.get("submitted"),
+                       "completed": doc.get("completed"),
+                       "spooled": doc.get("spooled"),
+                       "jobs_resumed": doc.get("jobs_resumed"),
+                       "interrupted": doc.get("interrupted")})
+        if rc not in (0, 3):
+            print(stderr[-800:], file=sys.stderr)
+    completed_total = sum(p["completed"] or 0 for p in parsed)
+    okflag = bool(attempts) and attempts[-1][0] == 0
+    print(json.dumps({"mode": "supervise", "attempts": parsed,
+                      "restarts": max(len(parsed) - 1, 0),
+                      "completed_total": completed_total,
+                      "ok": okflag}))
+    return 0 if okflag else 1
+
+
+def main(argv=None) -> int:
+    """``python -m pint_tpu.serve check|supervise``: drive the demo
+    service through the daemon path and print one JSON line — the
+    subprocess surface the tooling tests and the chaos sweep exercise
+    under the serve failpoints."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.serve",
+        description="continuous-batching timing daemon")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    chk = sub.add_parser(
+        "check", help="daemon self-exercise -> one JSON line of stats")
+    chk.add_argument("--jobs", type=int, default=12)
+    chk.add_argument("--wait-ms", type=float, default=40.0)
+    chk.add_argument("--batch-size", type=int, default=2)
+    chk.add_argument("--stagger-ms", type=float, default=2.0)
+    chk.add_argument("--deadline-ms", type=float, default=0.0,
+                     help="per-request deadline (0 = none): queued "
+                     "jobs past it expire with ServeDeadlineExceeded, "
+                     "never mid-dispatch")
+    chk.add_argument("--spool", default=None,
+                     help="drain spool path; also arms the SIGTERM "
+                     "record-don't-kill window (exit 3 = interrupted "
+                     "with a spool to resume)")
+    chk.add_argument("--resume", action="store_true",
+                     help="readmit the spool instead of submitting "
+                     "fresh jobs (the restarted-daemon half of "
+                     "supervise)")
+    chk.add_argument("--corpus", choices=("demo", "pta"),
+                     default="demo",
+                     help="traffic corpus: the 4-pulsar demo set, or "
+                     "a simulated PTA fleet (pint_tpu.pta factory)")
+    chk.add_argument("--pta-n", type=int, default=8,
+                     help="pulsar count for --corpus pta")
+    sup = sub.add_parser(
+        "supervise", help="run the check daemon under a restarting "
+        "supervisor (crash -> backoff restart -> spool resume)")
+    sup.add_argument("--spool", required=True)
+    sup.add_argument("--jobs", type=int, default=12)
+    sup.add_argument("--wait-ms", type=float, default=40.0)
+    sup.add_argument("--batch-size", type=int, default=2)
+    sup.add_argument("--stagger-ms", type=float, default=2.0)
+    sup.add_argument("--max-restarts", type=int, default=3)
+    sup.add_argument("--backoff-s", type=float, default=0.25)
+    sup.add_argument("--timeout-s", type=float, default=600.0)
+    args = ap.parse_args(argv)
+    if args.cmd == "supervise":
+        return _supervise(args)
+    return _check(args)
 
 
 if __name__ == "__main__":   # pragma: no cover

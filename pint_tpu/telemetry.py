@@ -67,7 +67,8 @@ from pint_tpu import profiling
 
 __all__ = ["enable", "disable", "enabled", "span", "event", "warn",
            "new_trace_id", "trace_context", "current_trace_id",
-           "events", "clear", "dump", "dump_on_failure", "load_dump",
+           "events", "clear", "dump", "dump_on_failure", "incident",
+           "load_dump",
            "list_dumps", "summarize", "to_chrome_trace", "write_stats",
            "read_stats", "install_excepthook", "main",
            "add_span_end_hook", "remove_span_end_hook"]
@@ -342,6 +343,21 @@ def dump_on_failure(reason: str) -> Optional[str]:
         return dump(reason=reason)
     except Exception:
         return None
+
+
+def incident(reason: str, /, **attrs) -> Optional[str]:
+    """A contained failure's one-call discipline: record a warning
+    event carrying ``attrs`` AND cut a flight-recorder dump named after
+    ``reason`` — so every blast-radius containment site (serve
+    quarantine, circuit-breaker open, spool-entry skip) leaves both a
+    greppable warning in the ring and a black-box artifact on disk.
+    Returns the dump path (None unless ``PINT_TPU_TELEMETRY_DUMP``
+    opted in).  Never raises."""
+    try:
+        warn(reason, **attrs)
+    except Exception:
+        pass
+    return dump_on_failure(reason)
 
 
 def list_dumps(base: str) -> List[str]:

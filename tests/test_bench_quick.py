@@ -133,6 +133,19 @@ def _assert_schema(d, fast=False):
     assert isinstance(sv["timer_flush_fraction"], (int, float))
     assert d["serve_p50_ms"] == sv["p50_ms"]
     assert d["serve_fits_per_sec"] == sv["fits_per_sec"]
+    # blast-radius containment axis (ISSUE 18): a healthy-path bench
+    # run must show ZERO quarantines and ZERO deadline misses (the
+    # metrics-compare gate enforces the same), and the per-bucket
+    # breaker map must be present and fully closed
+    for key in ("serve_deadline_miss_fraction", "serve_quarantined"):
+        assert isinstance(d.get(key), (int, float)), (key, d.get(key))
+    assert d["serve_quarantined"] == 0, d
+    assert d["serve_deadline_miss_fraction"] == 0, d
+    assert d["serve_quarantined"] == sv["quarantined"]
+    assert d["serve_deadline_miss_fraction"] == sv["deadline_miss_fraction"]
+    bs = sv.get("breaker_state")
+    assert isinstance(bs, dict), sv
+    assert all(v == "closed" for v in bs.values()), bs
     # live-metrics leg (ISSUE 12): the daemon wrote its stats() to the
     # atomic stats file while serving, and the snapshot read back after
     # drain agrees with the leg's own completion count

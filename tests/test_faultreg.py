@@ -1,0 +1,65 @@
+"""Failpoint-registry completeness gate (ISSUE 18 satellite).
+
+Every failpoint ``pint_tpu.faultinject`` exports must be exercised by
+at least one test, so a new failpoint cannot land untested and silently
+rot.  The check is deliberately grep-based (literal name occurrence in
+``tests/``): an injection that no test ever *names* is dead weight even
+if some fixture happens to trip it indirectly.
+"""
+
+import os
+
+import pint_tpu.faultinject as faultinject
+
+#: exported names that are registry plumbing or CLI, not failpoints
+_EXEMPT = {"wrap", "is_active", "main"}
+
+
+def _failpoint_names():
+    return sorted(set(faultinject.__all__) - _EXEMPT)
+
+
+def test_every_failpoint_is_exercised_by_some_test():
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    this = os.path.basename(__file__)
+    blob = []
+    for fn in sorted(os.listdir(tests_dir)):
+        # the checker itself doesn't count as coverage (its name list
+        # is derived from __all__ at runtime, never spelled out)
+        if fn.endswith(".py") and fn != this:
+            with open(os.path.join(tests_dir, fn),
+                      encoding="utf-8") as fh:
+                blob.append(fh.read())
+    corpus = "\n".join(blob)
+    missing = [n for n in _failpoint_names() if n not in corpus]
+    assert not missing, (
+        f"failpoint(s) {missing} are registered in "
+        f"pint_tpu.faultinject.__all__ but no test in tests/ names "
+        f"them — add a driving test (or a subprocess leg) before "
+        f"shipping a failpoint")
+
+
+def test_env_activatable_failpoints_are_exported():
+    """Every PINT_TPU_FAULTS name must map back to an exported context
+    manager, so in-process tests and subprocess legs drive the same
+    failpoint."""
+    for name in faultinject._ENV_FACTORIES:
+        assert name in faultinject.__all__, (
+            f"env-activatable failpoint {name!r} missing from __all__")
+        assert callable(getattr(faultinject, name)), (
+            f"env-activatable failpoint {name!r} has no context "
+            f"manager")
+
+
+def test_sweep_default_set_is_env_activatable():
+    """The chaos sweep activates its fault set across a process
+    boundary — a sweep fault that is not env-activatable would silently
+    run a clean leg."""
+    for name in faultinject._SWEEP_FAULTS:
+        assert name in faultinject._ENV_FACTORIES, (
+            f"sweep fault {name!r} not env-activatable")
+    # the silent-corruption negative control must stay OUT of the
+    # default set (it exists to prove the judge catches it when
+    # injected) but IN the env registry (the --inject leg needs it)
+    assert "silent_result_bias" not in faultinject._SWEEP_FAULTS
+    assert "silent_result_bias" in faultinject._ENV_FACTORIES

@@ -323,9 +323,13 @@ class TestGracefulDrain:
         assert s["warnings"] and "serve.spool" in s["spans"]
         assert "serve.flush" in [o["name"] for o in s["open_spans"]]
 
-    def test_resume_rejects_crc_mismatch_and_missing_jobs(
+    def test_resume_skips_crc_mismatch_and_missing_jobs(
             self, demo, tmp_path):
-        _, jobs, _ = demo
+        """ISSUE 18 satellite: a poisoned spool entry no longer takes
+        the whole resume down — the bad job is SKIPPED with a warning
+        (+ telemetry event + spool_skipped stat) and every healthy
+        batch-mate is readmitted and served bit-identically."""
+        _, jobs, ctrl = demo
         spool = str(tmp_path / "serve_spool.npz")
         svc = _fresh(spool=spool)
         for j in jobs + jobs:   # two batches; batch 1 spools
@@ -334,11 +338,213 @@ class TestGracefulDrain:
             with pytest.raises(ServeDrained) as ei:
                 svc.flush()
         assert ei.value.n_spooled == 2
-        # a resubmitted job whose staged data differs from what was
-        # spooled must be refused, not silently re-fit
+        # a resubmitted job whose staged data differs from the spooled
+        # CRC is skipped loudly, never silently re-fit — and its
+        # healthy batch-mate still resumes bit-identically
         bad = [jobs[0]._replace(crc="deadbeef"), jobs[1]]
-        with pytest.raises(ValueError, match="does not match"):
-            _fresh(spool=spool).resume_spool(bad)
-        # a spooled job the caller did not resubmit is a hard error
-        with pytest.raises(ValueError, match="no matching prepared"):
-            _fresh(spool=spool).resume_spool([jobs[0]])
+        svc2 = _fresh(spool=spool)
+        with pytest.warns(RuntimeWarning, match="refusing to resume"):
+            futs = svc2.resume_spool(bad)
+        assert [f.name for f in futs] == [jobs[1].name]
+        svc2.flush()
+        r = futs[0].result(timeout=600.0)
+        assert float(r.chi2) == float(ctrl[r.name].chi2)
+        assert svc2.stats()["spool_skipped"] == 1
+        # a spooled job the caller did not resubmit: skipped, the rest
+        # readmitted
+        svc3 = _fresh(spool=spool)
+        with pytest.warns(RuntimeWarning, match="no matching prepared"):
+            futs3 = svc3.resume_spool([jobs[0]])
+        assert [f.name for f in futs3] == [jobs[0].name]
+        assert svc3.stats()["spool_skipped"] == 1
+
+    def test_resume_survives_corrupt_spool_container(
+            self, demo, tmp_path):
+        """A flipped byte in the spool container (CRC caught at load)
+        resumes NOTHING — loud warning + spool_skipped stat — instead
+        of crashing the restarted daemon."""
+        _, jobs, _ = demo
+        spool = str(tmp_path / "serve_spool.npz")
+        svc = _fresh(spool=spool)
+        for j in jobs + jobs:
+            svc.submit_prepared(j)
+        with faultinject.sigterm_midscan(after_chunk=0):
+            with pytest.raises(ServeDrained):
+                svc.flush()
+        with faultinject.corrupt_checkpoint(spool, mode="flip"):
+            svc2 = _fresh(spool=spool)
+            with pytest.warns(RuntimeWarning, match="corrupt spool"):
+                futs = svc2.resume_spool(jobs)
+        assert futs == []
+        assert svc2.stats()["spool_skipped"] == 1
+
+
+class TestQuarantine:
+    """ISSUE 18 tentpole: a poison batch member resolves to typed
+    ``ServePoisoned`` while every healthy batch-mate's answer is
+    BIT-identical to a solo run — blast radius of one."""
+
+    def test_poison_member_quarantined_mate_bit_identical(self, demo):
+        _, jobs, ctrl = demo
+        svc = _fresh()
+        victim, mate = jobs[0].name, jobs[1].name
+        with faultinject.poison_batch_member(victim=victim):
+            futs = {j.name: svc.submit_prepared(j) for j in jobs}
+            svc.flush()
+            exc = futs[victim].exception(timeout=600.0)
+        from pint_tpu.exceptions import ServePoisoned
+        assert isinstance(exc, ServePoisoned)
+        assert exc.job == victim
+        # the mate re-served through the SAME compiled program via
+        # bisection: rung still "bucket", numbers bit-identical
+        r = futs[mate].result(timeout=600.0)
+        assert r.rung == "bucket"
+        assert float(r.chi2) == float(ctrl[mate].chi2)
+        np.testing.assert_array_equal(r.x, ctrl[mate].x)
+        st = svc.stats()
+        assert st["quarantined"] == 1
+        assert st["completed"] == 1
+
+    def test_oom_dispatch_contained_on_eager_lane(self, demo):
+        """A dispatch-level failure (RESOURCE_EXHAUSTED) never loses a
+        job: every member of the failed batch is served solo on the
+        eager lane, numerically consistent with the bucket answer."""
+        _, jobs, ctrl = demo
+        svc = _fresh()
+        with faultinject.oom_dispatch():
+            fut = svc.submit_prepared(jobs[0])
+            svc.flush()
+            r = fut.result(timeout=600.0)
+        assert r.rung == "eager"
+        assert np.isfinite(r.chi2)
+        # eager lane is host-driven (not the same compiled program):
+        # agreement is to solver tolerance, not bits
+        assert float(r.chi2) == pytest.approx(
+            float(ctrl[r.name].chi2), rel=1e-9)
+        st = svc.stats()
+        assert st["eager_served"] == 1
+        assert st["quarantined"] == 0
+
+    def test_slow_dispatch_still_bit_identical(self, demo, monkeypatch):
+        """``slow_dispatch`` only stalls the dispatch — undeadlined
+        jobs must still complete bit-identically through the bucket."""
+        _, jobs, ctrl = demo
+        monkeypatch.setenv("PINT_TPU_SLOW_DISPATCH_S", "0.05")
+        svc = _fresh()
+        with faultinject.slow_dispatch():
+            futs = [svc.submit_prepared(j) for j in jobs]
+            svc.flush()
+            rs = [f.result(timeout=600.0) for f in futs]
+        for r in rs:
+            assert r.rung == "bucket"
+            assert float(r.chi2) == float(ctrl[r.name].chi2)
+
+
+class TestDeadlines:
+    def test_queued_job_expires_before_staging(self, demo):
+        """A deadline expires the job in the QUEUE with typed
+        ``ServeDeadlineExceeded`` — it never reaches a dispatch, and
+        its batch-mate is unaffected."""
+        import time
+
+        from pint_tpu.exceptions import ServeDeadlineExceeded
+
+        _, jobs, ctrl = demo
+        svc = _fresh()
+        doomed = svc.submit_prepared(jobs[0], deadline_s=0.01)
+        time.sleep(0.05)
+        mate = svc.submit_prepared(jobs[1])
+        svc.flush()
+        exc = doomed.exception(timeout=600.0)
+        assert isinstance(exc, ServeDeadlineExceeded)
+        assert exc.waited_s >= exc.deadline_s
+        r = mate.result(timeout=600.0)
+        assert float(r.chi2) == float(ctrl[r.name].chi2)
+        st = svc.stats()
+        assert st["deadline_misses"] == 1
+        assert st["deadline_miss_fraction"] == pytest.approx(0.5)
+
+    def test_nonpositive_deadline_rejected_at_admission(self, demo):
+        from pint_tpu.exceptions import ServeDeadlineExceeded
+
+        _, jobs, _ = demo
+        svc = _fresh()
+        with pytest.raises(ServeDeadlineExceeded):
+            svc.submit_prepared(jobs[0], deadline_s=0.0)
+        assert svc.stats()["deadline_misses"] == 1
+
+    def test_cancel_unstaged_future(self, demo):
+        from pint_tpu.exceptions import ServeCancelled
+
+        _, jobs, ctrl = demo
+        svc = _fresh()
+        fut = svc.submit_prepared(jobs[0])
+        assert fut.cancel() is True
+        assert isinstance(fut.exception(timeout=600.0), ServeCancelled)
+        assert fut.cancel() is False   # already settled
+        mate = svc.submit_prepared(jobs[1])
+        svc.flush()
+        r = mate.result(timeout=600.0)
+        assert float(r.chi2) == float(ctrl[r.name].chi2)
+        assert svc.stats()["cancelled"] == 1
+
+
+class TestAdmissionGuard:
+    def test_over_capacity_is_typed_not_oom(self, demo):
+        """A job whose predicted bucket footprint can NEVER fit the
+        device budget is rejected ``ServeOverCapacity`` at admission —
+        the daemon refuses the work instead of OOMing mid-flight."""
+        from pint_tpu.exceptions import ServeOverCapacity
+
+        _, jobs, _ = demo
+        svc = _fresh(max_device_bytes=1)
+        with pytest.raises(ServeOverCapacity) as ei:
+            svc.submit_prepared(jobs[0])
+        assert ei.value.predicted_bytes > ei.value.limit_bytes
+        assert svc.stats()["over_capacity"] == 1
+
+    def test_roomy_budget_admits_and_serves(self, demo):
+        _, jobs, ctrl = demo
+        svc = _fresh(max_device_bytes=1 << 40)
+        futs = [svc.submit_prepared(j) for j in jobs]
+        svc.flush()
+        for f in futs:
+            r = f.result(timeout=600.0)
+            assert float(r.chi2) == float(ctrl[r.name].chi2)
+
+
+class TestCircuitBreaker:
+    def test_breaker_opens_serves_eager_then_probes_closed(self, demo):
+        """N consecutive dispatch failures open the bucket's breaker
+        (straight to the eager lane, no doomed dispatches); after the
+        cooldown a half-open probe re-runs the compiled program and a
+        success closes the breaker — back to bit-identical bucket
+        serving."""
+        _, jobs, ctrl = demo
+        job = jobs[0]   # one-job flushes: eager-lane fits are ~5 s each
+        svc = _fresh()
+        svc._breaker_n = 2             # open after 2 failures (cheap)
+        svc._breaker_cooldown_s = 999.0
+        with faultinject.oom_dispatch():
+            for _ in range(2):
+                fut = svc.submit_prepared(job)
+                svc.flush()
+                assert fut.result(timeout=600.0).rung == "eager"
+        st = svc.stats()
+        assert st["breaker_opens"] == 1
+        assert list(st["breaker_state"].values()) == ["open"]
+        # open + inside cooldown: straight to eager (the failpoint is
+        # GONE — the breaker alone keeps the bucket out of rotation)
+        fut = svc.submit_prepared(job)
+        svc.flush()
+        assert fut.result(timeout=600.0).rung == "eager"
+        # cooldown elapses: the half-open probe succeeds and the
+        # bucket serves bit-identically again
+        svc._breaker_cooldown_s = 0.0
+        fut = svc.submit_prepared(job)
+        svc.flush()
+        r = fut.result(timeout=600.0)
+        assert r.rung == "bucket"
+        assert float(r.chi2) == float(ctrl[r.name].chi2)
+        st = svc.stats()
+        assert list(st["breaker_state"].values()) == ["closed"]

@@ -713,14 +713,123 @@ class TestServeColdStart:
             doc_a["results"]["serve"]["chi2"]
 
 
+class TestServeChaosSweep:
+    """The chaos sweep (ISSUE 18 tentpole): ``python -m
+    pint_tpu.faultinject sweep`` drives ``serve check`` under every
+    env-activatable serve failpoint (and seeded pairs) and enforces the
+    global containment invariant — every failure is a typed error or a
+    loud degradation, NEVER a silent wrong answer.  Marker ``serve``;
+    opt out with ``PINT_TPU_SKIP_SERVE=1``."""
+
+    @staticmethod
+    def _sweep(extra=()):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PINT_TPU_FAULTS", None)
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.faultinject", "sweep",
+             "--seed", "7", "--jobs", "4", *extra],
+            capture_output=True, text=True, timeout=1800, env=env)
+
+    def test_sweep_exits_zero_on_shipped_tree(self):
+        import json
+
+        p = self._sweep(["--pairs", "1"])
+        assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["ok"] is True and doc["problems"] == []
+        # baseline + every default fault + the seeded pair all ran
+        legs = {s["leg"] for s in doc["legs"]}
+        assert "baseline" in legs
+        from pint_tpu.faultinject import _SWEEP_FAULTS
+        assert set(_SWEEP_FAULTS) <= legs
+        assert doc["n_legs"] == len(_SWEEP_FAULTS) + 2
+
+    def test_sweep_catches_injected_silent_corruption(self):
+        """The negative control: ``--inject silent_result_bias`` adds a
+        failpoint that ONLY flips low chi2 bits (no raise, no flag, no
+        counter) — the judge must exit 1 and name the corrupted leg."""
+        import json
+
+        p = self._sweep(["--pairs", "0",
+                         "--inject", "silent_result_bias"])
+        assert p.returncode == 1, p.stdout + p.stderr[-2000:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["ok"] is False
+        hits = [pr for pr in doc["problems"]
+                if "silent_result_bias" in pr
+                and "SILENT WRONG ANSWER" in pr]
+        assert hits, doc["problems"]
+        # attribution is precise: no OTHER leg is blamed
+        assert all("silent_result_bias" in pr
+                   for pr in doc["problems"]), doc["problems"]
+
+
+class TestServeSupervise:
+    """The supervised-restart leg (ISSUE 18): ``python -m
+    pint_tpu.serve supervise`` restarts a daemon SIGTERM-killed
+    mid-flight (the one-shot ``kill_daemon`` failpoint) and resumes its
+    spool — across the kill, no admitted job is lost and none is fit
+    twice.  Marker ``serve``; opt out with ``PINT_TPU_SKIP_SERVE=1``."""
+
+    def test_kill_midflight_restarts_and_resumes(self, tmp_path):
+        import json
+        import os
+        import subprocess
+        import sys
+
+        token = tmp_path / "kill.token"
+        token.write_text("")
+        spool = str(tmp_path / "spool.npz")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # slow_dispatch stretches each bucket dispatch to 1 s so the
+        # SIGTERM (fired by kill_daemon after the FIRST daemon batch)
+        # provably lands while later jobs are still queued; wait-ms 600
+        # keeps the submitter parked until after the kill
+        env.update({
+            "PINT_TPU_FAULTS": "kill_daemon,slow_dispatch",
+            "PINT_TPU_SLOW_DISPATCH_S": "1.0",
+            "PINT_TPU_KILL_TOKEN": str(token),
+        })
+        p = subprocess.run(
+            [sys.executable, "-m", "pint_tpu.serve", "supervise",
+             "--spool", spool, "--jobs", "8", "--wait-ms", "600",
+             "--stagger-ms", "5", "--backoff-s", "0.05",
+             "--timeout-s", "570"],
+            capture_output=True, text=True, timeout=1500, env=env)
+        assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["ok"] is True
+        assert doc["restarts"] >= 1, doc
+        a1, last = doc["attempts"][0], doc["attempts"][-1]
+        # attempt 1 died to the in-flight SIGTERM with a spool (rc 3)
+        assert a1["rc"] == 3 and a1["interrupted"] == 15, a1
+        assert a1["spooled"] >= 1, a1
+        # conservation on the killed attempt: every admitted job either
+        # completed or was spooled — nothing vanished
+        assert a1["completed"] + a1["spooled"] == a1["submitted"], a1
+        # the restarted attempt readmitted EXACTLY the spool (no fresh
+        # submissions -> nothing fit twice) and completed all of it
+        assert last["jobs_resumed"] == a1["spooled"], (a1, last)
+        assert last["completed"] == last["jobs_resumed"], last
+        assert doc["completed_total"] == a1["submitted"], doc
+        # the kill token is one-shot: consumed by the first SIGTERM
+        assert not token.exists()
+
+
 class TestTelemetryBlackBox:
-    """The flight recorder's black-box proof (ISSUE 12), ACROSS the
-    process boundary: the ``recorder_crash`` failpoint (activated via
-    ``PINT_TPU_FAULTS``) kills a serve batch mid-dispatch, and the
-    crashed process must leave a CRC-valid dump whose ERRORED
-    ``serve.dispatch_bucket`` span names the admitted requests' trace
-    ids; the ``python -m pint_tpu.telemetry`` CLI must summarize it and
-    export valid Chrome trace JSON.  Plus the hard contract-neutrality
+    """The flight recorder's black-box proof (ISSUE 12 -> 18), ACROSS
+    the process boundary: the ``recorder_crash`` failpoint (activated
+    via ``PINT_TPU_FAULTS``) makes every serve bucket dispatch raise —
+    under blast-radius containment the daemon must NOT crash: every job
+    is re-served on the eager lane, and each failed dispatch leaves a
+    CRC-valid incident dump (reason ``serve_bucket_failure``) naming
+    the failing bucket and the admitted requests' trace ids; the
+    ``python -m pint_tpu.telemetry`` CLI must summarize it and export
+    valid Chrome trace JSON.  Plus the hard contract-neutrality
     requirement: the FULL dispatch-contract audit passes with recording
     enabled.  Marker ``telemetry``; opt out with
     ``PINT_TPU_SKIP_TELEMETRY=1``."""
@@ -737,7 +846,7 @@ class TestTelemetryBlackBox:
             [sys.executable, "-m", module, *args],
             capture_output=True, text=True, timeout=600, env=env)
 
-    def test_recorder_crash_leaves_readable_dump(self, tmp_path):
+    def test_recorder_crash_contained_with_incident_dump(self, tmp_path):
         import json
 
         from pint_tpu import telemetry
@@ -746,42 +855,48 @@ class TestTelemetryBlackBox:
         p = self._run("pint_tpu.serve", ["check", "--jobs", "4"],
                       {"PINT_TPU_FAULTS": "recorder_crash",
                        "PINT_TPU_TELEMETRY_DUMP": dump})
-        # the crash must be a crash: nonzero exit, the failpoint's
-        # message in the traceback
-        assert p.returncode != 0, p.stdout + p.stderr[-800:]
-        assert "recorder_crash fired" in p.stderr, p.stderr[-800:]
-        # ... and the black box survives it, CRC-intact
+        # blast-radius containment (ISSUE 18): the dispatch failure is
+        # CONTAINED — the run completes every job on the eager lane
+        # (loudly flagged), never crashes and never silently drops one
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["completed"] == 4 and doc["errors"] == {}
+        assert all(e["rung"] == "eager" and e["flagged"]
+                   for e in doc["results"].values()), doc["results"]
+        assert doc["eager_served"] == 4
+        assert doc["quarantined"] == 0
+        # ... and the black box carries the evidence, CRC-intact: each
+        # failed dispatch cut an incident dump naming the bucket and
+        # the admitted requests it was fitting
+        assert telemetry.list_dumps(dump)
         header, evs = telemetry.load_dump(dump)
-        assert header["reason"] == "unhandled_exception"
+        assert header["reason"] == "serve_bucket_failure"
         assert header["pid"] != __import__("os").getpid()
         admits = [e for e in evs if e.get("name") == "serve.admit"]
         assert admits, [e.get("name") for e in evs]
         admitted = {e["attrs"]["trace_id"] for e in admits}
-        # the failing bucket's span is in the dump, marked ERRORED (the
-        # unwinding exception closed it with the error type) and names
-        # the admitted requests it was fitting
+        incidents = [e for e in evs if e.get("ev") == "W"
+                     and e.get("name") == "serve_bucket_failure"]
+        assert incidents, [e.get("name") for e in evs]
+        assert incidents[-1]["attrs"]["err"] == "RuntimeError"
+        assert set(incidents[-1]["attrs"]["traces"]) <= admitted
+        # the failing dispatch's span was still OPEN at dump time (the
+        # incident fires inside the containment handler, before
+        # bisection resolves the batch)
         begins = [e for e in evs if e.get("ev") == "B"
                   and e.get("name") == "serve.dispatch_bucket"]
         assert begins, [e.get("name") for e in evs]
         assert set(begins[-1]["attrs"]["traces"]) <= admitted
-        errored = [e for e in evs if e.get("ev") == "E"
-                   and e.get("span") == begins[-1]["span"]]
-        assert errored and errored[0]["err"] == "RuntimeError"
-        # the unhandled-exception warning is the last word
-        warns = [e for e in evs if e.get("ev") == "W"]
-        assert warns[-1]["name"] == "unhandled_exception"
-        assert "recorder_crash" in warns[-1]["attrs"]["message"]
 
         # the operator CLI renders the same story from the dump alone
         ps = self._run("pint_tpu.telemetry", ["summarize", dump])
         assert ps.returncode == 0, ps.stdout + ps.stderr[-800:]
         doc = json.loads(ps.stdout)
-        assert doc["header"]["reason"] == "unhandled_exception"
-        errs = doc["summary"]["errored_spans"]
-        assert any(e["name"] == "serve.dispatch_bucket"
-                   and e["err"] == "RuntimeError" for e in errs), errs
-        assert any(w["name"] == "unhandled_exception"
+        assert doc["header"]["reason"] == "serve_bucket_failure"
+        assert any(w["name"] == "serve_bucket_failure"
                    for w in doc["summary"]["warnings"])
+        assert any(o["name"] == "serve.dispatch_bucket"
+                   for o in doc["summary"]["open_spans"])
 
         # ... and exports valid Chrome trace-event JSON for Perfetto
         chrome = str(tmp_path / "chrome.json")
