@@ -593,6 +593,122 @@ def bench_serve(n_requests: int = 24, batch_size: int = 2,
         "metrics_scrape": metrics_scrape}
 
 
+def bench_gateway(n_clients: int = 2, jobs_per_client: int = 4,
+                  batch_size: int = 2, max_wait_ms: float = 25.0,
+                  subset: int = 2, seed: int = 78):
+    """Multi-process load against the HTTP front door (ISSUE 19): an
+    in-process TimingService behind a loopback Gateway, driven by
+    jax-free client subprocesses (``pint_tpu/client.py`` is
+    stdlib-only by design, so each client is a real second process
+    without a second jax import).  The quota is sized generously so
+    the clean path shows 0 retries and 0 dedup hits — the
+    client-observed p50/p99 measure the HTTP + admission + journal
+    overhead stacked on the serve path, not backpressure.  Priorities
+    alternate across clients so both admission classes are exercised."""
+    import subprocess
+    import tempfile
+
+    import pint_tpu
+    from pint_tpu.gateway import Gateway, payload_crc, serialize_job
+    from pint_tpu.serve import _demo_service
+
+    svc, jobs = _demo_service(batch_size=batch_size, maxiter=3,
+                              max_wait_ms=max_wait_ms)
+    if subset:   # quick mode: one shape bucket -> one program compile
+        jobs = jobs[:subset]
+    payloads = [serialize_job(j.model, j.resid.toas, name=j.name)
+                for j in jobs]
+    tmpdir = tempfile.mkdtemp(prefix="pint_tpu_gwbench_")
+    payloads_path = os.path.join(tmpdir, "payloads.json")
+    with open(payloads_path, "w", encoding="utf-8") as fh:
+        json.dump(payloads, fh)
+    total_jobs = n_clients * jobs_per_client
+    gw = Gateway(svc, quota=4.0 * total_jobs, window_s=1.0,
+                 journal=os.path.join(tmpdir, "journal.jsonl"))
+    # warm THROUGH the gateway payload cache so the timed phase is the
+    # steady-state wire path (gateway submissions deserialize to the
+    # same PreparedJob the warm-up staged — same idiom as
+    # `gateway check`)
+    t0 = time.time()
+    warm = [svc.submit_prepared(gw._prepare_cached(p, payload_crc(p)))
+            for p in payloads]
+    svc.flush()
+    for f in warm:
+        f.result(timeout=600.0)
+    compile_s = time.time() - t0
+    svc.reset_stats()
+    svc.start()
+    gw.start(port=0)
+    client_py = os.path.join(
+        os.path.dirname(pint_tpu.__file__), "client.py")
+    procs, docs = [], []
+    t0 = time.time()
+    try:
+        for i in range(n_clients):
+            procs.append(subprocess.Popen(
+                [sys.executable, client_py, "load",
+                 "--url", f"http://127.0.0.1:{gw.port}",
+                 "--payloads", payloads_path,
+                 "--jobs", str(jobs_per_client),
+                 "--tenant", f"bench{i}",
+                 "--priority", ("high", "normal")[i % 2],
+                 "--key-prefix", f"gwb{seed}-{i}",
+                 "--seed", str(seed + i)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        for p in procs:
+            out, _err = p.communicate(timeout=600)
+            line = out.strip().splitlines()[-1] if out.strip() else "{}"
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                doc = {"error": "unparseable client output"}
+            doc["rc"] = p.returncode
+            docs.append(doc)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        wall = max(time.time() - t0, 1e-9)
+        gw.settle_done()
+        gst = gw.stats()
+        gw.stop()
+        st = svc.drain(timeout=600.0)
+    completed = sum(d.get("completed") or 0 for d in docs)
+    retries = sum(d.get("retries") or 0 for d in docs)
+    dedup_hits = sum(d.get("dedup_hits") or 0 for d in docs)
+    # per-client percentiles: clients are symmetric (same corpus, same
+    # job count), so the leg's p50 is the mean of client medians and
+    # the p99 is the worst client tail
+    p50s = [d["p50_ms"] for d in docs if d.get("p50_ms") is not None]
+    p99s = [d["p99_ms"] for d in docs if d.get("p99_ms") is not None]
+    by_priority = {}
+    for d in docs:
+        pri = d.get("priority")
+        if pri:
+            ent = by_priority.setdefault(
+                pri, {"completed": 0, "p99_ms": None})
+            ent["completed"] += d.get("completed") or 0
+            if d.get("p99_ms") is not None:
+                ent["p99_ms"] = max(ent["p99_ms"] or 0.0, d["p99_ms"])
+    return {
+        "n_clients": n_clients, "jobs_per_client": jobs_per_client,
+        "jobs": total_jobs, "completed": completed,
+        "p50_ms": round(float(np.mean(p50s)), 3) if p50s else None,
+        "p99_ms": round(max(p99s), 3) if p99s else None,
+        "by_priority": by_priority,
+        # must-be-zero on the clean path (`metrics compare` gates on
+        # both): a retry means a connection/5xx hiccup on loopback, a
+        # dedup hit means a duplicate submission slipped through
+        "retries": retries, "dedup_hits": dedup_hits,
+        "gw_dedup_hits": gst["dedup_hits"],
+        "codes": gst["codes"], "accepted": gst["accepted"],
+        "fits": st["completed"], "dispatches": st["dispatches"],
+        "fits_per_sec": round(completed / wall, 1),
+        "client_rcs": [d.get("rc") for d in docs],
+        "compile_s": round(compile_s, 2), "wall_s": round(wall, 4)}
+
+
 def bench_design_split(ntoas: int = 2500):
     """Split vs full design-matrix assembly wall-clock at the headline
     width (~86 params, 70 DMX bins), same backend, steady state (cached
@@ -1023,6 +1139,20 @@ def bench_quick(backend_status=None):
             serve = bench_serve(subset=2)
         except Exception as e:  # keep the quick line alive
             serve = {"error": f"{type(e).__name__}: {e}"}
+    # the HTTP front door under multi-process client load (ISSUE 19):
+    # client-observed p50/p99 through the loopback gateway plus the
+    # must-be-zero clean-path axes (retries, dedup hits)
+    if fast:
+        gateway = {"skipped": "PINT_TPU_BENCH_FAST=1"}
+    else:
+        try:
+            # 2 clients x 4 jobs on the one-bucket subset: the quick
+            # leg proves the wire path end-to-end; the headline leg
+            # runs more clients over the full two-bucket corpus
+            gateway = bench_gateway(n_clients=2, jobs_per_client=4,
+                                    subset=2)
+        except Exception as e:  # keep the quick line alive
+            gateway = {"error": f"{type(e).__name__}: {e}"}
     # per-program cost cards (ISSUE 13): what each headline entrypoint
     # program costs in FLOPs / bytes / per-device peak, off the
     # compiled artifacts on the audit fixture
@@ -1122,6 +1252,14 @@ def bench_quick(backend_status=None):
         "serve_quarantined": serve.get("quarantined"),
         "serve_deadline_miss_fraction":
             serve.get("deadline_miss_fraction"),
+        # HTTP front door (ISSUE 19): client-observed latency through
+        # the loopback gateway in real client subprocesses, plus the
+        # must-be-zero clean-path axes (`metrics compare` gates on
+        # retries growth and any dedup hit)
+        "gateway_p50_ms": gateway.get("p50_ms"),
+        "gateway_p99_ms": gateway.get("p99_ms"),
+        "gateway_retries": gateway.get("retries"),
+        "gateway_dedup_hits": gateway.get("dedup_hits"),
         # per-program cost cards (ISSUE 13): {entry: {flops,
         # bytes_accessed, peak_bytes, ...}}; null when the leg was
         # skipped/failed (schema-checked in tests/test_bench_quick.py
@@ -1144,6 +1282,7 @@ def bench_quick(backend_status=None):
         "precflow_clean": precflow.get("precflow_clean"),
         "submetrics": {"fleet": fleet, "aot_cold_start": aot_cold,
                        "comm_profile": comm, "serve": serve,
+                       "gateway": gateway,
                        "telemetry": telemetry_cost,
                        "cost_cards": cost_cards, "pta": pta_leg,
                        "precflow": precflow},
@@ -1282,6 +1421,9 @@ def main(argv=None):
             ("design_split", bench_design_split),
             ("fleet", bench_fleet),
             ("serve", bench_serve),
+            ("gateway", lambda: bench_gateway(n_clients=3,
+                                              jobs_per_client=4,
+                                              subset=0)),
             ("cost_cards", bench_cost_cards),
             ("pta", bench_pta),
             ("aot_cold_start", bench_cold_start),
@@ -1354,6 +1496,16 @@ def main(argv=None):
         "serve_deadline_miss_fraction": (submetrics.get("serve")
                                          or {}).get(
             "deadline_miss_fraction"),
+        # HTTP front door (ISSUE 19): client-observed latency through
+        # the loopback gateway plus the must-be-zero clean-path axes
+        "gateway_p50_ms": (submetrics.get("gateway") or {}).get(
+            "p50_ms"),
+        "gateway_p99_ms": (submetrics.get("gateway") or {}).get(
+            "p99_ms"),
+        "gateway_retries": (submetrics.get("gateway") or {}).get(
+            "retries"),
+        "gateway_dedup_hits": (submetrics.get("gateway") or {}).get(
+            "dedup_hits"),
         # analytic solve-FLOP floor / measured wall (profiling.solve_flops)
         "solve_utilization": headline_util,
         # steady-state XLA-boundary counters (ISSUE 5): the regression

@@ -1,0 +1,455 @@
+"""pint_tpu.client — crash-survivable client for the network front door.
+
+The other half of the ISSUE 19 boundary: a small, dependency-free
+client for the :mod:`pint_tpu.gateway` HTTP API whose failure handling
+is strong enough to extend the PR 18 kill-midflight conservation
+invariant across the network.  Three disciplines:
+
+* **Bounded retries with backoff + jitter under a caller deadline** —
+  connection failures, 429 (honoring Retry-After) and 503 are retried
+  up to ``retries`` times with exponential backoff and uniform jitter,
+  never past the caller's ``timeout_s``; 400/404/409 are terminal (a
+  malformed request does not become correct by repetition).
+* **Idempotency by default** — every ``submit`` carries an
+  ``X-Idempotency-Key`` (auto-generated when the caller has none), so
+  a retry after a dropped connection maps back to the SAME job id
+  server-side and can never double-fit.
+* **Reconnect across restarts** — ``wait`` polls the job id and
+  treats connection failures as "daemon restarting", probing
+  ``/healthz`` until the supervised daemon is back; a resolved job's
+  result replays from the gateway's dedup journal, so the answer
+  survives the daemon that computed it.
+
+IMPORTANT: this module imports ONLY the standard library at module
+level and is runnable as a plain script (``python pint_tpu/client.py
+load ...``) — the bench harness spawns client PROCESSES from it, and
+importing the ``pint_tpu`` package would pay the full jax start-up tax
+in every one of them.
+
+Env knobs (all overridable per-call): ``PINT_TPU_CLIENT_RETRIES``
+(default 4), ``PINT_TPU_CLIENT_BACKOFF_S`` (0.2),
+``PINT_TPU_CLIENT_JITTER_S`` (0.1), ``PINT_TPU_CLIENT_BACKOFF_CAP_S``
+(per-attempt sleep cap, 5.0), ``PINT_TPU_CLIENT_TIMEOUT_S``
+(per-request socket timeout, 30).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import os
+import random
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+__all__ = ["GatewayClient", "GatewayClientError", "GatewayUnavailable",
+           "GatewayQuotaExceeded", "GatewayRequestFailed", "main"]
+
+
+class GatewayClientError(Exception):
+    """Base for client-side gateway failures; ``http_code`` is the
+    terminal status code when one was received (else None)."""
+
+    http_code: Optional[int] = None
+
+    def __init__(self, msg="", http_code=None, doc=None):
+        self.http_code = http_code
+        self.doc = doc or {}
+        super().__init__(msg)
+
+
+class GatewayUnavailable(GatewayClientError):
+    """The gateway could not be reached (or kept dropping the
+    connection) within the retry budget — the daemon is down, still
+    restarting, or the network is broken."""
+
+
+class GatewayQuotaExceeded(GatewayClientError):
+    """429 survived the retry budget: this tenant is over quota at
+    this priority and the Retry-After horizon exceeds the caller's
+    patience."""
+
+
+class GatewayRequestFailed(GatewayClientError):
+    """A terminal (non-retryable) HTTP error: 400 bad payload, 409
+    idempotency conflict, 404 unknown job, or a 5xx that is not
+    backpressure."""
+
+
+#: connection-level failures worth retrying — includes the half-open
+#: socket shapes a killed daemon leaves behind
+_CONN_ERRORS = (ConnectionError, socket.timeout, socket.gaierror,
+                http.client.HTTPException, TimeoutError)
+
+
+def _pct(samples_ms: List[float], q: float) -> Optional[float]:
+    xs = sorted(samples_ms)
+    if not xs:
+        return None
+    i = min(len(xs) - 1, max(0, int(math.ceil(q * len(xs))) - 1))
+    return round(xs[i], 4)
+
+
+class GatewayClient:
+    """One tenant's handle on a gateway base URL.
+
+    ``stats`` accumulates across calls: ``retries`` (re-sent
+    requests), ``reconnects`` (healthz probe cycles after a connection
+    loss), ``dedup`` (submissions the server answered from its
+    idempotency table/journal)."""
+
+    def __init__(self, base_url: str, *, tenant: str = "default",
+                 retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 jitter_s: Optional[float] = None,
+                 request_timeout_s: Optional[float] = None,
+                 seed: Optional[int] = None):
+        self.base_url = base_url.rstrip("/")
+        self.tenant = tenant
+        env = os.environ.get
+        self.retries = int(env("PINT_TPU_CLIENT_RETRIES", "4") or 4) \
+            if retries is None else int(retries)
+        self.backoff_s = float(env("PINT_TPU_CLIENT_BACKOFF_S",
+                                   "0.2") or 0.2) \
+            if backoff_s is None else float(backoff_s)
+        self.jitter_s = float(env("PINT_TPU_CLIENT_JITTER_S",
+                                  "0.1") or 0.1) \
+            if jitter_s is None else float(jitter_s)
+        self.backoff_cap_s = float(env("PINT_TPU_CLIENT_BACKOFF_CAP_S",
+                                       "5.0") or 5.0)
+        self.request_timeout_s = float(env("PINT_TPU_CLIENT_TIMEOUT_S",
+                                           "30") or 30) \
+            if request_timeout_s is None else float(request_timeout_s)
+        self._rng = random.Random(seed)
+        self._keyseq = 0
+        self.stats = {"retries": 0, "reconnects": 0, "dedup": 0}
+
+    # -- low-level HTTP ----------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 body: Optional[bytes] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        """-> ``(code, doc, headers)``; raises the ``_CONN_ERRORS``
+        family on transport failure (retried by the callers)."""
+        req = urllib.request.Request(
+            self.base_url + path, data=body, method=method,
+            headers=dict(headers or {}))
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as resp:
+                raw = resp.read()
+                return resp.status, self._decode(raw), dict(
+                    resp.headers)
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            return e.code, self._decode(raw), dict(e.headers)
+        except urllib.error.URLError as e:
+            reason = getattr(e, "reason", e)
+            if isinstance(reason, _CONN_ERRORS + (OSError,)):
+                raise reason if isinstance(reason, Exception) \
+                    else ConnectionError(str(reason))
+            raise ConnectionError(str(reason))
+
+    @staticmethod
+    def _decode(raw: bytes) -> dict:
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            return doc if isinstance(doc, dict) else {"body": doc}
+        except (ValueError, UnicodeDecodeError):
+            return {}
+
+    def _sleep_budget(self, attempt: int, retry_after: Optional[float],
+                      deadline_at: Optional[float]) -> bool:
+        """Back off before retry ``attempt``; False when the caller's
+        deadline cannot absorb the wait (stop retrying).  Exponential
+        with a cap (the ``run_supervised`` idiom) so a large retry
+        budget spans a slow daemon restart without the tail attempts
+        sleeping for minutes."""
+        delay = min(self.backoff_s * (2.0 ** attempt),
+                    self.backoff_cap_s) \
+            + self._rng.uniform(0.0, self.jitter_s)
+        if retry_after is not None:
+            delay = max(delay, float(retry_after))
+        if deadline_at is not None \
+                and time.monotonic() + delay >= deadline_at:
+            return False
+        time.sleep(delay)
+        return True
+
+    # -- probes ------------------------------------------------------------
+
+    def healthz(self) -> Optional[dict]:
+        """One /healthz probe; None when unreachable."""
+        try:
+            code, doc, _ = self._request("GET", "/healthz")
+        except _CONN_ERRORS + (OSError,):
+            return None
+        return doc if code == 200 else None
+
+    def wait_ready(self, timeout_s: float = 30.0,
+                   poll_s: float = 0.2) -> bool:
+        """Probe /healthz until the gateway answers — the reconnect
+        loop a supervised restart is bridged by."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.healthz() is not None:
+                return True
+            time.sleep(poll_s)
+        return self.healthz() is not None
+
+    # -- submission --------------------------------------------------------
+
+    def new_idem_key(self) -> str:
+        self._keyseq += 1
+        return f"c{os.getpid()}-{os.urandom(6).hex()}-{self._keyseq}"
+
+    def submit(self, payload: dict, *, priority: str = "normal",
+               tenant: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               idem_key: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               timeout_s: Optional[float] = None) -> dict:
+        """POST the job; returns ``{"job_id", "trace_id", "dedup"}``.
+
+        The idempotency key (auto-generated if absent) makes every
+        retry safe: a response lost to a dropped connection is
+        recovered by re-sending, and the server maps the key back to
+        the original admission.  ``deadline_ms`` is the JOB deadline —
+        re-computed to the remaining budget on each retry so the
+        propagated header never promises time that was already spent
+        backing off."""
+        idem_key = idem_key or self.new_idem_key()
+        body = json.dumps(payload).encode("utf-8")
+        deadline_at = None
+        if timeout_s is not None:
+            deadline_at = time.monotonic() + float(timeout_s)
+        job_deadline_at = None
+        if deadline_ms is not None:
+            job_deadline_at = time.monotonic() + float(deadline_ms) / 1e3
+        attempt = 0
+        while True:
+            headers = {"Content-Type": "application/json",
+                       "X-Tenant": tenant or self.tenant,
+                       "X-Priority": priority,
+                       "X-Idempotency-Key": idem_key}
+            if trace_id:
+                headers["X-Trace-Id"] = trace_id
+            if job_deadline_at is not None:
+                remaining_ms = (job_deadline_at - time.monotonic()) \
+                    * 1e3
+                headers["X-Deadline-Ms"] = f"{remaining_ms:.1f}"
+            retry_after = None
+            try:
+                code, doc, hdrs = self._request(
+                    "POST", "/v1/jobs", body=body, headers=headers)
+            except _CONN_ERRORS + (OSError,) as e:
+                code, doc, hdrs = None, {"error": type(e).__name__,
+                                         "message": str(e)}, {}
+            if code == 202:
+                if doc.get("dedup"):
+                    self.stats["dedup"] += 1
+                return doc
+            if code in (400, 404, 409, 504):
+                # terminal: a bad payload, a key conflict, or a
+                # deadline that already expired cannot be fixed by
+                # resending the same request
+                raise GatewayRequestFailed(
+                    f"gateway rejected the request "
+                    f"({code}: {doc.get('message') or doc.get('error')})",
+                    http_code=code, doc=doc)
+            if code in (429, 503):
+                ra = hdrs.get("Retry-After")
+                try:
+                    retry_after = float(ra) if ra else None
+                except ValueError:
+                    retry_after = None
+            if attempt >= self.retries or not self._sleep_budget(
+                    attempt, retry_after, deadline_at):
+                if code == 429:
+                    raise GatewayQuotaExceeded(
+                        f"over quota after {attempt} retries "
+                        f"({doc.get('message')})", http_code=429,
+                        doc=doc)
+                if code is None:
+                    raise GatewayUnavailable(
+                        f"gateway unreachable after {attempt} "
+                        f"retries ({doc.get('message')})")
+                raise GatewayRequestFailed(
+                    f"gateway error {code} after {attempt} retries "
+                    f"({doc.get('message') or doc.get('error')})",
+                    http_code=code, doc=doc)
+            attempt += 1
+            self.stats["retries"] += 1
+
+    # -- result polling ----------------------------------------------------
+
+    def status(self, job_id: str) -> dict:
+        code, doc, _ = self._request("GET", f"/v1/jobs/{job_id}")
+        if code == 200:
+            return doc
+        raise GatewayRequestFailed(
+            f"job {job_id!r}: gateway answered {code}",
+            http_code=code, doc=doc)
+
+    def wait(self, job_id: str, timeout_s: float = 120.0,
+             poll_s: float = 0.1) -> dict:
+        """Poll until the job resolves (state ``done`` or ``error``).
+        A connection loss mid-wait is treated as a daemon restart:
+        probe ``/healthz`` until it is back, then resume polling —
+        a job resolved before the crash replays from the journal, an
+        unresolved one was re-admitted under the same id."""
+        deadline = time.monotonic() + float(timeout_s)
+        delay = float(poll_s)
+        while True:
+            try:
+                doc = self.status(job_id)
+                if doc.get("state") in ("done", "error"):
+                    return doc
+            except GatewayRequestFailed as e:
+                if e.http_code != 404:
+                    raise
+                # 404 right after a restart: the journal has the key
+                # but the client may poll before re-admission settles
+            except _CONN_ERRORS + (OSError,):
+                # daemon restarting: probe /healthz until it is back,
+                # bounded only by the CALLER's deadline — a supervised
+                # cold restart can take the full jax start-up tax
+                self.stats["reconnects"] += 1
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self.wait_ready(
+                        timeout_s=remaining):
+                    raise GatewayUnavailable(
+                        f"gateway did not come back while waiting "
+                        f"on {job_id!r}")
+            if time.monotonic() >= deadline:
+                raise GatewayUnavailable(
+                    f"job {job_id!r} not resolved within "
+                    f"{timeout_s} s")
+            time.sleep(delay)
+            delay = min(delay * 1.5, 1.0)
+
+    def submit_and_wait(self, payload: dict, *,
+                        priority: str = "normal",
+                        tenant: Optional[str] = None,
+                        deadline_ms: Optional[float] = None,
+                        idem_key: Optional[str] = None,
+                        trace_id: Optional[str] = None,
+                        timeout_s: float = 120.0) -> dict:
+        """Submit + wait under ONE deadline; the status doc gains a
+        ``dedup`` echo so callers can count journal replays."""
+        t0 = time.monotonic()
+        out = self.submit(payload, priority=priority, tenant=tenant,
+                          deadline_ms=deadline_ms, idem_key=idem_key,
+                          trace_id=trace_id, timeout_s=timeout_s)
+        remaining = max(float(timeout_s) - (time.monotonic() - t0),
+                        0.5)
+        doc = self.wait(out["job_id"], timeout_s=remaining)
+        doc["dedup"] = bool(out.get("dedup"))
+        return doc
+
+
+# --- jax-free load CLI (the bench client process) -----------------------------
+
+def _load_main(args) -> int:
+    """``load``: submit every payload in a JSON file and wait for all
+    of them — one bench client process.  Emits one JSON line:
+    per-key chi2 bits (the conservation check), retry/dedup counts,
+    and client-observed latency percentiles."""
+    with open(args.payloads, encoding="utf-8") as fh:
+        payloads = json.load(fh)
+    if not isinstance(payloads, list) or not payloads:
+        print(json.dumps({"error": "payloads file must be a "
+                                   "non-empty JSON list"}))
+        return 2
+    cl = GatewayClient(args.url, tenant=args.tenant,
+                       retries=args.retries, backoff_s=args.backoff_s,
+                       jitter_s=args.jitter_s, seed=args.seed)
+    if not cl.wait_ready(timeout_s=args.ready_timeout_s):
+        print(json.dumps({"error": "gateway never became ready",
+                          "url": args.url}))
+        return 2
+    results: Dict[str, Any] = {}
+    lat_ms: List[float] = []
+    errors: Dict[str, int] = {}
+    completed = dedup = 0
+    for i in range(args.jobs):
+        payload = payloads[i % len(payloads)]
+        key = f"{args.key_prefix}-{i}"
+        t0 = time.monotonic()
+        try:
+            doc = cl.submit_and_wait(
+                payload, priority=args.priority,
+                deadline_ms=args.deadline_ms or None, idem_key=key,
+                timeout_s=args.timeout_s)
+        except Exception as e:
+            errors[type(e).__name__] = errors.get(
+                type(e).__name__, 0) + 1
+            results[key] = {"error": type(e).__name__}
+            continue
+        lat_ms.append((time.monotonic() - t0) * 1e3)
+        err = doc.get("error")
+        if err:
+            name = err.get("type") if isinstance(err, dict) else str(err)
+            errors[name] = errors.get(name, 0) + 1
+            results[key] = {"error": name}
+            continue
+        r = doc.get("result") or {}
+        completed += 1
+        dedup += 1 if doc.get("dedup") else 0
+        results[key] = {"chi2_hex": r.get("chi2_hex"),
+                        "name": r.get("name"),
+                        "dedup": bool(doc.get("dedup"))}
+        if args.think_ms:
+            time.sleep(args.think_ms / 1e3)
+    print(json.dumps({
+        "mode": "client_load", "tenant": args.tenant,
+        "priority": args.priority, "jobs": args.jobs,
+        "completed": completed, "errors": errors,
+        "retries": cl.stats["retries"],
+        "reconnects": cl.stats["reconnects"], "dedup_hits": dedup,
+        "p50_ms": _pct(lat_ms, 0.50), "p99_ms": _pct(lat_ms, 0.99),
+        "results": results}))
+    return 0 if completed == args.jobs else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="pint_tpu/client.py",
+        description="resilient gateway client (stdlib-only; safe to "
+                    "run as a plain script — no jax import)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ld = sub.add_parser("load", help="submit a payload corpus and "
+                                     "wait; one JSON summary line")
+    ld.add_argument("--url", required=True)
+    ld.add_argument("--payloads", required=True,
+                    help="JSON file: list of wire payloads")
+    ld.add_argument("--jobs", type=int, default=8)
+    ld.add_argument("--tenant", default="default")
+    ld.add_argument("--priority", default="normal",
+                    choices=("high", "normal", "low"))
+    ld.add_argument("--key-prefix", default=f"load{os.getpid()}")
+    ld.add_argument("--deadline-ms", type=float, default=0.0)
+    ld.add_argument("--think-ms", type=float, default=0.0)
+    ld.add_argument("--retries", type=int, default=None)
+    ld.add_argument("--backoff-s", type=float, default=None)
+    ld.add_argument("--jitter-s", type=float, default=None)
+    ld.add_argument("--seed", type=int, default=None)
+    ld.add_argument("--timeout-s", type=float, default=240.0)
+    ld.add_argument("--ready-timeout-s", type=float, default=60.0)
+    args = ap.parse_args(argv)
+    return _load_main(args)
+
+
+if __name__ == "__main__":
+    # NO canonical-module re-import here (the serve/gateway idiom):
+    # that would import the pint_tpu package — and with it jax — in
+    # every bench client process.  This module is self-contained.
+    import sys
+
+    sys.exit(main())

@@ -210,8 +210,9 @@ class ServePoisoned(ServeError):
 
 class ServeDeadlineExceeded(ServeError):
     """The job's deadline expired while it was still queued, before its
-    bucket was staged for dispatch — deadlines are only checked at
-    admission and batch-take time, never mid-dispatch, so an expired
+    bucket was staged for dispatch — deadlines are checked at
+    admission, at batch-take time, and once more at pre-staging (the
+    take-to-stage scheduler gap), never mid-dispatch, so an expired
     job costs zero device work.
 
     Attributes: ``deadline_s`` (the relative deadline the job was
@@ -244,6 +245,49 @@ class ServeCancelled(ServeError):
     """The job was cancelled via ``ServeFuture.cancel()`` while still
     queued (cancellation is only possible before staging; an in-flight
     job cannot be cancelled)."""
+
+
+class GatewayError(PintTpuError):
+    """Base for network front-door (``pint_tpu.gateway``) failures."""
+
+
+class GatewayBadRequest(GatewayError):
+    """A submitted job payload could not be decoded into a (model,
+    TOAs) pair — malformed JSON, a missing column, or a par file the
+    model builder rejects.  Maps to HTTP 400: the request is wrong,
+    retrying it unchanged cannot succeed."""
+
+
+class GatewayQuotaExceeded(GatewayError):
+    """The tenant's token bucket cannot cover this request at its
+    priority class — over-quota admission control, not queue pressure.
+    Maps to HTTP 429 with a Retry-After hint; the request was never
+    handed to the timing service, so retrying after the hint is safe.
+
+    Attributes: ``tenant``, ``priority``, ``retry_after_s``."""
+
+    def __init__(self, msg="", tenant=None, priority=None,
+                 retry_after_s=None):
+        self.tenant = tenant
+        self.priority = priority
+        self.retry_after_s = retry_after_s
+        super().__init__(msg)
+
+
+class GatewayIdempotencyConflict(GatewayError):
+    """An idempotency key was replayed with a DIFFERENT payload than
+    the one it originally admitted (payload CRCs disagree).  Maps to
+    HTTP 409: honoring the replay would silently fit the wrong data
+    under the original job id.
+
+    Attributes: ``key``, ``expected_crc``, ``got_crc``."""
+
+    def __init__(self, msg="", key=None, expected_crc=None,
+                 got_crc=None):
+        self.key = key
+        self.expected_crc = expected_crc
+        self.got_crc = got_crc
+        super().__init__(msg)
 
 
 class MultihostTimeoutError(PintTpuError):

@@ -56,7 +56,9 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "stale_aot_version", "request_flood", "stalled_bucket",
            "recorder_crash", "nan_gwb_draw", "corrupt_sim_chunk",
            "poison_batch_member", "oom_dispatch", "slow_dispatch",
-           "silent_result_bias", "kill_daemon", "main"]
+           "silent_result_bias", "kill_daemon",
+           "gateway_drop_connection", "gateway_slow_response",
+           "tenant_flood", "main"]
 
 #: active registry failpoints: name -> wrapper factory ``fn -> fn'``
 _active: dict = {}
@@ -706,8 +708,9 @@ def _slow_dispatch_factory(fn):
     """Stall every bucket dispatch by PINT_TPU_SLOW_DISPATCH_S seconds
     (default 0.2) — the wedged-interconnect latency shape.  Queued jobs
     with deadlines must expire with typed ``ServeDeadlineExceeded`` at
-    batch-take time (never mid-dispatch), and jobs without deadlines
-    must still complete bit-identically."""
+    batch-take time or at the pre-staging re-check (never
+    mid-dispatch), and jobs without deadlines must still complete
+    bit-identically."""
     def slow(*args, **kwargs):
         import os
         import time as _time
@@ -787,6 +790,95 @@ def kill_daemon() -> Iterator[None]:
         yield
 
 
+#: idempotency keys whose admission response was already dropped —
+#: MODULE state, not factory state: ``wrap`` invokes the factory on
+#: every call, so once-per-key memory must live here
+_GW_DROPPED_KEYS: set = set()
+
+
+def _gateway_drop_connection_factory(fn):
+    """Sever the gateway's HTTP connection after a successful
+    admission, ONCE per idempotency key: the job is admitted (and its
+    journal ``accept`` record written) but the 202 response is lost —
+    the classic retry-ambiguity fault.  The client's idempotent retry
+    must map back to the same job id with NO second fit; the sweep's
+    gateway negative-control leg asserts exactly that
+    (``fits == accepted`` and ``dedup_hits >= 1``)."""
+    def drop(key):
+        if key and key not in _GW_DROPPED_KEYS:
+            _GW_DROPPED_KEYS.add(key)
+            return True
+        return fn(key)
+    return drop
+
+
+@contextlib.contextmanager
+def gateway_drop_connection() -> Iterator[None]:
+    """Failpoint ``"gateway_drop_connection"``: the gateway drops the
+    socket instead of answering the first POST per idempotency key
+    (see the ``pint_tpu.gateway`` request handler).  Env-activatable
+    (``PINT_TPU_FAULTS=gateway_drop_connection``)."""
+    _GW_DROPPED_KEYS.clear()
+    with _registered("gateway_drop_connection",
+                     _gateway_drop_connection_factory):
+        try:
+            yield
+        finally:
+            _GW_DROPPED_KEYS.clear()
+
+
+def _gateway_slow_response_factory(fn):
+    """Stall every gateway HTTP response by PINT_TPU_GATEWAY_SLOW_S
+    seconds (default 0.2) — slow-network shape on the front door.
+    Clients must absorb it with their request timeout / retry budget;
+    no job may error or double-fit."""
+    def slow(*args, **kwargs):
+        import os
+        import time as _time
+
+        _time.sleep(float(os.environ.get("PINT_TPU_GATEWAY_SLOW_S",
+                                         "0.2")))
+        return fn(*args, **kwargs)
+    return slow
+
+
+@contextlib.contextmanager
+def gateway_slow_response() -> Iterator[None]:
+    """Failpoint ``"gateway_slow_response"``: every gateway request
+    handler sleeps before answering (see ``pint_tpu.gateway``).
+    Env-activatable (``PINT_TPU_FAULTS=gateway_slow_response``; tune
+    with ``PINT_TPU_GATEWAY_SLOW_S``)."""
+    with _registered("gateway_slow_response",
+                     _gateway_slow_response_factory):
+        yield
+
+
+def _tenant_flood_factory(fn):
+    """Turn on the noisy-neighbour burst in ``gateway check``: the
+    wrapped probe returns PINT_TPU_FLOOD_N (default 24) instead of 0,
+    and the check floods that many low-priority requests from a
+    second ``flood`` tenant with no retries.  The sweep asserts the
+    flood is 429-rejected by its own token bucket while the primary
+    tenant's jobs complete with baseline-identical chi2 bits and
+    bounded p99."""
+    def flood(*args, **kwargs):
+        import os
+
+        return int(os.environ.get("PINT_TPU_FLOOD_N", "24"))
+    return flood
+
+
+@contextlib.contextmanager
+def tenant_flood() -> Iterator[None]:
+    """Failpoint ``"tenant_flood"``: ``gateway check`` adds an
+    over-quota burst from a second tenant (see
+    ``pint_tpu.gateway._check``).  Env-activatable
+    (``PINT_TPU_FAULTS=tenant_flood``; tune with
+    ``PINT_TPU_FLOOD_N``)."""
+    with _registered("tenant_flood", _tenant_flood_factory):
+        yield
+
+
 #: failpoints activatable across a process boundary via the
 #: PINT_TPU_FAULTS env var (comma-separated names; process-lifetime,
 #: no context manager to exit) — the bench/CLI-subprocess test leg
@@ -807,6 +899,9 @@ _ENV_FACTORIES = {
     "slow_dispatch": _slow_dispatch_factory,
     "silent_result_bias": _silent_result_bias_factory,
     "kill_daemon": _kill_daemon_factory,
+    "gateway_drop_connection": _gateway_drop_connection_factory,
+    "gateway_slow_response": _gateway_slow_response_factory,
+    "tenant_flood": _tenant_flood_factory,
 }
 
 
@@ -874,6 +969,14 @@ def corrupt_mjds(toas, rows: Sequence[int]) -> Iterator[None]:
 _SWEEP_FAULTS = ("request_flood", "stalled_bucket", "recorder_crash",
                  "poison_batch_member", "oom_dispatch", "slow_dispatch")
 
+#: the network-boundary failpoints the sweep drives against ``gateway
+#: check`` (ISSUE 19): a dropped admission response recovered by an
+#: idempotent retry, a slow front door, and a noisy-neighbour flood —
+#: each must contain to typed rejections/retries, never a duplicate or
+#: silently-wrong fit
+_SWEEP_GATEWAY_FAULTS = ("gateway_drop_connection",
+                         "gateway_slow_response", "tenant_flood")
+
 
 def _sweep_run_leg(faults, args):
     """One ``serve check`` subprocess under PINT_TPU_FAULTS=<faults>.
@@ -901,6 +1004,85 @@ def _sweep_run_leg(faults, args):
         except ValueError:
             continue
     return p.returncode, doc, p.stderr
+
+
+def _sweep_run_gateway_leg(faults, args):
+    """One ``gateway check`` subprocess under PINT_TPU_FAULTS=<faults>
+    — the network-boundary counterpart of :func:`_sweep_run_leg`.
+    Returns (rc, parsed JSON line or None, stderr)."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PINT_TPU_TELEMETRY_DUMP", None)
+    env["PINT_TPU_FAULTS"] = ",".join(faults)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "pint_tpu.gateway", "check",
+           "--jobs", str(args.jobs), "--wait-ms", str(args.wait_ms),
+           "--seed", str(args.seed)]
+    p = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=args.timeout_s, env=env)
+    doc = None
+    for ln in reversed(p.stdout.strip().splitlines()):
+        try:
+            doc = _json.loads(ln)
+            break
+        except ValueError:
+            continue
+    return p.returncode, doc, p.stderr
+
+
+def _sweep_expect_gateway_single(fault, doc, base):
+    """Per-fault containment stories at the network boundary (gateway
+    single-fault legs; ``base`` is the gateway baseline leg's doc)."""
+    problems = []
+    if fault == "gateway_drop_connection":
+        # the ISSUE 19 negative control: every first admission response
+        # is dropped, every client retries with its idempotency key —
+        # exactly-once admission and ZERO duplicate fits, proven by the
+        # dedup counter and fits == accepted
+        if doc.get("completed") != doc.get("jobs"):
+            problems.append(
+                f"[{fault}] every job must complete through the "
+                f"idempotent retry, got "
+                f"completed={doc.get('completed')}/{doc.get('jobs')}")
+        if not doc.get("dedup_hits"):
+            problems.append(
+                f"[{fault}] dropped responses must be recovered by "
+                f"dedup replay (dedup_hits=0)")
+        if doc.get("fits") != doc.get("accepted"):
+            problems.append(
+                f"[{fault}] DUPLICATE FIT: fits={doc.get('fits')} != "
+                f"accepted={doc.get('accepted')}")
+    elif fault == "gateway_slow_response":
+        if doc.get("completed") != doc.get("jobs"):
+            problems.append(
+                f"[{fault}] a slow front door must be absorbed by the "
+                f"client budget, got "
+                f"completed={doc.get('completed')}/{doc.get('jobs')}")
+    elif fault == "tenant_flood":
+        flood = doc.get("flood") or {}
+        codes = flood.get("codes") or {}
+        if not codes.get("429"):
+            problems.append(
+                f"[{fault}] the over-quota tenant must see 429 "
+                f"rejections, got codes={codes}")
+        if doc.get("completed") != doc.get("jobs"):
+            problems.append(
+                f"[{fault}] the in-quota tenant must be unaffected, "
+                f"got completed={doc.get('completed')}/"
+                f"{doc.get('jobs')}")
+        p99, base_p99 = doc.get("p99_ms"), (base or {}).get("p99_ms")
+        if p99 is not None and base_p99 is not None \
+                and p99 > 2.0 * base_p99 + 100.0:
+            # 2x the unloaded figure (+100 ms scheduler-noise floor on
+            # starved CI hosts): isolation, not merely completion
+            problems.append(
+                f"[{fault}] in-quota p99 {p99:.1f} ms exceeds 2x the "
+                f"unloaded baseline {base_p99:.1f} ms")
+    return problems
 
 
 def _sweep_judge(leg, faults, rc, doc, stderr, base_by_name):
@@ -991,10 +1173,13 @@ def main(argv=None) -> int:
     """``python -m pint_tpu.faultinject sweep``: seeded randomized
     chaos scheduler over the env-activatable serve failpoints.  Drives
     one clean baseline ``serve check`` leg, one leg per fault, and
-    ``--pairs`` seeded fault pairs, and enforces the blast-radius
-    invariant on every leg: a failure is a typed error or a loud
-    degradation, NEVER a silent wrong answer.  Exits 0 when the
-    invariant holds everywhere, 1 with per-leg attribution otherwise."""
+    ``--pairs`` seeded fault pairs, then (unless ``--no-gateway``) a
+    ``gateway check`` baseline plus one leg per network-boundary
+    failpoint, and enforces the blast-radius invariant on every leg: a
+    failure is a typed error or a loud degradation, NEVER a silent
+    wrong answer (and at the gateway, NEVER a duplicate fit).  Exits 0
+    when the invariant holds everywhere, 1 with per-leg attribution
+    otherwise."""
     import argparse
     import itertools
     import json as _json
@@ -1022,6 +1207,9 @@ def main(argv=None) -> int:
                          "legs (e.g. the silent_result_bias negative "
                          "control)")
     sw.add_argument("--timeout-s", type=float, default=240.0)
+    sw.add_argument("--no-gateway", action="store_true",
+                    help="skip the network-boundary legs (gateway "
+                         "baseline + gateway failpoint singles)")
     args = ap.parse_args(argv)
 
     rng = random.Random(args.seed)
@@ -1077,12 +1265,57 @@ def main(argv=None) -> int:
             "completed": None if doc is None else doc.get("completed"),
             "rejected": None if doc is None else doc.get("rejected")})
 
+    # network-boundary legs (ISSUE 19): gateway baseline + one leg per
+    # gateway failpoint, judged by the same global invariant against
+    # the GATEWAY baseline's chi2 bits, plus per-fault stories
+    # (idempotent-retry-no-duplicate-fit, bounded-p99 flood isolation)
+    gw_base = None
+    gw_base_by_name = {}
+    gw_legs = [] if args.no_gateway \
+        else [()] + [(f,) for f in _SWEEP_GATEWAY_FAULTS]
+    for faults in gw_legs:
+        leg = "gw:" + ("+".join(faults) or "baseline")
+        print(f"sweep: leg {leg} ...", file=sys.stderr)
+        try:
+            rc, doc, err = _sweep_run_gateway_leg(faults, args)
+        except Exception as exc:
+            problems.append(f"[{leg}] leg did not finish: {exc}")
+            summaries.append({"leg": leg, "rc": None})
+            continue
+        if not faults:
+            if doc is None or rc != 0:
+                problems.append(
+                    f"[{leg}] gateway baseline failed (rc={rc})")
+            else:
+                gw_base = doc
+                for key, ent in (doc.get("results") or {}).items():
+                    if ent.get("flagged") or "chi2_hex" not in ent:
+                        continue
+                    name = key.split(":", 1)[-1]
+                    prev = gw_base_by_name.setdefault(
+                        name, ent["chi2_hex"])
+                    if prev != ent["chi2_hex"]:
+                        problems.append(
+                            f"[{leg}] {name} not deterministic across "
+                            f"resubmission: {prev} != "
+                            f"{ent['chi2_hex']}")
+        else:
+            problems += _sweep_judge(leg, faults, rc, doc, err,
+                                     gw_base_by_name)
+            if doc is not None:
+                problems += _sweep_expect_gateway_single(
+                    faults[0], doc, gw_base)
+        summaries.append({
+            "leg": leg, "rc": rc,
+            "completed": None if doc is None else doc.get("completed"),
+            "rejected": None if doc is None else doc.get("rejected")})
+
     ok = not problems
     for p in problems:
         print(f"sweep: FAIL {p}", file=sys.stderr)
     print(_json.dumps({"mode": "sweep", "seed": args.seed,
                        "jobs": args.jobs, "legs": summaries,
-                       "n_legs": len(legs), "ok": ok,
+                       "n_legs": len(summaries), "ok": ok,
                        "problems": problems}))
     return 0 if ok else 1
 

@@ -1,0 +1,1282 @@
+"""pint_tpu.gateway — the fault-tolerant network front door (ISSUE 19).
+
+An HTTP boundary in front of :class:`pint_tpu.serve.TimingService`,
+extending the metrics ``Exporter`` pattern (ISSUE 11/13) from scraping
+to submission: ``POST /v1/jobs`` admits a serialized (model, TOAs) job
+and returns a job id, ``GET /v1/jobs/<id>`` returns its status/result,
+``GET /healthz`` and ``GET /metrics`` ride along.  Three robustness
+layers make the boundary survivable rather than merely present:
+
+* **Multi-tenant admission** — every tenant owns a token bucket
+  (capacity ``PINT_TPU_GATEWAY_QUOTA``, refilled over
+  ``PINT_TPU_GATEWAY_QUOTA_WINDOW_S``); priority classes reserve
+  headroom (``high`` admits down to the last token, ``normal`` needs a
+  quarter of the bucket free, ``low`` half), so an over-quota tenant
+  gets a typed 429 with a Retry-After hint and can never stall the
+  queue for its neighbours.  Queue saturation from the service itself
+  (``ServeSaturated``) maps to 503 — backpressure, never a hang.
+* **Deadline propagation** — a client ``X-Deadline-Ms`` header becomes
+  the PR 18 per-request deadline: checked at admission (expired →
+  504 before the job costs anything), enforced in-queue by
+  ``TimingService._expire_locked``, and re-checked at pre-staging so
+  work that expired behind a slow dispatch is shed before it costs a
+  device program (the ISSUE 19 deadline edge fix in
+  ``TimingService._dispatch_inner``).
+* **Idempotency keys** — a retried ``POST`` carrying the same
+  ``X-Idempotency-Key`` returns the original job id/result instead of
+  re-fitting, backed by a CRC-verified append-only dedup journal
+  (``PINT_TPU_GATEWAY_JOURNAL``) that survives a daemon restart:
+  resolved keys replay their recorded result with zero device work,
+  accepted-but-unresolved keys re-admit under their original job id,
+  so across a ``gateway supervise`` restart every accepted job
+  resolves exactly once.
+
+Trace ids ride an ``X-Trace-Id`` header end to end.  The CLI mirrors
+``pint_tpu.serve``: ``check`` (self-contained loopback exercise — the
+chaos-sweep leg), ``serve`` (long-running daemon for multi-process
+clients), and ``supervise`` (restarting wrapper over ``serve``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import queue
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pint_tpu import faultinject, metrics, profiling, runtime, telemetry
+from pint_tpu.exceptions import (GatewayBadRequest, GatewayError,
+                                 GatewayIdempotencyConflict,
+                                 GatewayQuotaExceeded,
+                                 ServeDeadlineExceeded, ServeDrained,
+                                 ServeOverCapacity, ServeSaturated)
+from pint_tpu.logging import child as _logchild
+
+_log = _logchild("gateway")
+
+__all__ = ["Gateway", "TokenBucket", "DedupJournal", "serialize_job",
+           "deserialize_job", "payload_crc", "PRIORITIES", "main"]
+
+#: admission classes, strongest first; the per-class bucket thresholds
+#: reserve headroom so high-priority traffic survives a tenant's own
+#: bulk load (fractions of the bucket that must be AVAILABLE to admit)
+PRIORITIES = ("high", "normal", "low")
+_PRIORITY_RESERVE = {"high": 0.0, "normal": 0.25, "low": 0.5}
+
+_JOURNAL_SIG = "pint_tpu.gateway journal v1"
+
+#: gateway-side bound on how long a resolver waits on one future —
+#: generous (cold compiles on 1 CPU take tens of seconds), but finite
+#: so a wedged future cannot park the resolver forever
+_RESOLVE_TIMEOUT_S = 600.0
+
+
+# --- job serialization --------------------------------------------------------
+
+def serialize_job(model, toas, name: Optional[str] = None) -> dict:
+    """A (model, TOAs) pair as a JSON-safe wire payload: the par file
+    text plus the TOA columns.  Floats ride as JSON numbers — Python's
+    ``repr`` float round-trip is bit-exact, so a payload deserializes
+    into the same staged arrays (same ``PreparedJob.crc``) on every
+    replay, which is what makes idempotent retries and the args-LRU
+    device-traffic neutrality provable rather than probabilistic."""
+    if name is None:
+        name = getattr(getattr(model, "PSR", None), "value", None) \
+            or "JOB"
+    info = {k: v for k, v in toas.clock_corr_info.items()
+            if isinstance(v, (str, int, float, bool))}
+    return {
+        "name": str(name),
+        "par": model.as_parfile(),
+        "toas": {
+            "day": [int(d) for d in np.asarray(toas.utc.day)],
+            "frac": [float(f) for f in np.asarray(toas.utc.frac)],
+            "error_us": [float(e) for e in np.asarray(toas.error_us)],
+            "freq_mhz": [float(f) for f in np.asarray(toas.freq_mhz)],
+            "obs": [str(o) for o in np.asarray(toas.obs)],
+            "flags": [dict(f) for f in toas.flags],
+            "ephem": toas.ephem or "DE421",
+            "planets": bool(toas.planets),
+            "clock_corr_info": info,
+        },
+    }
+
+
+def deserialize_job(doc: dict):
+    """Wire payload -> ``(model, toas, name)``; raises typed
+    :class:`GatewayBadRequest` on anything malformed.  TDBs and
+    posvels are re-derived deterministically from the UTC columns (the
+    clock corrections already applied client-side ride the ``clkcorr``
+    flags, whose presence makes ``apply_clock_corrections``
+    idempotent)."""
+    from pint_tpu.mjd import MJD
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import TOAs
+
+    try:
+        name = str(doc["name"])
+        par = doc["par"]
+        t = doc["toas"]
+        day = np.asarray(t["day"], np.int64)
+        frac = np.asarray(t["frac"], np.float64)
+        model = get_model(str(par).strip().splitlines())
+        toas = TOAs.from_columns(
+            MJD(day, frac),
+            np.asarray(t["error_us"], np.float64),
+            np.asarray(t["freq_mhz"], np.float64),
+            np.asarray([str(o) for o in t["obs"]]),
+            flags=[dict(f) for f in t["flags"]])
+        ephem = str(t.get("ephem") or "DE421")
+        planets = bool(t.get("planets", False))
+        toas.ephem = ephem
+        toas.planets = planets
+        toas.clock_corr_info.update(t.get("clock_corr_info") or {})
+        toas.compute_TDBs(ephem=ephem)
+        toas.compute_posvels(ephem=ephem, planets=planets)
+    except GatewayError:
+        raise
+    except Exception as e:
+        raise GatewayBadRequest(
+            f"undecodable job payload ({type(e).__name__}: {e})") from e
+    return model, toas, name
+
+
+def payload_crc(doc: dict) -> str:
+    """CRC32 (8 hex) over the canonical JSON payload — the idempotency
+    conflict check: one key, one payload."""
+    blob = json.dumps(doc, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return f"{zlib.crc32(blob) & 0xFFFFFFFF:08x}"
+
+
+# --- per-tenant admission -----------------------------------------------------
+
+class TokenBucket:
+    """One tenant's admission budget: ``capacity`` tokens refilled
+    linearly over ``window_s``.  A request admits only when the bucket
+    holds at least its priority class's reserve ON TOP of the token it
+    consumes — so ``low`` traffic starves first and ``high`` admits
+    down to the last token.  Over-quota returns a Retry-After hint
+    (seconds until the class can admit), never a wait."""
+
+    __slots__ = ("capacity", "rate", "tokens", "_t", "_lock")
+
+    def __init__(self, capacity: float, window_s: float = 1.0):
+        self.capacity = max(float(capacity), 1.0)
+        self.rate = self.capacity / max(float(window_s), 1e-6)
+        self.tokens = self.capacity
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _need(self, priority: str) -> float:
+        reserve = _PRIORITY_RESERVE.get(priority, 0.25) * self.capacity
+        return min(1.0 + reserve, self.capacity)
+
+    def admit(self, priority: str):
+        """-> ``(admitted, retry_after_s)``; consumes one token on
+        admission."""
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(self.capacity,
+                              self.tokens + (now - self._t) * self.rate)
+            self._t = now
+            need = self._need(priority)
+            if self.tokens >= need:
+                self.tokens -= 1.0
+                return True, 0.0
+            return False, max((need - self.tokens) / self.rate, 0.05)
+
+
+# --- CRC-verified dedup journal ----------------------------------------------
+
+class DedupJournal:
+    """Append-only JSONL idempotency journal.  Every line is a record
+    ``{"sig", "kind", ..., "crc"}`` where ``crc`` is the CRC32 of the
+    canonical JSON of the record without its ``crc`` field — the same
+    self-verifying envelope discipline as the serve spool and the
+    telemetry dumps.  The loader SKIPS corrupt lines (counted, never
+    trusted): a torn tail from a crash mid-append costs one record,
+    not the journal.
+
+    Record kinds: ``accept`` (key -> job id + payload, written at
+    admission) and ``resolve`` (key -> result or typed error, written
+    when the future settles).  Together they give restart-surviving
+    exactly-once semantics: a resolved key replays its result with
+    zero device work; an accepted-but-unresolved key re-admits under
+    its original job id."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.skipped = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _crc(rec: dict) -> str:
+        blob = json.dumps(rec, sort_keys=True,
+                          separators=(",", ":")).encode("utf-8")
+        return f"{zlib.crc32(blob) & 0xFFFFFFFF:08x}"
+
+    def append(self, rec: dict) -> None:
+        rec = dict(rec, sig=_JOURNAL_SIG)
+        rec["crc"] = self._crc(rec)
+        line = json.dumps(rec, sort_keys=True,
+                          separators=(",", ":")) + "\n"
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(line)
+                fh.flush()
+                os.fsync(fh.fileno())
+
+    def load(self) -> Dict[str, dict]:
+        """-> ``{key: {"job_id", "payload_crc", "tenant", "priority",
+        "payload", "result", "error"}}`` merged from the verified
+        records; corrupt/foreign lines counted in ``self.skipped``."""
+        state: Dict[str, dict] = {}
+        self.skipped = 0
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                lines = fh.readlines()
+        except OSError:
+            return state
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                self.skipped += 1
+                continue
+            if not isinstance(rec, dict) \
+                    or rec.get("sig") != _JOURNAL_SIG:
+                self.skipped += 1
+                continue
+            want = rec.pop("crc", None)
+            if want != self._crc(rec):
+                self.skipped += 1
+                continue
+            key = rec.get("key")
+            if not key:
+                self.skipped += 1
+                continue
+            ent = state.setdefault(key, {
+                "job_id": None, "payload_crc": None, "tenant": None,
+                "priority": None, "payload": None, "result": None,
+                "error": None})
+            if rec.get("kind") == "accept":
+                ent.update(job_id=rec.get("job_id"),
+                           payload_crc=rec.get("payload_crc"),
+                           tenant=rec.get("tenant"),
+                           priority=rec.get("priority"),
+                           payload=rec.get("payload"))
+            elif rec.get("kind") == "resolve":
+                ent["job_id"] = rec.get("job_id", ent["job_id"])
+                ent["result"] = rec.get("result")
+                ent["error"] = rec.get("error")
+            else:
+                self.skipped += 1
+        return state
+
+
+# --- the gateway --------------------------------------------------------------
+
+def _result_doc(r) -> dict:
+    """A ``ServeResult`` as a JSON-safe document.  ``chi2_hex`` is the
+    bit-exact ``float.hex()`` the chaos-sweep judge and the
+    kill-midflight conservation legs compare."""
+    return {"name": r.name, "chi2": float(r.chi2),
+            "chi2_hex": float(r.chi2).hex(), "dof": int(r.dof),
+            "status": r.status.name, "iterations": int(r.iterations),
+            "x": [float(v) for v in np.asarray(r.x)],
+            "fit_names": list(r.fit_names), "rung": r.rung,
+            "ok": bool(r.ok)}
+
+
+_TENANT_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_-")
+
+
+class Gateway:
+    """The network front door over one :class:`TimingService`.
+
+    Owns the HTTP server, the per-tenant token buckets, the job table,
+    the payload-keyed prepared-job LRU (a replayed payload reuses the
+    SAME ``PreparedJob`` — same uid — so the serve args-LRU hits and
+    the gateway adds zero per-job device traffic on steady state), and
+    the dedup journal."""
+
+    def __init__(self, service, *, quota: Optional[float] = None,
+                 window_s: Optional[float] = None,
+                 journal: Optional[str] = None,
+                 prepared_cache_size: int = 256):
+        if quota is None:
+            quota = float(os.environ.get("PINT_TPU_GATEWAY_QUOTA",
+                                         "8") or 8)
+        if window_s is None:
+            window_s = float(os.environ.get(
+                "PINT_TPU_GATEWAY_QUOTA_WINDOW_S", "1.0") or 1.0)
+        self.service = service
+        self.quota = float(quota)
+        self.window_s = float(window_s)
+        journal = journal if journal is not None \
+            else (os.environ.get("PINT_TPU_GATEWAY_JOURNAL") or None)
+        self.journal = DedupJournal(journal) if journal else None
+        self._journal_state = self.journal.load() if self.journal \
+            else {}
+        self._tenants: Dict[str, TokenBucket] = {}
+        self._jobs: Dict[str, dict] = {}
+        self._by_key: Dict[str, str] = {}
+        self._prepared: "Dict[str, object]" = {}
+        self._prepared_order: List[str] = []
+        self._prepared_cap = int(prepared_cache_size)
+        self._lock = threading.Lock()
+        # start the id sequence PAST every id the journal still maps:
+        # a restarted daemon must never hand a journaled job's id to a
+        # fresh admission (a client polling across the restart would
+        # silently read the wrong job)
+        seq0 = 1
+        for ent in self._journal_state.values():
+            jid = ent.get("job_id") or ""
+            if jid.startswith("J") and jid[1:].isdigit():
+                seq0 = max(seq0, int(jid[1:]) + 1)
+        self._seq = itertools.count(seq0)
+        self._stats = {
+            "accepted": 0, "completed": 0, "errors": 0, "fits": 0,
+            "dedup_hits": 0, "journal_hits": 0, "journal_resumed": 0,
+            "dropped_responses": 0, "requests_total": 0,
+        }
+        self._codes: Dict[str, Dict[str, int]] = {}
+        self._lat: Dict[str, List[float]] = {}
+        self._depth = {p: 0 for p in PRIORITIES}
+        self._resolveq: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._resolver: Optional[threading.Thread] = None
+        self._server = None
+        self._thread = None
+        self.port: Optional[int] = None
+        self.last_activity = time.monotonic()
+
+    # -- admission (HTTP-free core, driven by the handler) -----------------
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        b = self._tenants.get(tenant)
+        if b is None:
+            b = self._tenants[tenant] = TokenBucket(self.quota,
+                                                   self.window_s)
+        return b
+
+    def _prepare_cached(self, payload: dict, crc: str):
+        """payload-CRC-keyed PreparedJob LRU: one prepare per distinct
+        payload, and — because the serve args-LRU keys on job uid — one
+        h2d staging per distinct batch composition, no matter how many
+        times the payload is POSTed."""
+        with self._lock:
+            job = self._prepared.get(crc)
+            if job is not None:
+                return job
+        model, toas, name = deserialize_job(payload)
+        job = self.service.prepare(model, toas, name=name)
+        with self._lock:
+            got = self._prepared.get(crc)
+            if got is not None:
+                return got
+            self._prepared[crc] = job
+            self._prepared_order.append(crc)
+            while len(self._prepared_order) > self._prepared_cap:
+                old = self._prepared_order.pop(0)
+                self._prepared.pop(old, None)
+        return job
+
+    def submit(self, payload: dict, *, tenant: str = "default",
+               priority: str = "normal",
+               deadline_s: Optional[float] = None,
+               idem_key: Optional[str] = None,
+               trace_id: Optional[str] = None) -> dict:
+        """Admit one job; returns ``{"job_id", "trace_id", "dedup"}``.
+        Raises the typed gateway/serve errors the HTTP layer maps to
+        status codes (429/409/400/503/504)."""
+        self._stats["requests_total"] += 1
+        crc = payload_crc(payload)
+        if idem_key:
+            hit = self._dedup_lookup(idem_key, crc)
+            if hit is not None:
+                profiling.count(f"gateway.request.{tenant}.202")
+                return hit
+        ok, retry_after = self._bucket(tenant).admit(priority)
+        if not ok:
+            raise GatewayQuotaExceeded(
+                f"tenant {tenant!r} over quota for priority "
+                f"{priority!r}; retry after {retry_after:.2f} s",
+                tenant=tenant, priority=priority,
+                retry_after_s=retry_after)
+        if deadline_s is not None and deadline_s <= 0.0:
+            # propagated deadline already expired at admission: shed
+            # before the payload is even decoded
+            raise ServeDeadlineExceeded(
+                f"deadline expired at gateway admission "
+                f"({deadline_s:.3f} s remaining)",
+                deadline_s=deadline_s, waited_s=0.0)
+        job = self._prepare_cached(payload, crc)
+        job_id = f"J{next(self._seq):06d}"
+        trace_id = trace_id or telemetry.new_trace_id()
+        fut = self.service.submit_prepared(job, deadline_s=deadline_s)
+        rec = {"job_id": job_id, "name": job.name, "tenant": tenant,
+               "priority": priority, "key": idem_key,
+               "payload_crc": crc, "trace_id": trace_id,
+               "state": "queued", "result": None, "error": None,
+               "submitted_at": time.monotonic(), "resolved_at": None,
+               "_future": fut}
+        with self._lock:
+            self._jobs[job_id] = rec
+            if idem_key:
+                self._by_key[idem_key] = job_id
+            self._stats["accepted"] += 1
+            self._depth[priority] = self._depth.get(priority, 0) + 1
+        profiling.count(f"gateway.queue_depth.{priority}")
+        if self.journal is not None and idem_key:
+            self.journal.append({
+                "kind": "accept", "key": idem_key, "job_id": job_id,
+                "payload_crc": crc, "tenant": tenant,
+                "priority": priority, "payload": payload})
+        telemetry.event("gateway.admit", job_id=job_id, tenant=tenant,
+                        priority=priority, trace_id=trace_id)
+        self._resolveq.put(job_id)
+        self._ensure_resolver()
+        return {"job_id": job_id, "trace_id": trace_id, "dedup": False}
+
+    def _dedup_lookup(self, key: str, crc: str) -> Optional[dict]:
+        """Idempotent replay: same key -> original job id (and its
+        result, when resolved) with zero quota cost and zero device
+        work.  Same key + different payload is a typed conflict."""
+        with self._lock:
+            job_id = self._by_key.get(key)
+            rec = self._jobs.get(job_id) if job_id else None
+        if rec is not None:
+            # live-table hit (same process)
+            want = rec.get("payload_crc")
+            if want is not None and want != crc:
+                raise GatewayIdempotencyConflict(
+                    f"idempotency key {key!r} replayed with a "
+                    f"different payload", key=key, expected_crc=want,
+                    got_crc=crc)
+            with self._lock:
+                self._stats["dedup_hits"] += 1
+            profiling.count("gateway.dedup_hit")
+            return {"job_id": rec["job_id"],
+                    "trace_id": rec["trace_id"], "dedup": True}
+        ent = self._journal_state.get(key)
+        if ent is None:
+            return None
+        if ent.get("payload_crc") is not None \
+                and ent["payload_crc"] != crc:
+            raise GatewayIdempotencyConflict(
+                f"idempotency key {key!r} replayed with a different "
+                f"payload", key=key, expected_crc=ent["payload_crc"],
+                got_crc=crc)
+        with self._lock:
+            self._stats["dedup_hits"] += 1
+        profiling.count("gateway.dedup_hit")
+        if ent.get("result") is not None or ent.get("error"):
+            # resolved in a previous daemon life: replay the journal
+            with self._lock:
+                self._stats["journal_hits"] += 1
+            profiling.count("gateway.journal_hit")
+            return {"job_id": ent["job_id"], "trace_id": None,
+                    "dedup": True}
+        # accepted but never resolved (daemon died first): re-admit
+        # under the ORIGINAL job id — the fit happens exactly once
+        self._readmit(key, ent)
+        return {"job_id": ent["job_id"], "trace_id": None,
+                "dedup": True}
+
+    def _readmit(self, key: str, ent: dict) -> None:
+        if ent.get("payload") is None:
+            raise GatewayBadRequest(
+                f"idempotency key {key!r} has no recorded payload to "
+                f"re-admit")
+        with self._lock:
+            if self._by_key.get(key):
+                return   # raced: another replay already re-admitted
+        job = self._prepare_cached(ent["payload"],
+                                   ent["payload_crc"]
+                                   or payload_crc(ent["payload"]))
+        fut = self.service.submit_prepared(job)
+        priority = ent.get("priority") or "normal"
+        rec = {"job_id": ent["job_id"], "name": job.name,
+               "tenant": ent.get("tenant") or "default",
+               "priority": priority, "key": key,
+               "payload_crc": ent.get("payload_crc"),
+               "trace_id": telemetry.new_trace_id(),
+               "state": "queued", "result": None, "error": None,
+               "submitted_at": time.monotonic(), "resolved_at": None,
+               "_future": fut}
+        with self._lock:
+            self._jobs[ent["job_id"]] = rec
+            self._by_key[key] = ent["job_id"]
+            self._stats["accepted"] += 1
+            self._stats["journal_resumed"] += 1
+            self._depth[priority] = self._depth.get(priority, 0) + 1
+        profiling.count(f"gateway.queue_depth.{priority}")
+        self._resolveq.put(ent["job_id"])
+        self._ensure_resolver()
+
+    def recover(self) -> int:
+        """Re-admit every accepted-but-unresolved journal key (the
+        restarted-daemon half of ``gateway supervise``).  Returns the
+        number of jobs resumed; resolved keys stay journal-served."""
+        n = 0
+        for key, ent in sorted(self._journal_state.items()):
+            if ent.get("result") is not None or ent.get("error"):
+                continue
+            if ent.get("payload") is None:
+                continue
+            try:
+                self._readmit(key, ent)
+                n += 1
+            except (ServeSaturated, ServeOverCapacity) as e:
+                _log.warning("recover: could not re-admit %r (%s)",
+                             key, type(e).__name__)
+        return n
+
+    # -- resolution --------------------------------------------------------
+
+    def _ensure_resolver(self) -> None:
+        with self._lock:
+            if self._resolver is None or not self._resolver.is_alive():
+                self._resolver = threading.Thread(
+                    target=self._resolve_loop,
+                    name="pint-tpu-gateway-resolve", daemon=True)
+                self._resolver.start()
+
+    def _resolve_loop(self) -> None:
+        while True:
+            job_id = self._resolveq.get()
+            if job_id is None:
+                return
+            self._settle(job_id)
+
+    def _settle(self, job_id: str) -> None:
+        with self._lock:
+            rec = self._jobs.get(job_id)
+        if rec is None or rec["state"] != "queued":
+            return
+        fut = rec["_future"]
+        try:
+            r = fut.result(timeout=_RESOLVE_TIMEOUT_S)
+        except Exception as e:
+            err = {"type": type(e).__name__, "message": str(e)}
+            with self._lock:
+                rec["state"] = "error"
+                rec["error"] = err
+                rec["resolved_at"] = time.monotonic()
+                self._stats["errors"] += 1
+                self._depth[rec["priority"]] = \
+                    self._depth.get(rec["priority"], 1) - 1
+            profiling.count(
+                f"gateway.queue_depth.{rec['priority']}", -1)
+            if self.journal is not None and rec["key"]:
+                self.journal.append({"kind": "resolve",
+                                     "key": rec["key"],
+                                     "job_id": job_id, "error": err})
+            return
+        doc = _result_doc(r)
+        with self._lock:
+            rec["state"] = "done"
+            rec["result"] = doc
+            rec["resolved_at"] = time.monotonic()
+            self._stats["completed"] += 1
+            self._stats["fits"] += 1
+            self._depth[rec["priority"]] = \
+                self._depth.get(rec["priority"], 1) - 1
+            self._lat.setdefault(rec["tenant"], []).append(
+                rec["resolved_at"] - rec["submitted_at"])
+        profiling.count(f"gateway.queue_depth.{rec['priority']}", -1)
+        if self.journal is not None and rec["key"]:
+            self.journal.append({"kind": "resolve", "key": rec["key"],
+                                 "job_id": job_id, "result": doc})
+
+    def settle_done(self) -> None:
+        """Synchronously journal every already-resolved future (the
+        SIGTERM path: nothing the service finished may be lost to a
+        racing resolver thread)."""
+        with self._lock:
+            ids = [jid for jid, r in self._jobs.items()
+                   if r["state"] == "queued" and r["_future"].done()]
+        for jid in ids:
+            self._settle(jid)
+
+    def shed_pending(self) -> int:
+        """Reject every still-queued job (restart handoff: their
+        ``accept`` journal records re-admit them in the next daemon
+        life).  Returns the number shed."""
+        with self._lock:
+            recs = [r for r in self._jobs.values()
+                    if r["state"] == "queued"
+                    and not r["_future"].done()]
+        n = 0
+        for rec in recs:
+            if rec["_future"].cancel():
+                n += 1
+        return n
+
+    # -- status / stats ----------------------------------------------------
+
+    def job_status(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._jobs.get(job_id)
+            if rec is not None:
+                out = {"job_id": job_id, "state": rec["state"],
+                       "name": rec["name"], "tenant": rec["tenant"],
+                       "priority": rec["priority"],
+                       "trace_id": rec["trace_id"]}
+                if rec["result"] is not None:
+                    out["result"] = rec["result"]
+                if rec["error"] is not None:
+                    out["error"] = rec["error"]
+                return out
+        # a previous daemon life may have resolved it: serve the journal
+        for key, ent in self._journal_state.items():
+            if ent.get("job_id") == job_id and (
+                    ent.get("result") is not None or ent.get("error")):
+                with self._lock:
+                    self._stats["journal_hits"] += 1
+                profiling.count("gateway.journal_hit")
+                out = {"job_id": job_id, "state": "done"
+                       if ent.get("result") is not None else "error",
+                       "from_journal": True}
+                if ent.get("result") is not None:
+                    out["result"] = ent["result"]
+                if ent.get("error"):
+                    out["error"] = ent["error"]
+                return out
+        return None
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._jobs.values()
+                       if r["state"] == "queued")
+
+    def stats(self) -> dict:
+        with self._lock:
+            s = dict(self._stats)
+            s["queue_depth"] = dict(self._depth)
+            s["codes"] = {t: dict(c) for t, c in self._codes.items()}
+            lat = {t: list(v) for t, v in self._lat.items()}
+            s["pending"] = sum(1 for r in self._jobs.values()
+                               if r["state"] == "queued")
+        s["journal_skipped"] = self.journal.skipped \
+            if self.journal is not None else 0
+        s["tenants"] = {}
+        for t, samples in lat.items():
+            ls = profiling.latency_stats(samples)
+            s["tenants"][t] = {"completed": len(samples),
+                               "p50_ms": ls["p50_ms"],
+                               "p99_ms": ls["p99_ms"]}
+        return s
+
+    def _count_response(self, tenant: str, code: int) -> None:
+        tenant = tenant if tenant and set(tenant) <= _TENANT_OK \
+            else "-"
+        with self._lock:
+            c = self._codes.setdefault(tenant, {})
+            c[str(code)] = c.get(str(code), 0) + 1
+        profiling.count(f"gateway.request.{tenant}.{code}")
+
+    # -- HTTP layer --------------------------------------------------------
+
+    def start(self, port: Optional[int] = None,
+              bind_timeout_s: float = 10.0) -> "Gateway":
+        """Bind and serve.  ``port`` defaults to
+        ``PINT_TPU_GATEWAY_PORT`` (0 = ephemeral; tests read
+        ``gateway.port`` back).  Bind failures retry briefly — a
+        supervised restart can race its predecessor's close — then
+        raise."""
+        import http.server
+
+        if port is None:
+            raw = os.environ.get("PINT_TPU_GATEWAY_PORT", "0").strip()
+            port = int(raw) if raw else 0
+        handler = _make_handler(self)
+        deadline = time.monotonic() + bind_timeout_s
+        while True:
+            try:
+                server = http.server.ThreadingHTTPServer(
+                    ("127.0.0.1", int(port)), handler)
+                break
+            except OSError as e:
+                if time.monotonic() >= deadline:
+                    raise GatewayError(
+                        f"gateway could not bind 127.0.0.1:{port} "
+                        f"within {bind_timeout_s:.0f} s: {e}") from e
+                time.sleep(0.2)
+        server.daemon_threads = True
+        thread = threading.Thread(
+            target=server.serve_forever, name="pint-tpu-gateway",
+            kwargs={"poll_interval": 0.2}, daemon=True)
+        thread.start()
+        self._server = server
+        self._thread = thread
+        self.port = server.server_address[1]
+        telemetry.event("gateway.started", port=self.port)
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            server, self._server = self._server, None
+            thread, self._thread = self._thread, None
+            resolver, self._resolver = self._resolver, None
+        if server is not None:
+            try:
+                server.shutdown()
+                server.server_close()
+                if thread is not None:
+                    thread.join(timeout=5.0)
+            except Exception:
+                pass
+        if resolver is not None:
+            self._resolveq.put(None)
+            resolver.join(timeout=5.0)
+
+
+def _make_handler(gw: Gateway):
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):  # noqa: D102 — silence stderr
+            pass
+
+        def _send(self, code: int, doc: dict, tenant: str = "-",
+                  trace_id: Optional[str] = None,
+                  retry_after: Optional[float] = None) -> None:
+            body = json.dumps(doc, sort_keys=True).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if trace_id:
+                self.send_header("X-Trace-Id", trace_id)
+            if retry_after is not None:
+                self.send_header("Retry-After",
+                                 f"{max(retry_after, 0.05):.2f}")
+            self.end_headers()
+            self.wfile.write(body)
+            gw._count_response(tenant, code)
+
+        def do_GET(self):
+            gw.last_activity = time.monotonic()
+            faultinject.wrap("gateway_slow_response", lambda: None)()
+            path = self.path.split("?")[0]
+            try:
+                if path == "/healthz":
+                    self._send(200, {"ok": True, "stats": gw.stats(),
+                                     "serve": gw.service.stats()})
+                elif path == "/metrics":
+                    body = metrics.render_prometheus(
+                        gw.service.stats()).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path.startswith("/v1/jobs/"):
+                    job_id = path[len("/v1/jobs/"):]
+                    doc = gw.job_status(job_id)
+                    if doc is None:
+                        self._send(404, {"error": "unknown job id",
+                                         "job_id": job_id})
+                    else:
+                        self._send(200, doc,
+                                   tenant=doc.get("tenant", "-"),
+                                   trace_id=doc.get("trace_id"))
+                else:
+                    self._send(404, {"error": "not found"})
+            except Exception as e:   # a broken request never kills us
+                try:
+                    self._send(500, {"error": type(e).__name__,
+                                     "message": str(e)})
+                except Exception:
+                    pass
+
+        def do_POST(self):
+            gw.last_activity = time.monotonic()
+            faultinject.wrap("gateway_slow_response", lambda: None)()
+            path = self.path.split("?")[0]
+            if path != "/v1/jobs":
+                self._send(404, {"error": "not found"})
+                return
+            tenant = (self.headers.get("X-Tenant") or
+                      "default").strip()
+            priority = (self.headers.get("X-Priority") or
+                        "normal").strip().lower()
+            idem_key = (self.headers.get("X-Idempotency-Key") or
+                        "").strip() or None
+            trace_id = (self.headers.get("X-Trace-Id") or
+                        "").strip() or None
+            raw_deadline = (self.headers.get("X-Deadline-Ms") or
+                            "").strip()
+            try:
+                if not tenant or not set(tenant) <= _TENANT_OK \
+                        or len(tenant) > 64:
+                    raise GatewayBadRequest(
+                        f"bad tenant {tenant!r} (want "
+                        f"[A-Za-z0-9_-], <= 64 chars)")
+                if priority not in PRIORITIES:
+                    raise GatewayBadRequest(
+                        f"bad priority {priority!r} "
+                        f"(want one of {PRIORITIES})")
+                deadline_s = None
+                if raw_deadline:
+                    try:
+                        deadline_s = float(raw_deadline) / 1e3
+                    except ValueError:
+                        raise GatewayBadRequest(
+                            f"bad X-Deadline-Ms {raw_deadline!r}")
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(
+                        self.rfile.read(n).decode("utf-8"))
+                    if not isinstance(payload, dict):
+                        raise ValueError("payload is not an object")
+                except (ValueError, UnicodeDecodeError) as e:
+                    raise GatewayBadRequest(
+                        f"undecodable request body ({e})")
+                out = gw.submit(payload, tenant=tenant,
+                                priority=priority,
+                                deadline_s=deadline_s,
+                                idem_key=idem_key, trace_id=trace_id)
+            except GatewayQuotaExceeded as e:
+                self._send(429, {"error": "GatewayQuotaExceeded",
+                                 "message": str(e),
+                                 "retry_after_s": e.retry_after_s},
+                           tenant=tenant, trace_id=trace_id,
+                           retry_after=e.retry_after_s)
+                return
+            except GatewayIdempotencyConflict as e:
+                self._send(409, {"error": "GatewayIdempotencyConflict",
+                                 "message": str(e)},
+                           tenant=tenant, trace_id=trace_id)
+                return
+            except GatewayBadRequest as e:
+                self._send(400, {"error": "GatewayBadRequest",
+                                 "message": str(e)},
+                           tenant=tenant, trace_id=trace_id)
+                return
+            except ServeDeadlineExceeded as e:
+                self._send(504, {"error": "ServeDeadlineExceeded",
+                                 "message": str(e)},
+                           tenant=tenant, trace_id=trace_id)
+                return
+            except (ServeSaturated, ServeOverCapacity,
+                    ServeDrained) as e:
+                self._send(503, {"error": type(e).__name__,
+                                 "message": str(e)},
+                           tenant=tenant, trace_id=trace_id,
+                           retry_after=0.2)
+                return
+            except Exception as e:
+                self._send(500, {"error": type(e).__name__,
+                                 "message": str(e)},
+                           tenant=tenant, trace_id=trace_id)
+                return
+            # the ISSUE 19 drop failpoint: the job IS admitted (journal
+            # record written) but the response is lost — the client's
+            # idempotent retry must map back to the same job id with
+            # no second fit
+            drop = faultinject.wrap("gateway_drop_connection",
+                                    lambda key: False)
+            if idem_key and drop(idem_key):
+                with gw._lock:
+                    gw._stats["dropped_responses"] += 1
+                profiling.count("gateway.dropped_response")
+                try:
+                    self.connection.close()
+                except Exception:
+                    pass
+                return
+            self._send(202, out, tenant=tenant,
+                       trace_id=out.get("trace_id") or trace_id)
+
+    return _Handler
+
+
+# --- CLI ----------------------------------------------------------------------
+
+def _demo_payloads():
+    """The four serve demo pulsars as wire payloads (the gateway's
+    traffic corpus: same physics as ``serve check``, so chi2 bits are
+    comparable across the serve and gateway sweep legs)."""
+    from pint_tpu.serve import _demo_service
+
+    svc, jobs = _demo_service()
+    payloads = [serialize_job(j.model, j.resid.toas, name=j.name)
+                for j in jobs]
+    return payloads
+
+
+def _check(args) -> int:
+    """``gateway check``: in-process service + loopback HTTP gateway +
+    resilient clients -> one JSON line (the chaos-sweep leg for the
+    gateway failpoints).  The ``tenant_flood`` failpoint adds a burst
+    of low-priority traffic from a second tenant; the judge asserts
+    the flood is rejected with 429s while the primary tenant's jobs
+    all complete with baseline-identical chi2 bits."""
+    import tempfile
+
+    from pint_tpu.client import GatewayClient
+    from pint_tpu.serve import _demo_service
+
+    telemetry.install_excepthook()
+    st = runtime.acquire_backend()
+    svc, jobs = _demo_service(batch_size=args.batch_size, maxiter=3,
+                              max_wait_ms=args.wait_ms)
+    payloads = [serialize_job(j.model, j.resid.toas, name=j.name)
+                for j in jobs]
+    # warm the bucket programs inline (the timed phase measures the
+    # serving policy, not first-call compiles); gateway submissions
+    # deserialize to fresh staged arrays, so warm THROUGH the gateway
+    # payload cache to make steady state provable
+    journal = args.journal
+    ephemeral_journal = False
+    if journal is None:
+        fd, journal = tempfile.mkstemp(
+            prefix="pint_tpu_gateway_", suffix=".journal.jsonl")
+        os.close(fd)
+        os.unlink(journal)
+        ephemeral_journal = True
+    gw = Gateway(svc, quota=args.quota, window_s=args.window_s,
+                 journal=journal)
+    warm = [svc.submit_prepared(
+        gw._prepare_cached(p, payload_crc(p))) for p in payloads]
+    svc.flush()
+    for f in warm:
+        try:
+            f.result(timeout=600.0)
+        except Exception:
+            pass
+    svc.reset_stats()
+    svc.start()
+    gw.start(port=args.port)
+    base = f"http://127.0.0.1:{gw.port}"
+
+    results: Dict[str, dict] = {}
+    rejected = 0
+    lock = threading.Lock()
+
+    def run_client(i: int) -> None:
+        nonlocal rejected
+        cl = GatewayClient(base, retries=4, backoff_s=0.1,
+                           jitter_s=0.05)
+        payload = payloads[i % len(payloads)]
+        key = f"chk-{args.seed}-{i}"
+        name = payload["name"]
+        deadline_ms = args.deadline_ms or None
+        try:
+            doc = cl.submit_and_wait(
+                payload, tenant="primary",
+                priority=("high" if i % 3 == 0 else "normal"),
+                deadline_ms=deadline_ms, idem_key=key,
+                timeout_s=args.timeout_s)
+        except Exception as e:
+            with lock:
+                if type(e).__name__ in ("GatewayQuotaExceeded",
+                                        "GatewayUnavailable"):
+                    rejected += 1
+                results[f"{i}:{name}"] = {"error": type(e).__name__,
+                                          "flagged": True}
+            return
+        r = doc.get("result") or {}
+        err = doc.get("error")
+        with lock:
+            if err:
+                results[f"{i}:{name}"] = {"error": err.get("type"),
+                                          "flagged": True}
+            else:
+                results[f"{i}:{name}"] = {
+                    "chi2_hex": r.get("chi2_hex"),
+                    "status": r.get("status"),
+                    "rung": r.get("rung"),
+                    "flagged": r.get("rung") != "bucket",
+                    "retries": cl.stats["retries"],
+                    "dedup": bool(doc.get("dedup"))}
+
+    flood_n = int(faultinject.wrap("tenant_flood", lambda: 0)() or 0)
+    flood_codes: Dict[str, int] = {}
+
+    def run_flood() -> None:
+        cl = GatewayClient(base, retries=0, backoff_s=0.01,
+                           jitter_s=0.0)
+        for i in range(flood_n):
+            try:
+                cl.submit(payloads[i % len(payloads)],
+                          tenant="flood", priority="low",
+                          idem_key=f"flood-{args.seed}-{i}")
+                code = 202
+            except Exception as e:
+                code = getattr(e, "http_code", None) or \
+                    type(e).__name__
+            with lock:
+                flood_codes[str(code)] = \
+                    flood_codes.get(str(code), 0) + 1
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=run_client, args=(i,),
+                                daemon=True)
+               for i in range(args.jobs)]
+    flood_thread = None
+    if flood_n:
+        flood_thread = threading.Thread(target=run_flood, daemon=True)
+        flood_thread.start()
+    for t in threads:
+        t.start()
+        time.sleep(args.stagger_ms / 1e3)
+    for t in threads:
+        t.join(args.timeout_s)
+    if flood_thread is not None:
+        flood_thread.join(args.timeout_s)
+    wall = time.monotonic() - t0
+    s = svc.drain(timeout=600.0)
+    gws = gw.stats()
+    gw.stop()
+    if ephemeral_journal:
+        try:
+            os.unlink(journal)
+        except OSError:
+            pass
+    completed = sum(1 for e in results.values() if "chi2_hex" in e)
+    primary = gws["tenants"].get("primary") or {}
+    line = {"mode": "gateway_check", "backend": st.rung,
+            "jobs": args.jobs, "completed": completed,
+            "rejected": rejected, "results": results,
+            "accepted": gws["accepted"], "fits": gws["fits"],
+            "unique_jobs": len({k.split(":", 1)[1]
+                                for k in results} &
+                               {p["name"] for p in payloads}),
+            "dedup_hits": gws["dedup_hits"],
+            "journal_hits": gws["journal_hits"],
+            "dropped_responses": gws["dropped_responses"],
+            "codes": gws["codes"],
+            "p50_ms": primary.get("p50_ms"),
+            "p99_ms": primary.get("p99_ms"),
+            "flood": {"n": flood_n, "codes": flood_codes},
+            "serve": {k: s[k] for k in
+                      ("completed", "dispatches", "deadline_misses",
+                       "quarantined", "rejected")},
+            "wall_s": round(wall, 3)}
+    print(json.dumps(line))
+    return 0 if completed + rejected == args.jobs else 1
+
+
+def _serve_daemon(args) -> int:
+    """``gateway serve``: the long-running network daemon (multi-
+    process clients, the supervise child).  SIGTERM sheds still-queued
+    jobs (their journal ``accept`` records re-admit them next life),
+    journals everything already resolved, and exits 3 — the
+    interrupted-with-state handoff ``gateway supervise`` restarts."""
+    from pint_tpu.serve import _demo_service
+
+    telemetry.install_excepthook()
+    runtime.acquire_backend()
+    svc, jobs = _demo_service(batch_size=args.batch_size, maxiter=3,
+                              max_wait_ms=args.wait_ms)
+    if args.warm:
+        warm = [svc.submit_prepared(j) for j in jobs]
+        svc.flush()
+        for f in warm:
+            try:
+                f.result(timeout=600.0)
+            except Exception:
+                pass
+        svc.reset_stats()
+    svc.start()
+    gw = Gateway(svc, quota=args.quota, window_s=args.window_s,
+                 journal=args.journal)
+    resumed = gw.recover()
+    gw.start(port=args.port)
+    if args.port_file:
+        tmp = args.port_file + f".tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(str(gw.port))
+        os.replace(tmp, args.port_file)
+    interrupted = None
+    shed = 0
+    with runtime.SignalFlush() as sigs:
+        t0 = time.monotonic()
+        while True:
+            time.sleep(0.05)
+            now = time.monotonic()
+            if sigs.fired is not None:
+                interrupted = sigs.fired
+                break
+            gws = gw.stats()
+            active = gws["accepted"] + gws["requests_total"] + resumed
+            if active > 0 and gws["pending"] == 0 \
+                    and now - gw.last_activity > args.idle_exit_s:
+                break
+            if args.max_runtime_s and now - t0 > args.max_runtime_s:
+                break
+    if interrupted is not None:
+        # restart handoff, in order: stop admission-side dispatching of
+        # still-queued work, let the in-flight batch finish, then
+        # journal every resolved future so nothing completed is refit
+        shed = gw.shed_pending()
+        svc.drain(timeout=600.0)
+        gw.settle_done()
+    else:
+        svc.drain(timeout=600.0)
+        gw.settle_done()
+    gws = gw.stats()
+    gw.stop()
+    print(json.dumps({
+        "mode": "gateway_serve", "port": gw.port,
+        "interrupted": interrupted, "shed": shed,
+        "jobs_resumed": resumed, "accepted": gws["accepted"],
+        "completed": gws["completed"], "errors": gws["errors"],
+        "fits": gws["fits"], "dedup_hits": gws["dedup_hits"],
+        "journal_hits": gws["journal_hits"],
+        "journal_skipped": gws["journal_skipped"],
+        "codes": gws["codes"]}))
+    return 3 if interrupted is not None else 0
+
+
+def _supervise(args) -> int:
+    """``gateway supervise``: the ``serve`` daemon under
+    :func:`runtime.run_supervised` on a FIXED port — a SIGTERM-killed
+    gateway restarts with backoff, rebinds the same address, re-admits
+    its journal, and the network clients' idempotent retries land on
+    the same job ids."""
+    import socket
+    import sys
+
+    port = args.port
+    if not port:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+    def argv(attempt: int) -> list:
+        cmd = [sys.executable, "-m", "pint_tpu.gateway", "serve",
+               "--port", str(port), "--journal", args.journal,
+               "--wait-ms", str(args.wait_ms),
+               "--batch-size", str(args.batch_size),
+               "--idle-exit-s", str(args.idle_exit_s),
+               "--max-runtime-s", str(args.max_runtime_s)]
+        if args.quota is not None:
+            cmd += ["--quota", str(args.quota)]
+        if args.window_s is not None:
+            cmd += ["--window-s", str(args.window_s)]
+        if args.port_file:
+            cmd += ["--port-file", args.port_file]
+        return cmd
+
+    attempts = runtime.run_supervised(
+        argv, max_restarts=args.max_restarts,
+        backoff_s=args.backoff_s, clean_rcs=(0,),
+        timeout_s=args.timeout_s)
+    parsed = []
+    for rc, stdout, stderr in attempts:
+        doc = {}
+        for ln in reversed([x for x in stdout.splitlines()
+                            if x.strip()]):
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+        parsed.append({"rc": rc,
+                       "interrupted": doc.get("interrupted"),
+                       "shed": doc.get("shed"),
+                       "jobs_resumed": doc.get("jobs_resumed"),
+                       "accepted": doc.get("accepted"),
+                       "completed": doc.get("completed"),
+                       "fits": doc.get("fits"),
+                       "dedup_hits": doc.get("dedup_hits"),
+                       "journal_hits": doc.get("journal_hits")})
+        if rc not in (0, 3):
+            print(stderr[-800:], file=sys.stderr)
+    okflag = bool(attempts) and attempts[-1][0] == 0
+    fits_total = sum(p["fits"] or 0 for p in parsed)
+    print(json.dumps({"mode": "gateway_supervise", "port": port,
+                      "attempts": parsed,
+                      "restarts": max(len(parsed) - 1, 0),
+                      "fits_total": fits_total, "ok": okflag}))
+    return 0 if okflag else 1
+
+
+def main(argv=None) -> int:
+    """``python -m pint_tpu.gateway check|serve|supervise``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.gateway",
+        description="fault-tolerant HTTP front door over the timing "
+                    "daemon")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--port", type=int, default=0)
+        p.add_argument("--wait-ms", type=float, default=40.0)
+        p.add_argument("--batch-size", type=int, default=2)
+        p.add_argument("--quota", type=float, default=None)
+        p.add_argument("--window-s", type=float, default=None)
+        p.add_argument("--journal", default=None)
+
+    chk = sub.add_parser(
+        "check", help="loopback self-exercise -> one JSON line (the "
+                      "chaos-sweep gateway leg)")
+    common(chk)
+    chk.add_argument("--jobs", type=int, default=8)
+    chk.add_argument("--stagger-ms", type=float, default=5.0)
+    chk.add_argument("--deadline-ms", type=float, default=0.0)
+    chk.add_argument("--seed", type=int, default=0)
+    chk.add_argument("--timeout-s", type=float, default=240.0)
+
+    srv = sub.add_parser(
+        "serve", help="long-running network daemon (the supervise "
+                      "child)")
+    common(srv)
+    srv.add_argument("--port-file", default=None,
+                     help="write the bound port here (atomic) so "
+                          "clients can find an ephemeral port")
+    srv.add_argument("--idle-exit-s", type=float, default=3.0,
+                     help="exit 0 after serving traffic and then "
+                          "seeing no requests for this long")
+    srv.add_argument("--max-runtime-s", type=float, default=540.0)
+    srv.add_argument("--no-warm", dest="warm", action="store_false",
+                     help="skip the inline bucket-program warmup")
+
+    sup = sub.add_parser(
+        "supervise", help="serve under a restarting supervisor "
+                          "(SIGTERM -> backoff restart -> journal "
+                          "re-admission on the same port)")
+    common(sup)
+    sup.add_argument("--port-file", default=None)
+    sup.add_argument("--idle-exit-s", type=float, default=3.0)
+    sup.add_argument("--max-runtime-s", type=float, default=540.0)
+    sup.add_argument("--max-restarts", type=int, default=3)
+    sup.add_argument("--backoff-s", type=float, default=0.25)
+    sup.add_argument("--timeout-s", type=float, default=600.0)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "supervise":
+        if not args.journal:
+            ap.error("supervise requires --journal")
+        return _supervise(args)
+    if args.cmd == "serve":
+        return _serve_daemon(args)
+    return _check(args)
+
+
+if __name__ == "__main__":   # pragma: no cover
+    # delegate to the canonical module instance so failpoints/counters
+    # registered at import time are shared (the serve/aot CLI idiom)
+    import sys as _sys
+
+    from pint_tpu.gateway import main as _main
+
+    _sys.exit(_main())

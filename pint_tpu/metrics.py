@@ -313,11 +313,44 @@ def render_prometheus(extra_stats: Optional[Dict[str, Any]] = None
         out.append(f"# HELP {name} {help_}")
         out.append(f"# TYPE {name} {typ}")
 
+    # gateway front-door families (ISSUE 19): the gateway feeds plain
+    # profiling.count names ("gateway.request.<tenant>.<code>",
+    # "gateway.queue_depth.<priority>" as +-1 deltas) with zero
+    # per-site metrics edits; exposition re-labels them here so
+    # dashboards get real tenant/code/priority label axes instead of
+    # one flat name string
+    gw_req: Dict[str, float] = {}
+    gw_depth: Dict[str, float] = {}
+    generic: Dict[str, float] = {}
+    for name, v in snap["counters"].items():
+        if name.startswith("gateway.request."):
+            gw_req[name[len("gateway.request."):]] = v
+        elif name.startswith("gateway.queue_depth."):
+            gw_depth[name[len("gateway.queue_depth."):]] = v
+        else:
+            generic[name] = v
     fam("pint_tpu_counter_total", "counter",
         "pint_tpu.profiling dispatch/runtime counters")
-    for name in sorted(snap["counters"]):
+    for name in sorted(generic):
         out.append('pint_tpu_counter_total{name="%s"} %s'
-                   % (_esc_label(name), _fmt(snap["counters"][name])))
+                   % (_esc_label(name), _fmt(generic[name])))
+    if gw_req:
+        fam("pint_tpu_gateway_requests_total", "counter",
+            "gateway HTTP responses by tenant and status code")
+        for key in sorted(gw_req):
+            tenant, _, code = key.rpartition(".")
+            out.append(
+                'pint_tpu_gateway_requests_total{tenant="%s",'
+                'code="%s"} %s'
+                % (_esc_label(tenant), _esc_label(code),
+                   _fmt(gw_req[key])))
+    if gw_depth:
+        fam("pint_tpu_gateway_queue_depth", "gauge",
+            "gateway jobs admitted and not yet resolved, by priority "
+            "class")
+        for prio in sorted(gw_depth):
+            out.append('pint_tpu_gateway_queue_depth{priority="%s"} %s'
+                       % (_esc_label(prio), _fmt(gw_depth[prio])))
     fam("pint_tpu_gauge", "gauge", "pint_tpu point-in-time gauges")
     for name in sorted(snap["gauges"]):
         out.append('pint_tpu_gauge{name="%s"} %s'
@@ -635,7 +668,11 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
       be ZERO whenever the new line carries them (absolute, like the
       compile axes): the healthy-path bench has no poison jobs and no
       expiring deadlines, so any nonzero value means containment fired
-      on clean traffic — a regression, not noise.
+      on clean traffic — a regression, not noise;
+    * ``gateway_p99_ms`` — bounded growth by ``p99_tolerance``;
+      ``gateway_dedup_hits`` must be ZERO and ``gateway_retries`` may
+      not exceed the prior round (clean traffic never retries or
+      replays).
 
     An axis absent from either line is skipped — early rounds carry
     only the headline, and a gate that fails on *missing history* would
@@ -671,12 +708,16 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         fail("all_gather_bytes", og, ng,
              "all-gather bytes exceeded the prior round "
              "(no-implicit-gather invariant)")
-    op, np_ = _num(old, "serve_p99_ms"), _num(new, "serve_p99_ms")
-    if op is not None and np_ is not None and op > 0:
-        if np_ > op * (1.0 + p99_tolerance):
-            fail("serve_p99_ms", op, np_,
-                 f"serve p99 grew {np_ / op - 1.0:+.1%} "
-                 f"(> +{p99_tolerance:.0%} tolerance)")
+    # latency axes: in-process serving (ISSUE 18) and the network
+    # front door (ISSUE 19) share the p99 growth bound
+    for axis in ("serve_p99_ms", "gateway_p99_ms"):
+        op, np_ = _num(old, axis), _num(new, axis)
+        if op is not None and np_ is not None and op > 0:
+            if np_ > op * (1.0 + p99_tolerance):
+                fail(axis, op, np_,
+                     f"{axis.split('_')[0]} p99 grew "
+                     f"{np_ / op - 1.0:+.1%} "
+                     f"(> +{p99_tolerance:.0%} tolerance)")
     # PTA-scale throughput axes (ISSUE 15): simulation and whole-array
     # fit rates may not drop below (1 - tolerance) of the prior round;
     # rounds predating the pta leg skip via the absent-axis rule
@@ -696,6 +737,19 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         if na is not None and na != 0:
             fail(axis, _num(old, axis), na,
                  f"healthy-path {axis} must stay 0 (got {na:g})")
+    # gateway exactly-once axis (ISSUE 19): on clean bench traffic
+    # with distinct idempotency keys, dedup replays mean the harness
+    # retried something it should not have — absolute zero, and a
+    # bounded retry budget on the front door
+    na = _num(new, "gateway_dedup_hits")
+    if na is not None and na != 0:
+        fail("gateway_dedup_hits", _num(old, "gateway_dedup_hits"),
+             na, f"healthy-path gateway_dedup_hits must stay 0 "
+                 f"(got {na:g})")
+    og, ng = _num(old, "gateway_retries"), _num(new, "gateway_retries")
+    if og is not None and ng is not None and ng > og:
+        fail("gateway_retries", og, ng,
+             "healthy-path gateway retries exceeded the prior round")
     return failures
 
 

@@ -658,6 +658,36 @@ class TimingService:
                 f"{waited:.3f} s (deadline {limit:.3f} s); never "
                 f"staged", deadline_s=limit, waited_s=waited))
 
+    def _shed_expired_pairs(self, pairs) -> list:
+        """Pre-staging deadline re-check over pairs already TAKEN for a
+        batch: any whose deadline passed between batch selection and
+        staging rejects with ``ServeDeadlineExceeded`` (counted as a
+        deadline miss, exactly like an in-queue expiry) and the
+        survivors dispatch without it.  Closes the ISSUE 19 edge where
+        a propagated deadline expired behind a slow scheduler gap but
+        the job still rode the batch onto the device."""
+        now = time.monotonic()
+        expired = [(j, f) for j, f in pairs
+                   if f.deadline_at is not None and now >= f.deadline_at]
+        if not expired:
+            return pairs
+        with self._cond:
+            self._stats["deadline_misses"] += len(expired)
+        for job, fut in expired:
+            waited = now - fut.submitted_at
+            limit = fut.deadline_at - fut.submitted_at
+            profiling.count("serve.deadline_miss")
+            telemetry.warn("serve.deadline_miss", job=job.name,
+                           trace_id=fut.trace_id, waited_s=waited,
+                           stage="pre_staging")
+            fut._reject(ServeDeadlineExceeded(
+                f"job {job.name!r} expired after {waited:.3f} s "
+                f"(deadline {limit:.3f} s), between batch selection "
+                f"and staging; shed pre-staging",
+                deadline_s=limit, waited_s=waited))
+        gone = {id(f) for _, f in expired}
+        return [(j, f) for j, f in pairs if id(f) not in gone]
+
     def _breaker_admit(self, bucket: _ServeBucket) -> bool:
         """True when the bucket's compiled program may be tried: breaker
         closed, or open past its cooldown (=> half-open probe)."""
@@ -804,6 +834,17 @@ class TimingService:
         a non-finite row quarantines its job only.  The healthy path is
         byte-for-byte the pre-containment one: 0 compiles, 0 retraces,
         1 dispatch + 1 result fetch per coalesced batch."""
+        # scheduler latency on the device path (drives deadline misses)
+        faultinject.wrap("slow_dispatch", lambda: None)()
+        # deadline re-check at pre-staging (ISSUE 19): a job taken into
+        # this batch whose deadline expired during the scheduler gap
+        # above is shed HERE, before it costs any device work — batch
+        # selection already expired the queue, but the window between
+        # take and stage was unguarded
+        pairs = self._shed_expired_pairs(pairs)
+        if not pairs:
+            self._finish_batch(bucket, pairs, reason, dispatched=False)
+            return
         if not self._breaker_admit(bucket):
             # breaker open: the bucket's program is suspect — every job
             # goes solo on the eager lane (rung "eager" or typed
@@ -852,8 +893,6 @@ class TimingService:
         faultinject.wrap("recorder_crash", lambda: None)()
         # a dispatch-time allocator failure (RESOURCE_EXHAUSTED)
         faultinject.wrap("oom_dispatch", lambda: None)()
-        # scheduler latency on the device path (drives deadline misses)
-        faultinject.wrap("slow_dispatch", lambda: None)()
         jobs = [j for j, _ in pairs]
         padded = jobs + [jobs[-1]] * (self.batch_size - len(jobs))
         prog = self._bucket_program(bucket)

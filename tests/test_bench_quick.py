@@ -155,6 +155,26 @@ def _assert_schema(d, fast=False):
     assert sf["pending"] == 0, sf
     assert isinstance(sf["stats_file_writes"], int)
     assert sf["stats_file_writes"] >= 1, sf
+    # network front door axis (ISSUE 19): client-observed p50/p99
+    # through the loopback gateway in real (jax-free) client
+    # subprocesses, plus the must-be-zero clean-path axes the
+    # metrics-compare gate enforces — a retry means a loopback
+    # connection hiccup, a dedup hit means a duplicate submission
+    for key in ("gateway_p50_ms", "gateway_p99_ms"):
+        assert isinstance(d.get(key), (int, float)), (key, d.get(key))
+    assert d["gateway_p50_ms"] > 0
+    assert d["gateway_p99_ms"] >= d["gateway_p50_ms"]
+    assert d["gateway_retries"] == 0, d
+    assert d["gateway_dedup_hits"] == 0, d
+    gwl = d["submetrics"].get("gateway")
+    assert isinstance(gwl, dict) and "error" not in gwl, gwl
+    assert gwl["completed"] == gwl["jobs"] > 0, gwl
+    assert gwl["client_rcs"] == [0] * gwl["n_clients"], gwl
+    assert gwl["fits"] == gwl["accepted"] == gwl["jobs"], gwl
+    assert d["gateway_p50_ms"] == gwl["p50_ms"]
+    assert d["gateway_p99_ms"] == gwl["p99_ms"]
+    # both admission priority classes really rode the wire
+    assert set(gwl["by_priority"]) == {"high", "normal"}, gwl
     # cost-card axis (ISSUE 13): per-entrypoint compiled-program cost
     # (FLOPs, bytes accessed, per-device peak bytes) in the line, so a
     # program suddenly costing more shows up in the series even when

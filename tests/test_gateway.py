@@ -1,0 +1,415 @@
+"""The fault-tolerant network front door (ISSUE 19,
+``pint_tpu.gateway`` + ``pint_tpu.client``): wire serialization that
+round-trips chi2 BIT-identically, per-tenant token-bucket admission
+with priority reserves, deadline propagation into the serve plane,
+idempotent retries over a CRC-verified dedup journal, and the
+steady-state serve contract holding with the HTTP hop in-path.
+
+Tier-1 keeps these legs CHEAP: one module-level program cache, one
+shared warmed service behind one loopback gateway, and every HTTP leg
+routes the two 8-TOA demo pulsars (one bucket program for the whole
+module).  The two-process supervise/kill-midflight and chaos-sweep
+depth legs ride the slow ``test_tooling.py`` (marker ``gateway``
+selects both; ``PINT_TPU_SKIP_GATEWAY=1`` opts out).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pint_tpu.exceptions import (GatewayBadRequest,
+                                 GatewayIdempotencyConflict)
+from pint_tpu.gateway import (DedupJournal, Gateway, TokenBucket,
+                              deserialize_job, payload_crc,
+                              serialize_job)
+from pint_tpu.serve import _demo_service
+
+#: one compiled program for the whole module (the test_serve idiom):
+#: every service below shares this cache and routes the 8-TOA bucket
+_PROGRAMS: dict = {}
+
+#: monotonically-bumped idempotency-key nonce: every test leg mints
+#: fresh keys against the shared gateway's journal
+_NONCE = iter(range(10 ** 6))
+
+
+def _key(tag):
+    return f"t19-{tag}-{next(_NONCE)}"
+
+
+@pytest.fixture(scope="module")
+def front(tmp_path_factory):
+    """(gateway, payloads, ctrl): a warmed demo service behind a
+    started loopback gateway with a real journal; ``ctrl`` maps name ->
+    bit-exact chi2 hex from the direct (no-HTTP) path."""
+    svc, jobs = _demo_service(batch_size=2, maxiter=3,
+                              max_wait_ms=25.0,
+                              program_cache=_PROGRAMS)
+    jobs = jobs[:2]   # SERVE0/SERVE1: one structure/shape bucket
+    payloads = [serialize_job(j.model, j.resid.toas, name=j.name)
+                for j in jobs]
+    journal = tmp_path_factory.mktemp("gw") / "journal.jsonl"
+    gw = Gateway(svc, quota=64.0, window_s=1.0, journal=str(journal))
+    # warm THROUGH the payload cache: gateway submissions must reuse
+    # the same PreparedJob (uid) the warm-up staged
+    warm = [svc.submit_prepared(gw._prepare_cached(p, payload_crc(p)))
+            for p in payloads]
+    svc.flush()
+    ctrl = {}
+    for f in warm:
+        r = f.result(timeout=600.0)
+        ctrl[r.name] = float(r.chi2).hex()
+    svc.reset_stats()
+    svc.start()
+    gw.start(port=0)
+    yield gw, payloads, ctrl
+    gw.stop()
+    svc.drain(timeout=60.0)
+
+
+def _post(gw, payload, headers=None, timeout=30.0):
+    """POST /v1/jobs -> (code, doc, headers); HTTP errors are decoded,
+    not raised."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}/v1/jobs",
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), e.headers
+
+
+def _get(gw, path, timeout=30.0):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}{path}",
+                timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _wait_done(gw, job_id, timeout_s=120.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        code, doc = _get(gw, f"/v1/jobs/{job_id}")
+        assert code == 200, (code, doc)
+        if doc["state"] in ("done", "error"):
+            return doc
+        time.sleep(0.02)
+    raise AssertionError(f"job {job_id} never settled")
+
+
+class TestTokenBucket:
+    def test_high_admits_down_to_the_last_token(self):
+        b = TokenBucket(4.0, window_s=3600.0)   # refill ~frozen
+        admits = [b.admit("high")[0] for _ in range(4)]
+        assert admits == [True] * 4
+        ok, retry_after = b.admit("high")
+        assert not ok and retry_after > 0.0
+
+    def test_priority_reserves_starve_low_first(self):
+        # capacity 4: low needs 1 + 0.5*4 = 3 tokens, normal needs
+        # 1 + 0.25*4 = 2, high needs exactly its own token
+        b = TokenBucket(4.0, window_s=3600.0)
+        assert b.admit("high")[0] and b.admit("high")[0]
+        assert not b.admit("low")[0]      # 2 tokens < need 3
+        assert b.admit("normal")[0]       # 2 tokens == need 2
+        assert not b.admit("normal")[0]   # 1 token  < need 2
+        assert b.admit("high")[0]         # down to the last token
+        assert not b.admit("high")[0]
+
+    def test_retry_after_scales_with_the_deficit(self):
+        b = TokenBucket(2.0, window_s=2.0)   # rate = 1 token/s
+        assert b.admit("high")[0] and b.admit("high")[0]
+        _, ra_high = b.admit("high")    # needs 1 token -> ~1 s
+        _, ra_low = b.admit("low")      # needs 2 tokens -> ~2 s
+        assert 0.0 < ra_high <= ra_low
+        assert ra_low == pytest.approx(2.0, abs=0.25)
+
+
+class TestDedupJournal:
+    def _mk(self, tmp_path):
+        j = DedupJournal(str(tmp_path / "j.jsonl"))
+        j.append({"kind": "accept", "key": "k1", "job_id": "J000001",
+                  "payload_crc": "deadbeef", "tenant": "t",
+                  "priority": "normal", "payload": {"x": 1}})
+        j.append({"kind": "resolve", "key": "k1", "job_id": "J000001",
+                  "result": {"chi2_hex": "0x1.8p+1"}})
+        j.append({"kind": "accept", "key": "k2", "job_id": "J000002",
+                  "payload_crc": "cafe0000", "tenant": "t",
+                  "priority": "high", "payload": {"x": 2}})
+        return j
+
+    def test_accept_resolve_merge(self, tmp_path):
+        j = self._mk(tmp_path)
+        state = DedupJournal(j.path).load()
+        assert state["k1"]["result"] == {"chi2_hex": "0x1.8p+1"}
+        assert state["k1"]["payload"] == {"x": 1}
+        assert state["k2"]["result"] is None        # unresolved
+        assert state["k2"]["job_id"] == "J000002"
+
+    def test_torn_tail_costs_one_record_not_the_journal(self, tmp_path):
+        j = self._mk(tmp_path)
+        with open(j.path, "r+", encoding="utf-8") as fh:
+            blob = fh.read()
+            fh.seek(0)
+            fh.write(blob[:-20])    # crash mid-append: torn last line
+            fh.truncate()
+        loader = DedupJournal(j.path)
+        state = loader.load()
+        assert loader.skipped == 1
+        assert state["k1"]["result"] is not None    # survivors intact
+
+    def test_bitflip_fails_crc_and_is_skipped(self, tmp_path):
+        j = self._mk(tmp_path)
+        with open(j.path, encoding="utf-8") as fh:
+            lines = fh.readlines()
+        lines[0] = lines[0].replace("J000001", "J999999", 1)
+        with open(j.path, "w", encoding="utf-8") as fh:
+            fh.writelines(lines)
+        loader = DedupJournal(j.path)
+        state = loader.load()
+        assert loader.skipped == 1
+        # the accept was corrupt; only the resolve survives for k1
+        assert state["k1"]["payload"] is None
+        assert state["k1"]["result"] is not None
+
+    def test_foreign_lines_are_not_trusted(self, tmp_path):
+        j = self._mk(tmp_path)
+        with open(j.path, "a", encoding="utf-8") as fh:
+            fh.write('{"key": "evil", "kind": "accept"}\n')
+        loader = DedupJournal(j.path)
+        state = loader.load()
+        assert loader.skipped == 1
+        assert "evil" not in state
+
+
+class TestWireSerialization:
+    def test_round_trip_is_a_fixed_point(self, front):
+        """serialize(deserialize(p)) == p up to the CRC — the dedup
+        check's ground truth: a payload that re-serializes to a
+        different CRC would defeat idempotency."""
+        _, payloads, _ = front
+        for p in payloads:
+            model, toas, name = deserialize_job(p)
+            again = serialize_job(model, toas, name=name)
+            assert payload_crc(again) == payload_crc(p)
+
+    def test_bad_payloads_raise_typed(self):
+        with pytest.raises(GatewayBadRequest):
+            deserialize_job({"name": "x"})          # no par/toas
+        with pytest.raises(GatewayBadRequest):
+            deserialize_job({"name": "x", "par": "PSR J",
+                             "toas": "not-a-dict"})
+
+
+class TestHTTPPath:
+    def test_submit_and_result_bit_identical(self, front):
+        """The tentpole conservation property at test granularity: a
+        fit through HTTP serialize -> deserialize -> prepare returns
+        the SAME chi2 bits as the direct in-process path."""
+        gw, payloads, ctrl = front
+        for p in payloads:
+            code, doc, hdrs = _post(
+                gw, p, {"X-Tenant": "t19",
+                        "X-Idempotency-Key": _key("bits")})
+            assert code == 202, doc
+            st = _wait_done(gw, doc["job_id"])
+            assert st["state"] == "done", st
+            r = st["result"]
+            assert r["chi2_hex"] == ctrl[r["name"]]
+
+    def test_dedup_replay_returns_the_original_job(self, front):
+        gw, payloads, _ = front
+        key = _key("dedup")
+        code1, doc1, _ = _post(gw, payloads[0],
+                               {"X-Idempotency-Key": key})
+        assert code1 == 202 and doc1["dedup"] is False
+        before = gw.stats()["accepted"]
+        code2, doc2, _ = _post(gw, payloads[0],
+                               {"X-Idempotency-Key": key})
+        assert code2 == 202, doc2
+        assert doc2["dedup"] is True
+        assert doc2["job_id"] == doc1["job_id"]
+        assert gw.stats()["accepted"] == before   # no second admission
+
+    def test_same_key_different_payload_conflicts(self, front):
+        gw, payloads, _ = front
+        key = _key("conflict")
+        code, doc, _ = _post(gw, payloads[0],
+                             {"X-Idempotency-Key": key})
+        assert code == 202, doc
+        code, doc, _ = _post(gw, payloads[1],
+                             {"X-Idempotency-Key": key})
+        assert code == 409
+        assert doc["error"] == "GatewayIdempotencyConflict"
+
+    def test_expired_deadline_is_shed_at_admission(self, front):
+        gw, payloads, _ = front
+        code, doc, _ = _post(gw, payloads[0],
+                             {"X-Deadline-Ms": "0",
+                              "X-Tenant": "t19dead"})
+        assert code == 504, doc
+        assert doc["error"] == "ServeDeadlineExceeded"
+
+    def test_validation_rejects_before_admission(self, front):
+        gw, payloads, _ = front
+        code, doc, _ = _post(gw, payloads[0],
+                             {"X-Tenant": "no spaces allowed"})
+        assert (code, doc["error"]) == (400, "GatewayBadRequest")
+        code, doc, _ = _post(gw, payloads[0],
+                             {"X-Priority": "urgent"})
+        assert (code, doc["error"]) == (400, "GatewayBadRequest")
+        code, doc, _ = _post(gw, payloads[0],
+                             {"X-Deadline-Ms": "soon"})
+        assert (code, doc["error"]) == (400, "GatewayBadRequest")
+        code, doc = _get(gw, "/v1/jobs/J424242")
+        assert (code, doc["error"]) == (404, "unknown job id")
+
+    def test_trace_id_rides_the_wire(self, front):
+        gw, payloads, _ = front
+        code, doc, hdrs = _post(
+            gw, payloads[0], {"X-Trace-Id": "trace-19-abc",
+                              "X-Idempotency-Key": _key("trace")})
+        assert code == 202
+        assert doc["trace_id"] == "trace-19-abc"
+        assert hdrs.get("X-Trace-Id") == "trace-19-abc"
+        st = _wait_done(gw, doc["job_id"])
+        assert st["trace_id"] == "trace-19-abc"
+
+    def test_over_quota_gets_429_with_retry_after(self, front):
+        """A second front door with quota=1 over the SAME warmed
+        service: the first POST admits (one real fit), the burst is
+        rejected with 429 + a Retry-After hint and never reaches the
+        service."""
+        gw, payloads, _ = front
+        tight = Gateway(gw.service, quota=1.0, window_s=60.0)
+        tight._prepared = gw._prepared          # share the payload LRU
+        tight._prepared_order = list(gw._prepared_order)
+        tight.start(port=0)
+        try:
+            code, doc, _ = _post(tight, payloads[0],
+                                 {"X-Tenant": "burst"})
+            assert code == 202, doc
+            accepted = tight.stats()["accepted"]
+            code, doc, hdrs = _post(tight, payloads[0],
+                                    {"X-Tenant": "burst"})
+            assert code == 429, doc
+            assert doc["error"] == "GatewayQuotaExceeded"
+            assert float(hdrs["Retry-After"]) > 0.0
+            assert tight.stats()["accepted"] == accepted
+            # an over-quota tenant is not the other tenant's problem
+            code, doc, _ = _post(tight, payloads[0],
+                                 {"X-Tenant": "bystander"})
+            assert code == 202, doc
+            _wait_done(tight, doc["job_id"])
+            tight.settle_done()
+        finally:
+            tight.stop()
+
+    def test_healthz_and_live_metrics_scrape(self, front):
+        from pint_tpu import metrics
+
+        gw, payloads, _ = front
+        code, doc, _ = _post(gw, payloads[0],
+                             {"X-Tenant": "scrape",
+                              "X-Idempotency-Key": _key("scrape")})
+        assert code == 202
+        _wait_done(gw, doc["job_id"])
+        code, doc = _get(gw, "/healthz")
+        assert code == 200 and doc["ok"] is True
+        assert doc["stats"]["accepted"] >= 1
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{gw.port}/metrics",
+            timeout=30).read().decode("utf-8")
+        parsed = metrics.parse_prometheus(body)
+        gw_families = {name for name, _ in parsed}
+        assert "pint_tpu_gateway_requests_total" in gw_families
+        assert parsed[("pint_tpu_gateway_requests_total",
+                       (("code", "202"), ("tenant", "scrape")))] >= 1
+
+
+class TestJournalReplay:
+    def test_resolved_key_replays_across_gateway_lives(
+            self, front, tmp_path):
+        """Exactly-once across a restart: a NEW gateway over the same
+        journal serves the old key's job id and bit-identical result
+        with zero device work."""
+        gw, payloads, ctrl = front
+        journal = str(tmp_path / "replay.jsonl")
+        gw1 = Gateway(gw.service, quota=64.0, journal=journal)
+        gw1._prepared = gw._prepared            # share the payload LRU
+        gw1._prepared_order = list(gw._prepared_order)
+        key = _key("lives")
+        out = gw1.submit(payloads[0], tenant="replay", idem_key=key)
+        deadline = time.monotonic() + 120.0
+        while gw1.pending() and time.monotonic() < deadline:
+            time.sleep(0.02)
+            gw1.settle_done()
+        st1 = gw1.job_status(out["job_id"])
+        assert st1 is not None and st1["state"] == "done", st1
+
+        gw2 = Gateway(gw.service, quota=64.0, journal=journal)
+        fits_before = gw.service.stats()["completed"]
+        hit = gw2.submit(payloads[0], tenant="replay", idem_key=key)
+        assert hit["dedup"] is True
+        assert hit["job_id"] == out["job_id"]
+        st2 = gw2.job_status(out["job_id"])
+        assert st2["from_journal"] is True
+        assert st2["result"]["chi2_hex"] \
+            == st1["result"]["chi2_hex"] \
+            == ctrl[st1["result"]["name"]]
+        assert gw.service.stats()["completed"] == fits_before  # 0 fits
+        with pytest.raises(GatewayIdempotencyConflict):
+            gw2.submit(payloads[1], tenant="replay", idem_key=key)
+        # id-collision regression: the new life's sequence starts PAST
+        # every journaled id — a fresh admission must never reuse the
+        # dead daemon's job id (a client polling across the restart
+        # would silently read the wrong job)
+        gw2._prepared = gw._prepared
+        gw2._prepared_order = list(gw._prepared_order)
+        fresh = gw2.submit(payloads[1], tenant="replay",
+                           idem_key=_key("lives2"))
+        assert fresh["job_id"] != out["job_id"], fresh
+        assert int(fresh["job_id"][1:]) > int(out["job_id"][1:])
+
+
+class TestSteadyStateContract:
+    def test_serve_contract_holds_with_gateway_in_path(self, front):
+        """ISSUE 19 acceptance: the serve_request budget (0 compiles /
+        0 retraces / 1 dispatch per steady batch, 0 h2d transfers)
+        holds with the HTTP front door in-path — serialization lands on
+        the payload-CRC PreparedJob LRU, so replayed wire payloads
+        reuse the staged arrays."""
+        from pint_tpu.client import GatewayClient
+        from pint_tpu.lint.contracts import steady_state_counters
+
+        gw, payloads, ctrl = front
+        cl = GatewayClient(f"http://127.0.0.1:{gw.port}",
+                           tenant="steady", retries=0)
+        assert cl.wait_ready(timeout_s=30.0)
+
+        seen = []
+
+        def call():
+            docs = [cl.submit(p, idem_key=_key("steady"))
+                    for p in payloads]
+            out = [cl.wait(d["job_id"], timeout_s=120.0)
+                   for d in docs]
+            assert all(o["state"] == "done" for o in out), out
+            seen.append([o["result"]["chi2_hex"] for o in out])
+
+        _, steady = steady_state_counters(call, warmup=1)
+        assert sorted(seen[-1]) == sorted(ctrl.values())
+        assert steady.compiles == 0, steady
+        assert steady.retraces == (), steady.retraces
+        assert steady.dispatches == 1, steady
+        assert steady.transfers_h2d == 0, steady   # staged-args reuse
+        assert cl.stats["retries"] == 0

@@ -229,6 +229,34 @@ class TestExposition:
                       (("le", "+Inf"), ("name", "unit.h")))]
         assert first == 1 and last_finite == 1 and inf == 2
 
+    def test_gateway_families_get_real_label_axes(self):
+        """ISSUE 19 satellite: the gateway feeds plain
+        ``profiling.count`` names with zero per-site metrics edits;
+        exposition re-labels them into
+        ``pint_tpu_gateway_requests_total{tenant,code}`` and
+        ``pint_tpu_gateway_queue_depth{priority}`` — and they round-trip
+        through the strict parser."""
+        profiling.count("gateway.request.alice.202")
+        profiling.count("gateway.request.alice.202")
+        profiling.count("gateway.request.bob.429")
+        profiling.count("gateway.queue_depth.high")
+        profiling.count("gateway.queue_depth.high")
+        profiling.count("gateway.queue_depth.high", -1)
+        parsed = metrics.parse_prometheus(metrics.render_prometheus())
+        assert parsed[("pint_tpu_gateway_requests_total",
+                       (("code", "202"), ("tenant", "alice")))] == 2
+        assert parsed[("pint_tpu_gateway_requests_total",
+                       (("code", "429"), ("tenant", "bob")))] == 1
+        assert parsed[("pint_tpu_gateway_queue_depth",
+                       (("priority", "high"),))] == 1
+        # the re-labelled families are NOT duplicated into the flat
+        # counter family
+        flat = {lbls for (n, lbls) in parsed
+                if n == "pint_tpu_counter_total"}
+        assert not any("gateway.request" in str(lbls) or
+                       "gateway.queue_depth" in str(lbls)
+                       for lbls in flat)
+
     def test_label_escaping_roundtrip(self):
         nasty = 'we"ird\\name\nwith everything'
         metrics.inc(nasty)
@@ -454,11 +482,33 @@ class TestCompare:
                                self._line(serve_p99_ms=16.0))
         assert f["metric"] == "serve_p99_ms"
 
+    def test_gateway_p99_growth_fails(self):
+        (f,) = metrics.compare(self._line(gateway_p99_ms=10.0),
+                               self._line(gateway_p99_ms=16.0))
+        assert f["metric"] == "gateway_p99_ms"
+
+    def test_gateway_dedup_hits_must_stay_zero(self):
+        # absolute: any dedup hit on the clean bench path means a
+        # duplicate submission slipped through
+        (f,) = metrics.compare(self._line(),
+                               self._line(gateway_dedup_hits=1))
+        assert f["metric"] == "gateway_dedup_hits"
+        assert "must stay 0" in f["why"]
+
+    def test_gateway_retries_may_not_grow(self):
+        (f,) = metrics.compare(self._line(gateway_retries=0),
+                               self._line(gateway_retries=2))
+        assert f["metric"] == "gateway_retries"
+        # equal is fine
+        assert metrics.compare(self._line(gateway_retries=2),
+                               self._line(gateway_retries=2)) == []
+
     def test_absent_axes_are_skipped(self):
         # early rounds carry only the headline: a richer new line must
         # not fail on missing history, and vice versa
         old = self._line()
         new = self._line(comm_bytes=10 ** 9, serve_p99_ms=10.0,
+                         gateway_p99_ms=10.0, gateway_retries=0,
                          dispatch_counters={"compiles": 0,
                                             "retraces": 0,
                                             "dispatches": 1})

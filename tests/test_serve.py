@@ -464,6 +464,31 @@ class TestDeadlines:
         assert st["deadline_misses"] == 1
         assert st["deadline_miss_fraction"] == pytest.approx(0.5)
 
+    def test_expired_behind_slow_dispatch_shed_pre_staging(
+            self, demo, monkeypatch):
+        """ISSUE 19 regression: a deadline that expires AFTER batch
+        selection but BEFORE staging is re-checked and shed pre-staging
+        — typed, counted as a deadline miss, and never rides the batch
+        onto the device — while its batch-mate completes
+        bit-identically."""
+        from pint_tpu.exceptions import ServeDeadlineExceeded
+
+        _, jobs, ctrl = demo
+        monkeypatch.setenv("PINT_TPU_SLOW_DISPATCH_S", "0.3")
+        svc = _fresh()
+        with faultinject.slow_dispatch():
+            keeper = svc.submit_prepared(jobs[0])
+            doomed = svc.submit_prepared(jobs[1], deadline_s=0.1)
+            svc.flush()
+            exc = doomed.exception(timeout=600.0)
+            r = keeper.result(timeout=600.0)
+        assert isinstance(exc, ServeDeadlineExceeded)
+        assert "pre-staging" in str(exc)
+        assert float(r.chi2) == float(ctrl[r.name].chi2)
+        st = svc.stats()
+        assert st["deadline_misses"] == 1
+        assert st["deadline_miss_fraction"] == pytest.approx(0.5)
+
     def test_nonpositive_deadline_rejected_at_admission(self, demo):
         from pint_tpu.exceptions import ServeDeadlineExceeded
 

@@ -741,12 +741,17 @@ class TestServeChaosSweep:
         assert p.returncode == 0, p.stdout + p.stderr[-2000:]
         doc = json.loads(p.stdout.splitlines()[-1])
         assert doc["ok"] is True and doc["problems"] == []
-        # baseline + every default fault + the seeded pair all ran
+        # baseline + every default fault + the seeded pair all ran,
+        # then the network-boundary legs (ISSUE 19): gateway baseline
+        # + one leg per gateway failpoint
         legs = {s["leg"] for s in doc["legs"]}
-        assert "baseline" in legs
-        from pint_tpu.faultinject import _SWEEP_FAULTS
+        assert "baseline" in legs and "gw:baseline" in legs
+        from pint_tpu.faultinject import (_SWEEP_FAULTS,
+                                          _SWEEP_GATEWAY_FAULTS)
         assert set(_SWEEP_FAULTS) <= legs
-        assert doc["n_legs"] == len(_SWEEP_FAULTS) + 2
+        assert {"gw:" + f for f in _SWEEP_GATEWAY_FAULTS} <= legs
+        assert doc["n_legs"] == (len(_SWEEP_FAULTS) + 2
+                                 + len(_SWEEP_GATEWAY_FAULTS) + 1)
 
     def test_sweep_catches_injected_silent_corruption(self):
         """The negative control: ``--inject silent_result_bias`` adds a
@@ -754,7 +759,7 @@ class TestServeChaosSweep:
         counter) — the judge must exit 1 and name the corrupted leg."""
         import json
 
-        p = self._sweep(["--pairs", "0",
+        p = self._sweep(["--pairs", "0", "--no-gateway",
                          "--inject", "silent_result_bias"])
         assert p.returncode == 1, p.stdout + p.stderr[-2000:]
         doc = json.loads(p.stdout.splitlines()[-1])
@@ -816,6 +821,206 @@ class TestServeSupervise:
         assert last["jobs_resumed"] == a1["spooled"], (a1, last)
         assert last["completed"] == last["jobs_resumed"], last
         assert doc["completed_total"] == a1["submitted"], doc
+        # the kill token is one-shot: consumed by the first SIGTERM
+        assert not token.exists()
+
+
+class TestGatewayDaemon:
+    """The HTTP front door's CLI subprocess legs (ISSUE 19): a clean
+    ``python -m pint_tpu.gateway check`` run, then each gateway
+    failpoint activated ACROSS the process boundary with
+    ``PINT_TPU_FAULTS`` — ``gateway_drop_connection`` severs every
+    first admission response (the idempotent-retry negative control),
+    ``gateway_slow_response`` stretches responses against the client's
+    retry budget, ``tenant_flood`` bursts a second tenant into the
+    quota.  Marker ``gateway``; opt out with
+    ``PINT_TPU_SKIP_GATEWAY=1``."""
+
+    @staticmethod
+    def _run(args=(), env_extra=None):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PINT_TPU_FAULTS", None)
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", "pint_tpu.gateway", "check", *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_gateway_check_completes_all_jobs(self):
+        import json
+
+        p = self._run(["--jobs", "6", "--wait-ms", "40"])
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["completed"] == 6 and doc["rejected"] == 0
+        # the clean path is quiet: no retries forced, nothing deduped,
+        # and every admission became exactly one fit
+        assert doc["dedup_hits"] == 0
+        assert doc["fits"] == doc["accepted"]
+        assert doc["p50_ms"] > 0 and doc["p99_ms"] >= doc["p50_ms"]
+
+    def test_dropped_responses_recovered_by_idempotent_retry(self):
+        """The ISSUE 19 negative control: every first admission
+        response is severed on the wire, every client retries under
+        its idempotency key — exactly-once admission, ZERO duplicate
+        fits."""
+        import json
+
+        p = self._run(["--jobs", "6", "--wait-ms", "40"],
+                      {"PINT_TPU_FAULTS": "gateway_drop_connection"})
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        assert doc["completed"] == 6, doc
+        assert doc["dropped_responses"] >= 1, doc
+        # the dropped responses were recovered by dedup replay, not by
+        # fresh admissions: retried keys hit the journal/live table ...
+        assert doc["dedup_hits"] >= 1, doc
+        # ... and nothing was fit twice
+        assert doc["fits"] == doc["accepted"], doc
+
+    def test_slow_response_absorbed_by_client_budget(self):
+        import json
+
+        p = self._run(["--jobs", "6", "--wait-ms", "40"],
+                      {"PINT_TPU_FAULTS": "gateway_slow_response"})
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        # a slow front door is a latency event, not a correctness one
+        assert doc["completed"] == 6, doc
+        assert doc["fits"] == doc["accepted"], doc
+
+    def test_tenant_flood_throttled_without_collateral(self):
+        import json
+
+        p = self._run(["--jobs", "6", "--wait-ms", "40"],
+                      {"PINT_TPU_FAULTS": "tenant_flood"})
+        assert p.returncode == 0, p.stdout + p.stderr[-800:]
+        doc = json.loads(p.stdout.splitlines()[-1])
+        flood = doc["flood"]
+        assert flood["n"] > 0
+        # the over-quota tenant is throttled with explicit 429s ...
+        assert flood["codes"].get("429", 0) >= 1, flood
+        # ... while the in-quota tenant is untouched
+        assert doc["completed"] == 6, doc
+
+
+class TestGatewaySupervise:
+    """The two-process kill-midflight leg (ISSUE 19 acceptance):
+    ``gateway supervise`` restarts a SIGTERM-killed daemon on the same
+    port while a separate jax-free ``client.py load`` process rides
+    through the crash on idempotent retries — every job fits exactly
+    once, chi2 bits are identical across the restart boundary, and the
+    dedup journal replays what the dead daemon already resolved.
+    Marker ``gateway``; opt out with ``PINT_TPU_SKIP_GATEWAY=1``."""
+
+    def test_kill_midflight_exactly_once(self, tmp_path):
+        import json
+        import os
+        import subprocess
+        import sys
+        import time
+
+        import pint_tpu
+        from pint_tpu.gateway import serialize_job
+        from pint_tpu.serve import _demo_service
+
+        svc, jobs = _demo_service(batch_size=2, maxiter=3,
+                                  max_wait_ms=25.0)
+        payloads = [serialize_job(j.model, j.resid.toas, name=j.name)
+                    for j in jobs]
+        pay_path = tmp_path / "payloads.json"
+        pay_path.write_text(json.dumps(payloads))
+
+        token = tmp_path / "kill.token"
+        token.write_text("")
+        journal = str(tmp_path / "gw.journal")
+        port_file = tmp_path / "gw.port"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # slow_dispatch stretches each bucket fit to 1 s so the
+        # kill_daemon SIGTERM (fired after the FIRST completed batch)
+        # provably lands while the client is still mid-load
+        env.update({
+            "PINT_TPU_FAULTS": "kill_daemon,slow_dispatch",
+            "PINT_TPU_SLOW_DISPATCH_S": "1.0",
+            "PINT_TPU_KILL_TOKEN": str(token),
+        })
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "pint_tpu.gateway", "supervise",
+             "--journal", journal, "--port-file", str(port_file),
+             "--wait-ms", "600", "--idle-exit-s", "8",
+             "--backoff-s", "0.1", "--timeout-s", "500"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+        try:
+            deadline = time.monotonic() + 180.0
+            while not port_file.exists():
+                assert sup.poll() is None, sup.communicate()[1][-2000:]
+                assert time.monotonic() < deadline, \
+                    "supervised gateway never published its port"
+                time.sleep(0.5)
+            port = int(port_file.read_text())
+            url = f"http://127.0.0.1:{port}"
+
+            cl_env = dict(os.environ, JAX_PLATFORMS="cpu")
+            cl_env.pop("PINT_TPU_FAULTS", None)
+            client_py = os.path.join(
+                os.path.dirname(pint_tpu.__file__), "client.py")
+            pc = subprocess.run(
+                [sys.executable, client_py, "load", "--url", url,
+                 "--payloads", str(pay_path), "--jobs", "8",
+                 "--key-prefix", "kmf", "--tenant", "primary",
+                 "--timeout-s", "360", "--retries", "20"],
+                capture_output=True, text=True, timeout=420,
+                env=cl_env)
+            assert pc.returncode == 0, pc.stdout + pc.stderr[-2000:]
+            load = json.loads(pc.stdout.splitlines()[-1])
+            assert load["completed"] == 8 and load["errors"] == {}
+
+            # chi2 bits conserved across the restart boundary: jobs i
+            # and i+4 carry the SAME payload but land on opposite
+            # sides of the kill
+            hexes = {k: v["chi2_hex"] for k, v in
+                     load["results"].items()}
+            assert all(hexes.values()), hexes
+            for i in range(4):
+                assert hexes[f"kmf-{i}"] == hexes[f"kmf-{i + 4}"], \
+                    (i, hexes)
+
+            # deterministic journal-replay probe while the restarted
+            # daemon still idles: kmf-0 was resolved by the KILLED
+            # daemon, so replaying its key must be served from the
+            # journal — same job, same bits, no new fit
+            from pint_tpu.client import GatewayClient
+            cl = GatewayClient(url, tenant="primary")
+            rep = cl.submit(payloads[0], idem_key="kmf-0")
+            assert rep["dedup"] is True, rep
+            res = cl.wait(rep["job_id"], timeout_s=60.0)
+            assert res.get("from_journal") is True, res
+            assert res["result"]["chi2_hex"] == hexes["kmf-0"]
+
+            out, err = sup.communicate(timeout=560)
+        finally:
+            if sup.poll() is None:
+                sup.kill()
+                sup.communicate()
+        assert sup.returncode == 0, out + err[-2000:]
+        doc = json.loads(out.splitlines()[-1])
+        assert doc["ok"] is True
+        assert doc["restarts"] >= 1, doc
+        a1, last = doc["attempts"][0], doc["attempts"][-1]
+        # attempt 1 died to the in-flight SIGTERM (rc 3 handoff)
+        assert a1["rc"] == 3 and a1["interrupted"] == 15, a1
+        assert last["rc"] == 0, last
+        # exactly-once: across every daemon life the 8 client jobs
+        # produced exactly 8 fits — the replayed key added none
+        assert doc["fits_total"] == 8, doc
+        assert sum(a["completed"] or 0 for a in doc["attempts"]) == 8
+        # the restarted daemon answered from the dedup journal
+        assert last["journal_hits"] >= 1, last
+        assert last["dedup_hits"] >= 1, last
         # the kill token is one-shot: consumed by the first SIGTERM
         assert not token.exists()
 
