@@ -53,7 +53,7 @@ import numpy as np
 from pint_tpu import faultinject, metrics, profiling, runtime, telemetry
 from pint_tpu.exceptions import (GatewayBadRequest, GatewayError,
                                  GatewayIdempotencyConflict,
-                                 GatewayQuotaExceeded,
+                                 GatewayQuotaExceeded, ServeCancelled,
                                  ServeDeadlineExceeded, ServeDrained,
                                  ServeOverCapacity, ServeSaturated)
 from pint_tpu.logging import child as _logchild
@@ -75,6 +75,13 @@ _JOURNAL_SIG = "pint_tpu.gateway journal v1"
 #: generous (cold compiles on 1 CPU take tens of seconds), but finite
 #: so a wedged future cannot park the resolver forever
 _RESOLVE_TIMEOUT_S = 600.0
+
+#: long-daemon memory bounds: per-tenant latency samples kept for the
+#: percentile stats, and distinct tenant buckets kept before the
+#: longest-idle bucket is evicted (a returning evicted tenant starts
+#: from a full bucket — a bounded-memory tradeoff, not a quota bypass)
+_LAT_KEEP = 512
+_TENANT_CAP = 1024
 
 
 # --- job serialization --------------------------------------------------------
@@ -314,7 +321,8 @@ class Gateway:
     def __init__(self, service, *, quota: Optional[float] = None,
                  window_s: Optional[float] = None,
                  journal: Optional[str] = None,
-                 prepared_cache_size: int = 256):
+                 prepared_cache_size: int = 256,
+                 job_retention: int = 4096):
         if quota is None:
             quota = float(os.environ.get("PINT_TPU_GATEWAY_QUOTA",
                                          "8") or 8)
@@ -332,6 +340,14 @@ class Gateway:
         self._tenants: Dict[str, TokenBucket] = {}
         self._jobs: Dict[str, dict] = {}
         self._by_key: Dict[str, str] = {}
+        #: per-key admission claims: one idempotency key admits under
+        #: exactly one claim at a time, so a concurrent retry waits
+        #: for the original to register instead of double-fitting
+        self._inflight: Dict[str, threading.Event] = {}
+        #: resolved job ids in resolution order — the eviction queue
+        #: that keeps the live table bounded in a long-running daemon
+        self._done_order: List[str] = []
+        self._retention = max(int(job_retention), 1)
         self._prepared: "Dict[str, object]" = {}
         self._prepared_order: List[str] = []
         self._prepared_cap = int(prepared_cache_size)
@@ -353,6 +369,7 @@ class Gateway:
         }
         self._codes: Dict[str, Dict[str, int]] = {}
         self._lat: Dict[str, List[float]] = {}
+        self._lat_n: Dict[str, int] = {}
         self._depth = {p: 0 for p in PRIORITIES}
         self._resolveq: "queue.Queue[Optional[str]]" = queue.Queue()
         self._resolver: Optional[threading.Thread] = None
@@ -364,11 +381,16 @@ class Gateway:
     # -- admission (HTTP-free core, driven by the handler) -----------------
 
     def _bucket(self, tenant: str) -> TokenBucket:
-        b = self._tenants.get(tenant)
-        if b is None:
-            b = self._tenants[tenant] = TokenBucket(self.quota,
-                                                   self.window_s)
-        return b
+        with self._lock:
+            b = self._tenants.get(tenant)
+            if b is None:
+                while len(self._tenants) >= _TENANT_CAP:
+                    idle = min(self._tenants,
+                               key=lambda t: self._tenants[t]._t)
+                    del self._tenants[idle]
+                b = self._tenants[tenant] = TokenBucket(
+                    self.quota, self.window_s)
+            return b
 
     def _prepare_cached(self, payload: dict, crc: str):
         """payload-CRC-keyed PreparedJob LRU: one prepare per distinct
@@ -400,13 +422,50 @@ class Gateway:
         """Admit one job; returns ``{"job_id", "trace_id", "dedup"}``.
         Raises the typed gateway/serve errors the HTTP layer maps to
         status codes (429/409/400/503/504)."""
-        self._stats["requests_total"] += 1
+        with self._lock:
+            self._stats["requests_total"] += 1
         crc = payload_crc(payload)
-        if idem_key:
+        if not idem_key:
+            return self._admit(payload, crc, tenant=tenant,
+                               priority=priority,
+                               deadline_s=deadline_s, idem_key=None,
+                               trace_id=trace_id)
+        # per-key claim: dedup lookup and job registration for one
+        # idempotency key form a single critical section — a client
+        # retry racing its still-running original (socket timeout,
+        # then retry while the first POST is mid-admission) waits for
+        # the original to register and then dedups against it, so one
+        # key can never double-fit
+        while True:
+            with self._lock:
+                claim = self._inflight.get(idem_key)
+                if claim is None:
+                    self._inflight[idem_key] = threading.Event()
+                    break
+            claim.wait(timeout=_RESOLVE_TIMEOUT_S)
+        try:
             hit = self._dedup_lookup(idem_key, crc)
             if hit is not None:
                 profiling.count(f"gateway.request.{tenant}.202")
                 return hit
+            return self._admit(payload, crc, tenant=tenant,
+                               priority=priority,
+                               deadline_s=deadline_s,
+                               idem_key=idem_key, trace_id=trace_id)
+        finally:
+            with self._lock:
+                claim = self._inflight.pop(idem_key, None)
+            if claim is not None:
+                claim.set()
+
+    def _admit(self, payload: dict, crc: str, *, tenant: str,
+               priority: str, deadline_s: Optional[float],
+               idem_key: Optional[str],
+               trace_id: Optional[str]) -> dict:
+        """The admission body (quota -> deadline -> prepare ->
+        register).  Keyed callers hold the per-key claim taken in
+        :meth:`submit`, which makes the dedup-miss -> registration
+        window atomic against concurrent retries of the same key."""
         ok, retry_after = self._bucket(tenant).admit(priority)
         if not ok:
             raise GatewayQuotaExceeded(
@@ -443,11 +502,30 @@ class Gateway:
                 "kind": "accept", "key": idem_key, "job_id": job_id,
                 "payload_crc": crc, "tenant": tenant,
                 "priority": priority, "payload": payload})
+            # payload deliberately NOT mirrored: re-admission only
+            # ever replays payloads across a restart (journal load),
+            # and an unresolved live record is never evicted — so the
+            # in-memory mirror stays small per key
+            with self._lock:
+                self._mirror_journal_locked(
+                    idem_key, job_id=job_id, payload_crc=crc,
+                    tenant=tenant, priority=priority)
         telemetry.event("gateway.admit", job_id=job_id, tenant=tenant,
                         priority=priority, trace_id=trace_id)
         self._resolveq.put(job_id)
         self._ensure_resolver()
         return {"job_id": job_id, "trace_id": trace_id, "dedup": False}
+
+    def _mirror_journal_locked(self, key: str, **fields) -> None:
+        """Mirror a journal append into the in-memory journal state,
+        so dedup lookups and ``job_status`` keep answering for keyed
+        jobs after their live-table record is evicted (the on-disk
+        journal is the durable copy; this map is its index)."""
+        ent = self._journal_state.setdefault(key, {
+            "job_id": None, "payload_crc": None, "tenant": None,
+            "priority": None, "payload": None, "result": None,
+            "error": None})
+        ent.update(fields)
 
     def _dedup_lookup(self, key: str, crc: str) -> Optional[dict]:
         """Idempotent replay: same key -> original job id (and its
@@ -568,9 +646,28 @@ class Gateway:
         fut = rec["_future"]
         try:
             r = fut.result(timeout=_RESOLVE_TIMEOUT_S)
+        except ServeCancelled:
+            # the shed_pending restart handoff: the job is NOT
+            # resolved — its journal 'accept' record re-admits it in
+            # the next daemon life.  A terminal 'resolve' record here
+            # would make recover()/_dedup_lookup treat the key as
+            # settled and serve the cancellation to the client's
+            # idempotent retry forever, so none is written.
+            with self._lock:
+                if rec["state"] != "queued":
+                    return
+                rec["state"] = "shed"
+                rec["resolved_at"] = time.monotonic()
+                self._depth[rec["priority"]] = \
+                    self._depth.get(rec["priority"], 1) - 1
+            profiling.count(
+                f"gateway.queue_depth.{rec['priority']}", -1)
+            return
         except Exception as e:
             err = {"type": type(e).__name__, "message": str(e)}
             with self._lock:
+                if rec["state"] != "queued":
+                    return
                 rec["state"] = "error"
                 rec["error"] = err
                 rec["resolved_at"] = time.monotonic()
@@ -583,9 +680,17 @@ class Gateway:
                 self.journal.append({"kind": "resolve",
                                      "key": rec["key"],
                                      "job_id": job_id, "error": err})
+                with self._lock:
+                    self._mirror_journal_locked(
+                        rec["key"], job_id=job_id, error=err)
+            with self._lock:
+                self._done_order.append(job_id)
+                self._evict_resolved_locked()
             return
         doc = _result_doc(r)
         with self._lock:
+            if rec["state"] != "queued":
+                return
             rec["state"] = "done"
             rec["result"] = doc
             rec["resolved_at"] = time.monotonic()
@@ -593,12 +698,42 @@ class Gateway:
             self._stats["fits"] += 1
             self._depth[rec["priority"]] = \
                 self._depth.get(rec["priority"], 1) - 1
-            self._lat.setdefault(rec["tenant"], []).append(
-                rec["resolved_at"] - rec["submitted_at"])
+            lat = self._lat.setdefault(rec["tenant"], [])
+            lat.append(rec["resolved_at"] - rec["submitted_at"])
+            if len(lat) > _LAT_KEEP:
+                del lat[:len(lat) - _LAT_KEEP]
+            self._lat_n[rec["tenant"]] = \
+                self._lat_n.get(rec["tenant"], 0) + 1
         profiling.count(f"gateway.queue_depth.{rec['priority']}", -1)
         if self.journal is not None and rec["key"]:
             self.journal.append({"kind": "resolve", "key": rec["key"],
                                  "job_id": job_id, "result": doc})
+            with self._lock:
+                self._mirror_journal_locked(
+                    rec["key"], job_id=job_id, result=doc)
+        with self._lock:
+            self._done_order.append(job_id)
+            self._evict_resolved_locked()
+
+    def _evict_resolved_locked(self) -> None:
+        """Bound the live job table (the long-daemon memory guard):
+        resolved records beyond the retention cap are dropped
+        oldest-resolved-first.  Keyed records are dropped only when
+        the journal holds their durable copy (and the journal-state
+        mirror keeps answering dedup/status for them); without a
+        journal the live table IS the dedup store, so keyed records
+        are exempt."""
+        while len(self._done_order) > self._retention:
+            jid = self._done_order.pop(0)
+            rec = self._jobs.get(jid)
+            if rec is None:
+                continue
+            key = rec.get("key")
+            if key and self.journal is None:
+                continue   # sole dedup copy: exempt from eviction
+            self._jobs.pop(jid, None)
+            if key:
+                self._by_key.pop(key, None)
 
     def settle_done(self) -> None:
         """Synchronously journal every already-resolved future (the
@@ -667,6 +802,7 @@ class Gateway:
             s["queue_depth"] = dict(self._depth)
             s["codes"] = {t: dict(c) for t, c in self._codes.items()}
             lat = {t: list(v) for t, v in self._lat.items()}
+            lat_n = dict(self._lat_n)
             s["pending"] = sum(1 for r in self._jobs.values()
                                if r["state"] == "queued")
         s["journal_skipped"] = self.journal.skipped \
@@ -674,7 +810,8 @@ class Gateway:
         s["tenants"] = {}
         for t, samples in lat.items():
             ls = profiling.latency_stats(samples)
-            s["tenants"][t] = {"completed": len(samples),
+            s["tenants"][t] = {"completed": lat_n.get(t,
+                                                     len(samples)),
                                "p50_ms": ls["p50_ms"],
                                "p99_ms": ls["p99_ms"]}
         return s

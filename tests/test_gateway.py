@@ -14,6 +14,7 @@ selects both; ``PINT_TPU_SKIP_GATEWAY=1`` opts out).
 """
 
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -379,6 +380,94 @@ class TestJournalReplay:
                            idem_key=_key("lives2"))
         assert fresh["job_id"] != out["job_id"], fresh
         assert int(fresh["job_id"][1:]) > int(out["job_id"][1:])
+
+
+class TestIdempotencyRace:
+    def test_concurrent_same_key_admits_exactly_once(self, front):
+        """A retry racing its still-running original: N concurrent
+        submissions of ONE idempotency key admit exactly one job — the
+        per-key claim closes the dedup check-then-act window that
+        would otherwise double-fit."""
+        gw, payloads, _ = front
+        key = _key("race")
+        before = gw.stats()["accepted"]
+        outs, errs = [], []
+        barrier = threading.Barrier(6)
+
+        def go():
+            barrier.wait(timeout=30.0)
+            try:
+                outs.append(gw.submit(payloads[0], tenant="race",
+                                      idem_key=key))
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=go, daemon=True)
+              for _ in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120.0)
+        assert not errs, errs
+        assert len(outs) == 6
+        assert len({o["job_id"] for o in outs}) == 1
+        assert sum(1 for o in outs if not o["dedup"]) == 1
+        assert gw.stats()["accepted"] == before + 1
+        _wait_done(gw, outs[0]["job_id"])
+
+
+class TestRestartHandoff:
+    def test_shed_jobs_readmit_next_life_not_resolved(self, front,
+                                                      tmp_path):
+        """A job shed at SIGTERM must NOT be journaled as a terminal
+        resolve: only its 'accept' record survives, so the next daemon
+        life re-admits it under the original job id and the fit
+        happens exactly once — the restart-handoff half of the
+        exactly-once contract.  A bare un-started service over the
+        module program cache stands in for the pre-SIGTERM daemon
+        (queued, never dispatched)."""
+        from pint_tpu.serve import TimingService
+
+        _, payloads, ctrl = front
+        svc = TimingService(batch_size=2, maxiter=3, max_wait_ms=25.0,
+                            program_cache=_PROGRAMS)
+        payload = payloads[0]
+        journal = str(tmp_path / "shed.jsonl")
+        gw1 = Gateway(svc, quota=64.0, journal=journal)
+        key = _key("shed")
+        out = gw1.submit(payload, tenant="handoff", idem_key=key)
+        # the service is never started in this life, so the job sits
+        # queued — exactly the SIGTERM shed_pending() window
+        assert gw1.shed_pending() == 1
+        gw1.settle_done()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if gw1.job_status(out["job_id"])["state"] != "queued":
+                break
+            time.sleep(0.01)
+        assert gw1.job_status(out["job_id"])["state"] == "shed"
+        gw1.stop()
+        ent = DedupJournal(journal).load()[key]
+        assert ent["result"] is None and not ent["error"], ent
+        gw2 = Gateway(svc, quota=64.0, journal=journal)
+        gw2._prepared = gw1._prepared       # share the payload LRU
+        gw2._prepared_order = list(gw1._prepared_order)
+        assert gw2.recover() == 1
+        assert gw2.stats()["journal_resumed"] == 1
+        st = gw2.job_status(out["job_id"])
+        assert st is not None and st["state"] == "queued", st
+        svc.start()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = gw2.job_status(out["job_id"])
+            if st["state"] in ("done", "error"):
+                break
+            time.sleep(0.02)
+            gw2.settle_done()
+        assert st["state"] == "done", st
+        assert st["result"]["chi2_hex"] == ctrl[payload["name"]]
+        gw2.stop()
+        svc.drain(timeout=60.0)
 
 
 class TestSteadyStateContract:
