@@ -1192,6 +1192,22 @@ def bench_quick(backend_status=None):
                         "wall_s": round(time.time() - t1, 2)}
         except Exception as e:  # keep the quick line alive
             precflow = {"error": f"{type(e).__name__}: {e}"}
+    # the concurrency & signal-safety audit (ISSUE 20): the whole
+    # package must show zero LOCK001/LOCK002/SIG001/HOOK001 findings —
+    # the serve plane's thread-safety as a boolean regression axis
+    if fast:
+        concurrency = {"skipped": "PINT_TPU_BENCH_FAST=1"}
+    else:
+        try:
+            t1 = time.time()
+            from pint_tpu.lint.concurrency import audit_concurrency
+
+            cf = audit_concurrency()
+            concurrency = {"concurrency_clean": not cf,
+                           "findings": [x.format() for x in cf],
+                           "wall_s": round(time.time() - t1, 2)}
+        except Exception as e:  # keep the quick line alive
+            concurrency = {"error": f"{type(e).__name__}: {e}"}
     # supervised-acquisition provenance (ISSUE 4): how the backend was
     # obtained — a wedged-probe run shows up as backend_rung
     # "cpu_fallback" with attempts > 1 instead of a null metric
@@ -1280,12 +1296,18 @@ def bench_quick(backend_status=None):
         # disable_x64() + policy("dd32")); null when the leg was
         # skipped/failed
         "precflow_clean": precflow.get("precflow_clean"),
+        # concurrency audit verdict (ISSUE 20): True when the package
+        # shows zero LOCK001/LOCK002/SIG001/HOOK001 findings (lock-
+        # guard inference, lock-order cycles, signal/hook hazards);
+        # null when the leg was skipped/failed
+        "concurrency_clean": concurrency.get("concurrency_clean"),
         "submetrics": {"fleet": fleet, "aot_cold_start": aot_cold,
                        "comm_profile": comm, "serve": serve,
                        "gateway": gateway,
                        "telemetry": telemetry_cost,
                        "cost_cards": cost_cards, "pta": pta_leg,
-                       "precflow": precflow},
+                       "precflow": precflow,
+                       "concurrency": concurrency},
     }
 
 

@@ -232,6 +232,23 @@ _SLOW_TESTS = {
     # variant-parity legs exercising the same DD core stay tier-1, and
     # the BTX-family depth file already rides the slow tier)
     ("test_binary_dd.py", "TestVariants::test_bt_equals_dd_without_extras"),
+    # tier-1 re-tune (2026-08, PR 20: the concurrency audit gate lands
+    # ~16 s of new tier-1 work — tests/test_concurrency.py plus the
+    # bench --quick concurrency leg — under the 850 s wall guard;
+    # measured slowest-10 offenders whose headline property stays
+    # covered by a cheaper tier-1 neighbour) — the all-components
+    # parfile round-trip matrix (5.8 s; the per-component round-trip
+    # legs — multi-EFAC parfile, aux-component pickle/parfile — stay
+    # tier-1),
+    ("test_components.py", "TestParfileRoundTrip::test_all_components_roundtrip"),
+    # the chi2-through-the-fit-loop scaled-errors depth leg (5.1 s;
+    # test_efac_equad_scaling keeps the EFAC/EQUAD scaling formula
+    # itself tier-1),
+    ("test_noise_model.py", "test_chi2_uses_scaled_errors"),
+    # and the SWX range/normalization matrix (4.5 s; the SWXP
+    # validation leg stays tier-1 and the SWM1 depth file already
+    # rides the slow tier)
+    ("test_aux_components.py", "TestSWX::test_ranges_and_normalization"),
 }
 
 
@@ -331,6 +348,13 @@ def pytest_configure(config):
         "rides tier-1; the two-process kill-midflight / chaos-sweep "
         "depth legs ride the slow test_tooling.py; run all with "
         "-m gateway, skip WIP branches with PINT_TPU_SKIP_GATEWAY=1)")
+    config.addinivalue_line(
+        "markers",
+        "concurrency: the concurrency & signal-safety audit gate "
+        "(tests/test_concurrency.py rides tier-1; the CLI + seeded "
+        "lock-order-invert subprocess legs ride the slow "
+        "test_tooling.py; run all with -m concurrency, skip WIP "
+        "branches with PINT_TPU_SKIP_CONCURRENCY=1)")
 
 
 # --- tier-1 wall budget ------------------------------------------------------
@@ -568,6 +592,20 @@ def pytest_collection_modifyitems(config, items):
             if os.environ.get("PINT_TPU_SKIP_PRECFLOW") == "1":
                 item.add_marker(_pytest.mark.skip(
                     reason="PINT_TPU_SKIP_PRECFLOW=1"))
+        if fname == "test_concurrency.py" or (
+                fname == "test_tooling.py" and getattr(
+                    item, "cls", None) is not None
+                and item.cls.__name__ == "TestConcurrencyGate"):
+            # the concurrency & signal-safety gate: the static-rule +
+            # in-process lockhooks legs ride tier-1
+            # (test_concurrency.py, ~3 s), the CLI subprocess + the
+            # ~50 s lock_order_invert/racy_schedule serve-check legs
+            # ride the slow test_tooling.py; ``-m concurrency``
+            # selects both
+            item.add_marker(_pytest.mark.concurrency)
+            if os.environ.get("PINT_TPU_SKIP_CONCURRENCY") == "1":
+                item.add_marker(_pytest.mark.skip(
+                    reason="PINT_TPU_SKIP_CONCURRENCY=1"))
         if fname == "test_lint.py":
             # the static-analysis gate rides in the smoke tier so every
             # tier-1 run enforces the precision/trace-safety invariants;

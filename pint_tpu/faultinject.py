@@ -57,6 +57,7 @@ __all__ = ["wrap", "is_active", "nan_sigma", "nan_wls_solver",
            "recorder_crash", "nan_gwb_draw", "corrupt_sim_chunk",
            "poison_batch_member", "oom_dispatch", "slow_dispatch",
            "silent_result_bias", "kill_daemon",
+           "racy_schedule", "lock_order_invert",
            "gateway_drop_connection", "gateway_slow_response",
            "tenant_flood", "main"]
 
@@ -790,6 +791,95 @@ def kill_daemon() -> Iterator[None]:
         yield
 
 
+#: the racy-schedule jitter RNG — MODULE state (``wrap`` re-invokes the
+#: factory per call site, so a factory-local RNG would replay its first
+#: draw forever); seeded once per process from PINT_TPU_RACY_SEED
+_RACY_RNG = None
+
+
+def _racy_schedule_factory(fn):
+    """Tiny seeded sleep (0..2 ms) at every traced-lock acquire
+    boundary — poor-man's TSan: the jitter widens the window between
+    check and act so latent races become repeatable, while staying
+    timing-only (no result may change, no job may error).  The hook
+    site lives in ``lint.lockhooks.LockAudit._attempt``; activating
+    this failpoint also turns the lock audit on for ``serve check`` /
+    ``gateway check`` (see ``lockhooks.maybe_instrument``)."""
+    def jitter(*args, **kwargs):
+        global _RACY_RNG
+        import os
+        import random as _random
+        import time as _time
+
+        if _RACY_RNG is None:
+            _RACY_RNG = _random.Random(
+                int(os.environ.get("PINT_TPU_RACY_SEED", "0")))
+        _time.sleep(_RACY_RNG.random() * 0.002)
+        return fn(*args, **kwargs)
+    return jitter
+
+
+@contextlib.contextmanager
+def racy_schedule() -> Iterator[None]:
+    """Failpoint ``"racy_schedule"``: seeded scheduling jitter at lock
+    acquire boundaries (see ``pint_tpu.lint.lockhooks``), amplifying
+    race windows during a lock-audited ``serve check``.
+    Env-activatable (``PINT_TPU_FAULTS=racy_schedule``; seed with
+    ``PINT_TPU_RACY_SEED``)."""
+    with _registered("racy_schedule", _racy_schedule_factory):
+        yield
+
+
+def _lock_order_invert_factory(fn):
+    """Deterministic two-lock / two-thread inverted acquisition, run
+    once when the lock audit's instrumented window opens: thread 1
+    takes A then B, thread 2 takes B then A, with 0.2 s acquire
+    timeouts so the cycle is RECORDED by the audit (edges land at
+    acquire attempt) without the process ever deadlocking.  This is the
+    lock-audit NEGATIVE CONTROL: a ``serve check`` leg under this
+    failpoint must exit 1 with a CONTRACT005 finding naming both lock
+    sites and both threads.  Deliberately NOT in the sweep's default
+    fault set — ``sweep --inject lock_order_invert`` drives it."""
+    def invert(*args, **kwargs):
+        import threading as _threading
+        import time as _time
+
+        lock_a = _threading.Lock()
+        lock_b = _threading.Lock()
+
+        def fwd():
+            with lock_a:
+                _time.sleep(0.05)
+                if lock_b.acquire(timeout=0.2):
+                    lock_b.release()
+
+        def rev():
+            with lock_b:
+                _time.sleep(0.05)
+                if lock_a.acquire(timeout=0.2):
+                    lock_a.release()
+
+        t1 = _threading.Thread(target=fwd, name="lock-order-invert-1")
+        t2 = _threading.Thread(target=rev, name="lock-order-invert-2")
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        return fn(*args, **kwargs)
+    return invert
+
+
+@contextlib.contextmanager
+def lock_order_invert() -> Iterator[None]:
+    """Failpoint ``"lock_order_invert"``: the lock audit's instrumented
+    window runs a seeded inverted-order acquisition pair (see
+    ``pint_tpu.lint.lockhooks.instrument``), so the audited check leg
+    must fail loudly with CONTRACT005 attribution.  Env-activatable
+    (``PINT_TPU_FAULTS=lock_order_invert``)."""
+    with _registered("lock_order_invert", _lock_order_invert_factory):
+        yield
+
+
 #: idempotency keys whose admission response was already dropped —
 #: MODULE state, not factory state: ``wrap`` invokes the factory on
 #: every call, so once-per-key memory must live here
@@ -899,6 +989,8 @@ _ENV_FACTORIES = {
     "slow_dispatch": _slow_dispatch_factory,
     "silent_result_bias": _silent_result_bias_factory,
     "kill_daemon": _kill_daemon_factory,
+    "racy_schedule": _racy_schedule_factory,
+    "lock_order_invert": _lock_order_invert_factory,
     "gateway_drop_connection": _gateway_drop_connection_factory,
     "gateway_slow_response": _gateway_slow_response_factory,
     "tenant_flood": _tenant_flood_factory,
@@ -962,12 +1054,17 @@ def corrupt_mjds(toas, rows: Sequence[int]) -> Iterator[None]:
 
 #: the serve-plane failpoints the chaos sweep drives by default — the
 #: env-activatable subset that perturbs a ``serve check`` run.  The
-#: silent-corruption negative control (``silent_result_bias``) and the
+#: silent-corruption negative control (``silent_result_bias``), the
+#: lock-audit negative control (``lock_order_invert``) and the
 #: supervise-leg kill switch (``kill_daemon``) are deliberately
-#: excluded: the first exists to prove the judge CATCHES silent
-#: corruption (``--inject`` adds it), the second needs a token file.
+#: excluded: the first two exist to prove the judges CATCH silent
+#: corruption / an order inversion (``--inject`` adds them), the third
+#: needs a token file.  ``racy_schedule`` IS in the default set: it is
+#: timing-only (seeded jitter at lock-acquire boundaries under the
+#: lock audit), so a clean serve plane must come through bit-identical.
 _SWEEP_FAULTS = ("request_flood", "stalled_bucket", "recorder_crash",
-                 "poison_batch_member", "oom_dispatch", "slow_dispatch")
+                 "poison_batch_member", "oom_dispatch", "slow_dispatch",
+                 "racy_schedule")
 
 #: the network-boundary failpoints the sweep drives against ``gateway
 #: check`` (ISSUE 19): a dropped admission response recovered by an
@@ -1099,9 +1196,18 @@ def _sweep_judge(leg, faults, rc, doc, stderr, base_by_name):
             f"(rc={rc}); stderr tail: {' | '.join(tail)}")
         return problems
     if rc != 0:
-        problems.append(
-            f"[{leg}] rc={rc}: jobs unaccounted for — a fault must "
-            "surface as a typed per-job error, not a failed run")
+        audit = [ln for ln in (stderr or "").splitlines()
+                 if "CONTRACT005" in ln]
+        if audit:
+            # the dynamic lock audit flipped the check: attribute the
+            # observed cycle / dispatch-under-lock, not the job count
+            problems.append(
+                f"[{leg}] rc={rc}: concurrency audit findings — "
+                + "; ".join(audit))
+        else:
+            problems.append(
+                f"[{leg}] rc={rc}: jobs unaccounted for — a fault must "
+                "surface as a typed per-job error, not a failed run")
     for key, ent in (doc.get("results") or {}).items():
         if ent.get("flagged"):
             continue   # typed error or loud degradation: exempt
@@ -1166,6 +1272,14 @@ def _sweep_expect_single(fault, doc):
             problems.append(
                 f"[{fault}] timer flushes must serve every job "
                 f"normally, got errors {errors}")
+    elif fault == "racy_schedule":
+        # timing-only jitter under the lock audit: every job completes
+        # normally AND the audited leg saw no lock-order cycle / no
+        # dispatch-under-lock (rc != 0 is already judged globally)
+        if errors:
+            problems.append(
+                f"[{fault}] schedule jitter is timing-only — every "
+                f"job must complete normally, got errors {errors}")
     return problems
 
 
@@ -1204,8 +1318,8 @@ def main(argv=None) -> int:
                     help="number of seeded two-fault legs")
     sw.add_argument("--inject", action="append", default=[],
                     help="extra failpoint(s) to sweep as single-fault "
-                         "legs (e.g. the silent_result_bias negative "
-                         "control)")
+                         "legs (e.g. the silent_result_bias / "
+                         "lock_order_invert negative controls)")
     sw.add_argument("--timeout-s", type=float, default=240.0)
     sw.add_argument("--no-gateway", action="store_true",
                     help="skip the network-boundary legs (gateway "

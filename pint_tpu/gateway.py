@@ -1057,6 +1057,26 @@ def _demo_payloads():
 
 
 def _check(args) -> int:
+    """``gateway check``: :func:`_check_body` under the dynamic lock
+    audit (see ``pint_tpu.serve._check`` — same wrapper contract:
+    CONTRACT005 findings to stderr, stdout stays one JSON line, any
+    finding forces rc 1)."""
+    import sys
+
+    from pint_tpu.lint import lockhooks
+
+    with lockhooks.maybe_instrument() as audit:
+        rc = _check_body(args)
+    if audit is not None:
+        findings = audit.judge()
+        for f in findings:
+            print(f.format(), file=sys.stderr)
+        if findings:
+            return 1
+    return rc
+
+
+def _check_body(args) -> int:
     """``gateway check``: in-process service + loopback HTTP gateway +
     resilient clients -> one JSON line (the chaos-sweep leg for the
     gateway failpoints).  The ``tenant_flood`` failpoint adds a burst

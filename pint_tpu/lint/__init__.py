@@ -33,6 +33,18 @@ both).  This package makes those conventions *checked properties*:
   out of bare f32, both with native x64 and rebuilt under
   ``disable_x64()`` + ``precision.policy("dd32")``
   (``--precflow`` / ``--list-precision-contracts``).
+* Concurrency & signal-safety audit (:mod:`pint_tpu.lint.concurrency`,
+  ``--concurrency[=modules]``): **LOCK001** writes to a lock-guarded
+  attribute (guard inferred from which lock dominates its write
+  sites) on thread-reachable paths without the lock, plus unlocked
+  check-then-act; **LOCK002** cycles in the static lock-acquisition-
+  order graph; **SIG001** signal-handler lock/blocking hazards;
+  **HOOK001** hook re-entrancy and hooks-under-registry-lock.  The
+  dynamic companion (:mod:`pint_tpu.lint.lockhooks`) traces real lock
+  acquisitions during ``serve check`` / ``gateway check``
+  (``PINT_TPU_LOCKAUDIT=1`` or the ``racy_schedule`` /
+  ``lock_order_invert`` failpoints) and judges observed cycles and
+  dispatch-under-lock as **CONTRACT005**.
 
 Run it::
 
@@ -54,6 +66,13 @@ from pint_tpu.lint.astrules import (  # noqa: F401
     lint_paths,
     lint_source,
 )
+from pint_tpu.lint.concurrency import (  # noqa: F401
+    RULES_CONCURRENCY,
+    audit_concurrency,
+    lint_concurrency_file,
+    lint_concurrency_paths,
+    lint_concurrency_source,
+)
 from pint_tpu.lint.baseline import (  # noqa: F401
     apply_baseline,
     default_baseline_path,
@@ -65,11 +84,15 @@ from pint_tpu.lint.findings import Finding, scan_suppressions  # noqa: F401
 __all__ = [
     "Finding", "RULES", "PRECISION_MODULES", "lint_source", "lint_file",
     "lint_paths", "scan_suppressions", "load_baseline", "write_baseline",
-    "apply_baseline", "default_baseline_path",
+    "apply_baseline", "default_baseline_path", "RULES_CONCURRENCY",
+    "audit_concurrency", "lint_concurrency_source",
+    "lint_concurrency_file", "lint_concurrency_paths",
 ]
 
 # NOTE: pint_tpu.lint.precflow (audit_precision, analyze_fn, the
 # precision lattice) and pint_tpu.lint.contracts (precision_contract,
 # PRECISION_REGISTRY) import jax at audit time and are deliberately
 # not re-exported here — `import pint_tpu.lint` stays jax-free for the
-# AST-only fast path.
+# AST-only fast path.  pint_tpu.lint.lockhooks (the dynamic
+# CONTRACT005 lock audit) pulls in pint_tpu.faultinject/profiling and
+# is likewise left to its call sites (serve/gateway `_check`).

@@ -118,6 +118,28 @@ RULES = {
     "PREC003": "precision-flow audit: a double-double pair is broken — "
                "the hi word is consumed without its lo partner outside "
                "the sanctioned dd/qs kernels",
+    "LOCK001": "concurrency audit: write/read-modify-write of a "
+               "lock-guarded attribute (guard inferred from the lock "
+               "dominating its write sites) on a thread-reachable path "
+               "without that lock held, or an unlocked check-then-act "
+               "on shared state in a lock-owning class",
+    "LOCK002": "concurrency audit: cycle in the static lock-"
+               "acquisition-order graph (nested with blocks propagated "
+               "through the module-local call graph) — potential "
+               "deadlock, both edges named",
+    "SIG001": "concurrency audit: signal-handler-reachable code "
+              "acquires a non-reentrant lock also taken on the main "
+              "path, or does unbounded blocking I/O (join/wait/acquire "
+              "with no timeout)",
+    "HOOK001": "concurrency audit: a profiling/telemetry hook "
+               "callback re-enters profiling.count, or hooks are "
+               "invoked while holding the registry lock (the PR 11 "
+               "'hooks called OUTSIDE the lock' invariant)",
+    "CONTRACT005": "dynamic lock audit (lint.lockhooks, via serve/"
+                   "gateway check under PINT_TPU_LOCKAUDIT=1 or a "
+                   "concurrency failpoint): observed lock-order cycle "
+                   "or device dispatch while holding a traced lock, "
+                   "with thread + allocation-site attribution",
 }
 
 PRECISION_MODULES = {

@@ -21,6 +21,11 @@ Modes:
   native x64 AND under ``disable_x64()`` + ``policy("dd32")``, and
   report phase-critical bare-f32 collapses (PREC002) and broken dd
   pairs (PREC003).
+* ``--concurrency[=MODULE[,MODULE]]`` — the concurrency & signal-
+  safety audit (:mod:`pint_tpu.lint.concurrency`): lock-guard
+  inference (LOCK001), static lock-order cycles (LOCK002), signal-
+  handler lock/blocking hazards (SIG001), and hook re-entrancy
+  (HOOK001) over the whole package or the named modules.
 
 Rule filtering: ``--select CODE[,CODE]`` keeps only those codes,
 ``--ignore CODE[,CODE]`` drops them (select wins when both name a
@@ -55,7 +60,10 @@ def _build_parser() -> argparse.ArgumentParser:
                     "audit, the CONTRACT001-CONTRACT004 dispatch-"
                     "contract audit incl. the warm-from-store cold-start "
                     "axis and the SPMD collective-communication budgets, "
-                    "and the PREC002/PREC003 precision-flow audit). "
+                    "the PREC002/PREC003 precision-flow audit, and the "
+                    "LOCK001/LOCK002/SIG001/HOOK001 concurrency & "
+                    "signal-safety audit with its CONTRACT005 dynamic "
+                    "lock-order companion). "
                     "Exit codes: 0 clean (always 0 with "
                     "--update-baseline), 1 new findings, 2 usage error.")
     ap.add_argument("paths", nargs="*",
@@ -98,6 +106,14 @@ def _build_parser() -> argparse.ArgumentParser:
                          "entrypoint (or the named subset) with native "
                          "x64 and under disable_x64()+policy('dd32'), "
                          "and report PREC002/PREC003 findings")
+    ap.add_argument("--concurrency", nargs="?", const="all",
+                    default=None, metavar="MODULE[,MODULE]",
+                    help="run the concurrency & signal-safety audit "
+                         "instead of the AST precision rules: lock-"
+                         "guard inference, lock-order cycles, signal-"
+                         "handler hazards and hook re-entrancy over "
+                         "the package (or the named modules, e.g. "
+                         "serve,gateway)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
     ap.add_argument("--list-contracts", action="store_true",
@@ -186,6 +202,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         except KeyError as exc:
             print(f"pint-tpu-lint: {exc}", file=sys.stderr)
             return 2
+    elif args.concurrency is not None:
+        from pint_tpu.lint.concurrency import (
+            audit_concurrency, lint_concurrency_paths,
+        )
+
+        if args.paths:
+            # explicit paths win over the module list: lint those files
+            # with the concurrency rules (the seeded-fixture CI leg)
+            for p in args.paths:
+                if not os.path.exists(p):
+                    print(f"pint-tpu-lint: no such path: {p}",
+                          file=sys.stderr)
+                    return 2
+            findings = lint_concurrency_paths(args.paths)
+        else:
+            names = None if args.concurrency == "all" else [
+                n.strip() for n in args.concurrency.split(",")
+                if n.strip()]
+            try:
+                findings = audit_concurrency(names)
+            except KeyError as exc:
+                print(f"pint-tpu-lint: {exc}", file=sys.stderr)
+                return 2
     else:
         paths = args.paths or [_package_dir()]
         for p in paths:

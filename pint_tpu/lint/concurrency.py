@@ -1,0 +1,868 @@
+"""lint v5 — the concurrency & signal-safety auditor (AST layer).
+
+The serve plane is genuinely concurrent: ``serve.py``, ``gateway.py``,
+``telemetry.py``, ``metrics.py`` and ``runtime.py`` share mutable state
+across a ThreadingHTTPServer, a dispatcher daemon, hook callbacks and
+signal handlers — and PR 19's races (the idempotency double-admit, the
+unlocked ``requests_total`` bump) were found by hand, after the fact.
+This module is the gate that catches the next one mechanically.
+
+Rules (all pure-AST, jax-free — safe to run anywhere):
+
+* **LOCK001** — lock-guard inference.  For each class that owns a lock
+  (``self._lock = threading.Lock()`` / ``RLock`` / ``Condition``), every
+  ``self._x`` attribute is mapped to its guarding lock by observing
+  which ``with self._lock:`` block dominates its write sites (strict
+  majority, ``__init__`` exempt — construction happens before
+  publication).  A write / read-modify-write / container mutation of a
+  guarded field on a *thread-reachable* path without that lock held is
+  a finding.  Thread roots: ``Thread(target=...)`` / ``Timer`` bodies,
+  ``do_*`` methods of HTTPRequestHandler subclasses, and callbacks
+  registered via ``add_count_hook`` / ``add_span_end_hook`` /
+  ``profiling._count_hook``.
+* **LOCK002** — static lock-acquisition-order graph.  Nested ``with``
+  blocks contribute direct edges; edges propagate through the
+  module-local call graph (calling ``f()`` while holding A, where ``f``
+  transitively acquires B, adds A -> B).  Any cycle is a potential
+  deadlock, reported once per cycle naming both edges with their
+  acquisition sites.
+* **SIG001** — signal-handler safety.  Code reachable from a registered
+  signal handler (``signal.signal(sig, h)`` sites — the ``SignalFlush``
+  pattern) may not acquire a non-reentrant lock that the main path also
+  takes (the signal can land *while the main thread holds it* — classic
+  self-deadlock), nor make an unbounded blocking call (``.join()`` /
+  ``.wait()`` / ``.acquire()`` with no timeout).
+* **HOOK001** — hook re-entry / registry-lock discipline.  Codifies the
+  PR 11 invariant "hooks are called OUTSIDE the lock": a callback
+  reachable from ``profiling.count`` / ``telemetry.span`` exit must not
+  re-enter ``profiling.count`` (infinite hook recursion), and the
+  emitting side must not invoke a registered hook while holding a
+  lock (``for hook in _count_hooks: hook(...)`` inside ``with _lock:``).
+
+What the AST cannot see — the *observed* acquisition order of real
+threads under a live serving run — is covered by the dynamic layer in
+:mod:`pint_tpu.lint.lockhooks` (CONTRACT005).
+
+Suppression and baseline ride the shared machinery: ``# ddlint:
+disable=LOCK001 <why>`` sanctions a site, and findings participate in
+the checked-in baseline exactly like the other AST rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from pint_tpu.lint.findings import Finding, scan_suppressions
+
+__all__ = ["RULES_CONCURRENCY", "lint_concurrency_source",
+           "lint_concurrency_file", "lint_concurrency_paths",
+           "audit_concurrency"]
+
+#: rule code -> one-line description (merged into ``--list-rules``)
+RULES_CONCURRENCY = {
+    "LOCK001": "guarded-field write without its inferred lock on a "
+               "thread-reachable path (guard = the lock whose with-block "
+               "dominates the attribute's write sites)",
+    "LOCK002": "lock-acquisition-order cycle in the static nested-with "
+               "graph propagated through the module-local call graph "
+               "(potential deadlock; both edges named)",
+    "SIG001": "signal-handler-reachable code acquires a non-reentrant "
+              "lock also taken on the main path, or makes an unbounded "
+              "blocking call (join/wait/acquire with no timeout)",
+    "HOOK001": "count/span hook re-enters profiling.count, or a "
+               "registered hook is invoked while a registry lock is "
+               "held (the 'hooks called OUTSIDE the lock' invariant)",
+}
+
+#: methods whose writes happen before the object is published to other
+#: threads — exempt from guard inference AND from firing
+_CONSTRUCTION = {"__init__", "__new__", "__init_subclass__"}
+
+#: container-mutation method names counted as write sites
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "move_to_end", "sort", "reverse",
+}
+
+#: lock factory names (trailing attribute) -> reentrant?
+_LOCK_FACTORIES = {"Lock": False, "RLock": True, "Condition": False,
+                   "Semaphore": False, "BoundedSemaphore": False}
+
+#: unbounded blocking primitives when called with no timeout (SIG001)
+_BLOCKING = {"join", "wait", "acquire"}
+
+
+def _dotted(node) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _trailing(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _Func:
+    """One function/method/closure and its concurrency-relevant facts."""
+
+    __slots__ = ("name", "qualname", "node", "cls", "parent", "calls",
+                 "thread_reachable", "thread_via",
+                 "sig_reachable", "sig_via",
+                 "hook_reachable", "hook_via",
+                 "acquires", "trans_acquires")
+
+    def __init__(self, name, qualname, node, cls, parent):
+        self.name = name
+        self.qualname = qualname
+        self.node = node
+        self.cls = cls                      # _Cls or None
+        self.parent = parent                # enclosing _Func or None
+        self.calls: Set[Tuple[str, str]] = set()   # ("name", x) | ("self", x)
+        self.thread_reachable = False
+        self.thread_via: Optional[str] = None
+        self.sig_reachable = False
+        self.sig_via: Optional[str] = None
+        self.hook_reachable = False
+        self.hook_via: Optional[str] = None
+        self.acquires: Set[Tuple[str, ...]] = set()       # direct lock ids
+        self.trans_acquires: Set[Tuple[str, ...]] = set()
+
+
+class _Cls:
+    __slots__ = ("name", "node", "bases", "methods", "locks")
+
+    def __init__(self, name, node, bases):
+        self.name = name
+        self.node = node
+        self.bases = bases                  # dotted base-name strings
+        self.methods: Dict[str, _Func] = {}
+        self.locks: Dict[str, str] = {}     # attr -> factory kind
+
+
+class _Index(ast.NodeVisitor):
+    """Pass 1: functions, classes, lock attributes, root registrations."""
+
+    def __init__(self, modname: str):
+        self.modname = modname
+        self.functions: List[_Func] = []
+        self.classes: Dict[str, _Cls] = {}
+        self.module_funcs: Dict[str, _Func] = {}
+        self.module_locks: Dict[str, str] = {}   # name -> factory kind
+        #: (kind, ref-node, cls-at-site, func-at-site, via) to resolve
+        #: in pass 2; kind in {"thread", "hook", "sig"}
+        self.root_refs: List[tuple] = []
+        self._cls_stack: List[_Cls] = []
+        self._fn_stack: List[_Func] = []
+
+    # -- structure -------------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        bases = [_dotted(b) or "" for b in node.bases]
+        rec = _Cls(node.name, node, bases)
+        self.classes[node.name] = rec
+        self._cls_stack.append(rec)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+        if any("RequestHandler" in b for b in rec.bases):
+            # every do_* verb of an HTTP(S) request handler runs on a
+            # server worker thread
+            for mname, m in rec.methods.items():
+                if mname.startswith("do_") or mname == "handle":
+                    self.root_refs.append(
+                        ("thread", m, None, None,
+                         f"{rec.name}.{mname} HTTP handler"))
+
+    def _enter_function(self, node) -> None:
+        cls = self._cls_stack[-1] if self._cls_stack and \
+            not self._fn_stack else None
+        parent = self._fn_stack[-1] if self._fn_stack else None
+        if parent is not None:
+            qual = f"{parent.qualname}.{node.name}"
+        elif cls is not None:
+            qual = f"{cls.name}.{node.name}"
+        else:
+            qual = node.name
+        rec = _Func(node.name, qual, node, cls, parent)
+        self.functions.append(rec)
+        if cls is not None:
+            cls.methods[node.name] = rec
+        elif parent is None:
+            self.module_funcs[node.name] = rec
+        self._fn_stack.append(rec)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _enter_function
+    visit_AsyncFunctionDef = _enter_function
+
+    # -- lock attributes & hook-singleton assignment ---------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        kind = self._lock_factory(node.value)
+        for tgt in node.targets:
+            if kind is not None:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and self._fn_stack and \
+                        self._fn_stack[-1].cls is not None:
+                    self._fn_stack[-1].cls.locks[tgt.attr] = kind
+                elif isinstance(tgt, ast.Name) and not self._fn_stack:
+                    self.module_locks[tgt.id] = kind
+            # ``profiling._count_hook = fn`` — the singleton count hook
+            if _trailing(tgt) == "_count_hook":
+                self.root_refs.append(
+                    ("hook", node.value,
+                     self._cls_stack[-1] if self._cls_stack else None,
+                     self._fn_stack[-1] if self._fn_stack else None,
+                     "_count_hook singleton"))
+        self.generic_visit(node)
+
+    def _lock_factory(self, value) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            name = _trailing(value.func)
+            if name in _LOCK_FACTORIES:
+                return name
+        return None
+
+    # -- root registrations ----------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _trailing(node.func)
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if name in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg in ("target", "function"):
+                    self.root_refs.append(
+                        ("thread", kw.value, cls, fn,
+                         f"threading.{name}(target=...)"))
+            if name == "Timer" and len(node.args) >= 2:
+                self.root_refs.append(
+                    ("thread", node.args[1], cls, fn,
+                     "threading.Timer body"))
+        elif name in ("add_count_hook", "add_span_end_hook") and node.args:
+            self.root_refs.append(
+                ("hook", node.args[0], cls, fn, f"{name}(...)"))
+        elif name == "signal" and len(node.args) >= 2 and \
+                _dotted(node.func) in ("signal.signal", "signal"):
+            self.root_refs.append(
+                ("sig", node.args[1], cls, fn, "signal.signal(...)"))
+        self.generic_visit(node)
+
+
+def _collect_calls(fn: _Func) -> None:
+    """Call edges: bare names and ``self.x(...)`` — module-local only."""
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                fn.calls.add(("name", f.id))
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                fn.calls.add(("self", f.attr))
+
+
+def _resolve_ref(index: _Index, ref, cls: Optional[_Cls],
+                 fn: Optional[_Func]) -> Optional[_Func]:
+    """A function-valued expression (``self._loop``, a bare name, a
+    ``Cls.method`` attribute) -> its _Func record, or None."""
+    if isinstance(ref, _Func):
+        return ref
+    if isinstance(ref, ast.Attribute) and \
+            isinstance(ref.value, ast.Name):
+        if ref.value.id == "self" and cls is not None:
+            return cls.methods.get(ref.attr)
+        owner = index.classes.get(ref.value.id)
+        if owner is not None:
+            return owner.methods.get(ref.attr)
+        return index.module_funcs.get(ref.attr)
+    if isinstance(ref, ast.Name):
+        cur = fn
+        while cur is not None:    # closures shadow module scope
+            for cand in index.functions:
+                if cand.parent is cur and cand.name == ref.id:
+                    return cand
+            cur = cur.parent
+        return index.module_funcs.get(ref.id)
+    return None
+
+
+def _resolve_call(index: _Index, fn: _Func,
+                  edge: Tuple[str, str]) -> Optional[_Func]:
+    kind, name = edge
+    if kind == "self":
+        return fn.cls.methods.get(name) if fn.cls is not None else None
+    cur = fn.parent
+    while cur is not None:
+        for cand in index.functions:
+            if cand.parent is cur and cand.name == name:
+                return cand
+        cur = cur.parent
+    return index.module_funcs.get(name)
+
+
+def _propagate(index: _Index) -> None:
+    """Fixed point: thread/sig/hook reachability through call edges and
+    into closures (a nested def runs on its parent's thread)."""
+    for kind, ref, cls, fn, via in index.root_refs:
+        target = _resolve_ref(index, ref, cls, fn)
+        if target is None:
+            continue
+        if kind == "thread" and not target.thread_reachable:
+            target.thread_reachable, target.thread_via = True, via
+        elif kind == "hook" and not target.hook_reachable:
+            target.hook_reachable, target.hook_via = True, via
+            # hooks fire on whichever thread hits the count/span site
+            if not target.thread_reachable:
+                target.thread_reachable, target.thread_via = True, via
+        elif kind == "sig" and not target.sig_reachable:
+            target.sig_reachable, target.sig_via = True, via
+    changed = True
+    while changed:
+        changed = False
+        for fn in index.functions:
+            flow = [fn.parent] if fn.parent is not None else []
+            for edge in fn.calls:
+                callee = _resolve_call(index, fn, edge)
+                if callee is not None:
+                    flow.append(None)   # marker: fn -> callee direction
+                    for flag, via in (("thread_reachable", "thread_via"),
+                                      ("sig_reachable", "sig_via"),
+                                      ("hook_reachable", "hook_via")):
+                        if getattr(fn, flag) and not getattr(callee, flag):
+                            setattr(callee, flag, True)
+                            setattr(callee, via,
+                                    getattr(fn, via) or fn.qualname)
+                            changed = True
+            for src in flow:
+                if src is None:
+                    continue
+                for flag, via in (("thread_reachable", "thread_via"),
+                                  ("sig_reachable", "sig_via"),
+                                  ("hook_reachable", "hook_via")):
+                    if getattr(src, flag) and not getattr(fn, flag):
+                        setattr(fn, flag, True)
+                        setattr(fn, via, getattr(src, via) or src.qualname)
+                        changed = True
+
+
+# --- per-function lock-aware event walk --------------------------------------
+
+class _Events:
+    """Lock-aware walk of one function body: write sites, call sites and
+    acquisitions, each annotated with the lexically-held lock set."""
+
+    def __init__(self, index: _Index, fn: _Func,
+                 entry_held: tuple = ()):
+        self.index = index
+        self.fn = fn
+        self.writes: List[tuple] = []     # (attr, kind, node, held)
+        self.calls: List[tuple] = []      # (call-node, edge|None, held)
+        self.acquires: List[tuple] = []   # (lock-id, node, held-before)
+        self.hook_vars: Set[str] = set()  # for-targets iterating *_hooks
+        self.guard_reads: List[tuple] = []   # (attr, if-stmt, held)
+        body = fn.node.body
+        self._walk(body, entry_held)
+
+    # lock identity: ("C", ClassName, attr) | ("M", name)
+    def _lock_of(self, expr) -> Optional[tuple]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.fn.cls is not None and \
+                expr.attr in self.fn.cls.locks:
+            return ("C", self.fn.cls.name, expr.attr)
+        if isinstance(expr, ast.Name) and \
+                expr.id in self.index.module_locks:
+            return ("M", expr.id)
+        return None
+
+    def lock_kind(self, lock_id: tuple) -> str:
+        if lock_id[0] == "C":
+            return self.index.classes[lock_id[1]].locks[lock_id[2]]
+        return self.index.module_locks[lock_id[1]]
+
+    def _walk(self, stmts, held: tuple) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in stmt.items:
+                    lock = self._lock_of(item.context_expr)
+                    if lock is not None:
+                        self.acquires.append((lock, item.context_expr,
+                                              inner))
+                        if lock not in inner:
+                            inner = inner + (lock,)
+                    else:
+                        self._scan_expr(item.context_expr, held)
+                self._walk(stmt.body, inner)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue    # separate scope; analysed on its own
+            elif isinstance(stmt, (ast.If, ast.While)):
+                # reads of self._x in a branch test, for the unlocked
+                # check-then-act half of LOCK001
+                for sub in ast.walk(stmt.test):
+                    attr = self._self_attr(sub)
+                    if attr is not None and self.fn.cls is not None \
+                            and attr not in self.fn.cls.locks:
+                        self.guard_reads.append((attr, stmt, held))
+                self._scan_expr(stmt.test, held)
+                self._walk(stmt.body, held)
+                self._walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.For):
+                # remember hook-list iteration targets for HOOK001
+                it = _trailing(stmt.iter)
+                if it is None and isinstance(stmt.iter, ast.Call):
+                    for a in stmt.iter.args:   # tuple(_hooks) wrapper
+                        it = it or _trailing(a)
+                if it is not None and it.endswith("_hooks") and \
+                        isinstance(stmt.target, ast.Name):
+                    self.hook_vars.add(stmt.target.id)
+                self._scan_expr(stmt.iter, held)
+                self._walk(stmt.body, held)
+                self._walk(stmt.orelse, held)
+            else:
+                for expr in ast.iter_child_nodes(stmt):
+                    if isinstance(expr, ast.expr):
+                        self._scan_expr(expr, held)
+                for block in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(stmt, block, None)
+                    if sub:
+                        if block == "handlers":
+                            for h in sub:
+                                self._walk(h.body, held)
+                        else:
+                            self._walk(sub, held)
+                self._scan_stmt_writes(stmt, held)
+
+    def _scan_stmt_writes(self, stmt, held: tuple) -> None:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self._write_target(tgt, "write", held)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._write_target(stmt.target, "write", held)
+        elif isinstance(stmt, ast.AugAssign):
+            self._write_target(stmt.target, "read-modify-write", held)
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._write_target(tgt, "delete", held)
+
+    def _self_attr(self, node) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr.startswith("_"):
+            return node.attr
+        return None
+
+    def _write_target(self, tgt, kind: str, held: tuple) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._write_target(el, kind, held)
+            return
+        base = tgt
+        if isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            kind = "item-" + kind
+        attr = self._self_attr(base)
+        if attr is not None and self.fn.cls is not None and \
+                attr not in self.fn.cls.locks:
+            self.writes.append((attr, kind, tgt, held))
+
+    def _scan_expr(self, expr, held: tuple) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda,)):
+                continue
+            if isinstance(node, ast.Call):
+                f = node.func
+                edge = None
+                if isinstance(f, ast.Name):
+                    edge = ("name", f.id)
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    edge = ("self", f.attr)
+                self.calls.append((node, edge, held))
+                # ``.append()`` & friends on self._x are write sites
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                    attr = self._self_attr(f.value)
+                    if attr is not None and self.fn.cls is not None and \
+                            attr not in self.fn.cls.locks:
+                        self.writes.append(
+                            (attr, f"mutation (.{f.attr}())", node, held))
+                # ``lock.acquire()`` outside a with-block still orders
+                lock = self._lock_of(f.value) \
+                    if isinstance(f, ast.Attribute) and \
+                    f.attr == "acquire" else None
+                if lock is not None:
+                    self.acquires.append((lock, node, held))
+
+
+def _lock_label(lock_id: tuple, modname: str) -> str:
+    if lock_id[0] == "C":
+        return f"{lock_id[1]}.self.{lock_id[2]}"
+    return f"{modname}.{lock_id[1]}"
+
+
+def _held_class_locks(held: tuple, cls: _Cls) -> Set[str]:
+    return {lid[2] for lid in held
+            if lid[0] == "C" and lid[1] == cls.name}
+
+
+def _build_events(index: _Index) -> Dict[_Func, "_Events"]:
+    """Lock-aware events with call-site held-set propagation.
+
+    The codebase's ``*_locked`` convention — private helpers that are
+    only ever called with the lock already held — would otherwise drown
+    the rules in false positives.  Rather than trusting the *name*, the
+    walk computes each private function's entry held-set as the
+    INTERSECTION of the locks held at all of its resolved call sites
+    (public functions and thread roots are entered bare: an external
+    caller holds nothing).  Entry sets only grow, so the fixed point
+    terminates."""
+    entry: Dict[_Func, tuple] = {fn: () for fn in index.functions}
+    events: Dict[_Func, _Events] = {}
+    for _ in range(20):
+        events = {fn: _Events(index, fn, entry[fn])
+                  for fn in index.functions}
+        sites: Dict[_Func, List[frozenset]] = {}
+        for fn, ev in events.items():
+            for _, edge, held in ev.calls:
+                if edge is None:
+                    continue
+                callee = _resolve_call(index, fn, edge)
+                if callee is not None:
+                    sites.setdefault(callee, []).append(frozenset(held))
+        changed = False
+        for fn in index.functions:
+            if not fn.name.startswith("_") or \
+                    fn.name.startswith("__") or fn not in sites:
+                continue    # public / dunder / never called locally
+            common = frozenset.intersection(*sites[fn])
+            new = tuple(sorted(common, key=repr))
+            if new != entry[fn]:
+                entry[fn] = new
+                changed = True
+        if not changed:
+            break
+    return events
+
+
+# --- rules -------------------------------------------------------------------
+
+def _rule_lock001(index: _Index, events: Dict[_Func, _Events],
+                  report) -> None:
+    """Guard inference + unguarded-write detection, per class."""
+    for cls in index.classes.values():
+        if not cls.locks:
+            continue
+        # attr -> [(func, node, held-class-locks, kind)]
+        sites: Dict[str, List[tuple]] = {}
+        # attr -> [(func, if-stmt, held-class-locks)] branch-test reads
+        reads: Dict[str, List[tuple]] = {}
+        for mname, fn in cls.methods.items():
+            if mname in _CONSTRUCTION or fn not in events:
+                continue
+            for attr, kind, node, held in events[fn].writes:
+                sites.setdefault(attr, []).append(
+                    (fn, node, _held_class_locks(held, cls), kind))
+            for attr, stmt, held in events[fn].guard_reads:
+                reads.setdefault(attr, []).append(
+                    (fn, stmt, _held_class_locks(held, cls)))
+            # closures inside methods write through the method's self
+            for sub in index.functions:
+                cur = sub.parent
+                while cur is not None and cur is not fn:
+                    cur = cur.parent
+                if cur is fn and sub in events and sub.cls is None:
+                    for attr, kind, node, held in events[sub].writes:
+                        sites.setdefault(attr, []).append(
+                            (sub, node, _held_class_locks(held, cls),
+                             kind))
+        guarded: Set[str] = set()
+        for attr, lst in sorted(sites.items()):
+            counts: Dict[str, int] = {}
+            for _, _, held, _ in lst:
+                for lock in held:
+                    counts[lock] = counts.get(lock, 0) + 1
+            if not counts:
+                continue
+            guard = max(sorted(counts), key=lambda k: counts[k])
+            locked = counts[guard]
+            if locked <= len(lst) - locked:
+                continue    # no strict majority -> no inferred guard
+            guarded.add(attr)
+            for fn, node, held, kind in lst:
+                if guard in held:
+                    continue
+                if not (fn.thread_reachable or fn.hook_reachable):
+                    continue
+                via = fn.thread_via or fn.hook_via or fn.qualname
+                report("LOCK001", node,
+                       f"{kind} of self.{attr} without self.{guard} — "
+                       f"inferred guard (held at {locked}/{len(lst)} "
+                       f"write sites) — on a thread-reachable path "
+                       f"({fn.qualname}, via {via})")
+        # unlocked check-then-act: a branch test reads self._x and the
+        # taken branch writes it back, no lock held at either site, in
+        # thread-reachable code of a lock-owning class.  The window
+        # between the read and the write is a race even when no guard
+        # could be inferred (the `_last_stats_write` /
+        # PR 19 double-admit shape)
+        for attr, rlist in sorted(reads.items()):
+            if attr in guarded:
+                continue    # the guard-based pass already judged it
+            for fn, stmt, rheld in rlist:
+                if rheld or not (fn.thread_reachable or
+                                 fn.hook_reachable):
+                    continue
+                for wfn, wnode, wheld, kind in sites.get(attr, ()):
+                    if wfn is not fn or wheld:
+                        continue
+                    if wnode.lineno < stmt.lineno:
+                        continue    # the act must follow the check
+                    via = fn.thread_via or fn.hook_via or fn.qualname
+                    report("LOCK001", wnode,
+                           f"unlocked check-then-act on self.{attr}: "
+                           f"tested at line {stmt.lineno} and "
+                           f"{kind.replace('item-', '')} here with no "
+                           f"{'/'.join(sorted('self.' + k for k in cls.locks))} "
+                           f"held — two threads can both pass the "
+                           f"check ({fn.qualname}, via {via})")
+                    break
+
+
+def _rule_lock002(index: _Index, events: Dict[_Func, _Events],
+                  modname: str, report) -> None:
+    """Lock-order graph: direct nesting + propagation through calls."""
+    # transitive acquire sets (fixed point)
+    for fn, ev in events.items():
+        fn.acquires = {lock for lock, _, _ in ev.acquires}
+        fn.trans_acquires = set(fn.acquires)
+    changed = True
+    while changed:
+        changed = False
+        for fn, ev in events.items():
+            for _, edge, _ in ev.calls:
+                if edge is None:
+                    continue
+                callee = _resolve_call(index, fn, edge)
+                if callee is not None and callee in events:
+                    add = callee.trans_acquires - fn.trans_acquires
+                    if add:
+                        fn.trans_acquires |= add
+                        changed = True
+    # edges with provenance: (A, B) -> (node, description)
+    edges: Dict[Tuple[tuple, tuple], tuple] = {}
+    for fn, ev in events.items():
+        for lock, node, held in ev.acquires:
+            for h in held:
+                if h != lock and (h, lock) not in edges:
+                    edges[(h, lock)] = (node, f"nested with in "
+                                              f"{fn.qualname}")
+        for call, edge, held in ev.calls:
+            if edge is None or not held:
+                continue
+            callee = _resolve_call(index, fn, edge)
+            if callee is None or callee not in events:
+                continue
+            for m in callee.trans_acquires:
+                for h in held:
+                    if h != m and (h, m) not in edges:
+                        edges[(h, m)] = (
+                            call, f"{fn.qualname} calls "
+                                  f"{callee.qualname} holding "
+                                  f"{_lock_label(h, modname)}")
+    # cycle detection (DFS, report each cycle once)
+    adj: Dict[tuple, List[tuple]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    seen_cycles: Set[frozenset] = set()
+    state: Dict[tuple, int] = {}
+    stack: List[tuple] = []
+
+    def dfs(v: tuple) -> None:
+        state[v] = 1
+        stack.append(v)
+        for w in adj.get(v, ()):
+            if state.get(w, 0) == 0:
+                dfs(w)
+            elif state.get(w) == 1:
+                cyc = stack[stack.index(w):] + [w]
+                key = frozenset(cyc)
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                parts = []
+                for a, b in zip(cyc, cyc[1:]):
+                    node, why = edges[(a, b)]
+                    parts.append(
+                        f"{_lock_label(a, modname)} -> "
+                        f"{_lock_label(b, modname)} "
+                        f"(line {node.lineno}: {why})")
+                first_node = edges[(cyc[0], cyc[1])][0]
+                report("LOCK002", first_node,
+                       "lock-acquisition-order cycle (potential "
+                       "deadlock): " + "; ".join(parts))
+        stack.pop()
+        state[v] = 2
+
+    for v in sorted(adj):
+        if state.get(v, 0) == 0:
+            dfs(v)
+
+
+def _rule_sig001(index: _Index, events: Dict[_Func, _Events],
+                 modname: str, report) -> None:
+    main_locks: Set[tuple] = set()
+    for fn, ev in events.items():
+        if not fn.sig_reachable:
+            for lock, _, _ in ev.acquires:
+                main_locks.add(lock)
+    for fn, ev in events.items():
+        if not fn.sig_reachable:
+            continue
+        for lock, node, _ in ev.acquires:
+            if ev.lock_kind(lock) != "RLock" and lock in main_locks:
+                report("SIG001", node,
+                       f"signal-handler path ({fn.qualname}, via "
+                       f"{fn.sig_via}) acquires non-reentrant "
+                       f"{_lock_label(lock, modname)} also taken on "
+                       f"the main path — self-deadlock if the signal "
+                       f"lands while it is held")
+        for call, _, _ in ev.calls:
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr in _BLOCKING \
+                    and not call.args and not call.keywords:
+                report("SIG001", call,
+                       f"unbounded blocking .{f.attr}() with no "
+                       f"timeout in signal-handler-reachable code "
+                       f"({fn.qualname}, via {fn.sig_via})")
+
+
+def _rule_hook001(index: _Index, events: Dict[_Func, _Events],
+                  report) -> None:
+    for fn, ev in events.items():
+        # (a) a registered hook must not re-enter profiling.count
+        if fn.hook_reachable:
+            for call, _, _ in ev.calls:
+                d = _dotted(call.func)
+                if d in ("profiling.count", "count") and \
+                        (d != "count" or
+                         index.modname == "profiling"):
+                    report("HOOK001", call,
+                           f"hook-reachable {fn.qualname} (via "
+                           f"{fn.hook_via}) re-enters profiling.count "
+                           f"— infinite hook recursion hazard")
+        # (b) the emitting side: never invoke a hook under a lock
+        for call, _, held in ev.calls:
+            if not held:
+                continue
+            t = _trailing(call.func)
+            if t is None:
+                continue
+            if t in ev.hook_vars or t.endswith("_hook") and \
+                    not t.startswith(("add_", "remove_")):
+                locks = ", ".join(
+                    _lock_label(h, index.modname) for h in held)
+                report("HOOK001", call,
+                       f"hook invoked while holding {locks} in "
+                       f"{fn.qualname} — hooks must be called OUTSIDE "
+                       f"the registry lock (PR 11 invariant)")
+
+
+# --- orchestration -----------------------------------------------------------
+
+def lint_concurrency_source(source: str, filename: str) -> List[Finding]:
+    """Run the concurrency rules over one file; suppressions applied."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as exc:
+        return [Finding("SYNTAX", filename, exc.lineno or 0,
+                        exc.offset or 0, f"syntax error: {exc.msg}",
+                        origin="concurrency")]
+    sup = scan_suppressions(source)
+    src_lines = source.splitlines()
+    findings: List[Finding] = []
+    modname = os.path.splitext(os.path.basename(filename))[0]
+
+    def report(code: str, node, message: str):
+        line = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", None)
+        if sup.is_suppressed(code, line, end):
+            return
+        text = src_lines[line - 1] if 0 < line <= len(src_lines) else ""
+        findings.append(Finding(code, filename, line,
+                                getattr(node, "col_offset", 0) + 1,
+                                message, source=text,
+                                origin="concurrency"))
+
+    index = _Index(modname)
+    index.visit(tree)
+    if not index.module_locks and \
+            not any(c.locks for c in index.classes.values()) and \
+            not index.root_refs:
+        return []    # no threading surface at all — skip the walks
+    for fn in index.functions:
+        _collect_calls(fn)
+    _propagate(index)
+    events = _build_events(index)
+
+    _rule_lock001(index, events, report)
+    _rule_lock002(index, events, modname, report)
+    _rule_sig001(index, events, modname, report)
+    _rule_hook001(index, events, report)
+
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    return findings
+
+
+def lint_concurrency_file(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_concurrency_source(fh.read(), path)
+
+
+def lint_concurrency_paths(paths) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        findings.extend(lint_concurrency_file(
+                            os.path.join(dirpath, fn)))
+        elif path.endswith(".py"):
+            findings.extend(lint_concurrency_file(path))
+    return findings
+
+
+def audit_concurrency(modules=None) -> List[Finding]:
+    """The bench/CLI entry: concurrency rules over the installed
+    package (or the named ``pint_tpu`` modules, e.g. ``["serve",
+    "gateway"]``).  Raises KeyError on an unknown module name."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if modules:
+        paths = []
+        for m in modules:
+            p = os.path.join(pkg, *m.strip().split(".")) + ".py"
+            if not os.path.isfile(p):
+                raise KeyError(f"unknown module {m!r} (no {p})")
+            paths.append(p)
+    else:
+        paths = [pkg]
+    return lint_concurrency_paths(paths)

@@ -1,0 +1,342 @@
+"""Dynamic lock-order auditor (CONTRACT005) — the runtime half of lint v5.
+
+The static rules in :mod:`pint_tpu.lint.concurrency` see what the AST
+can prove; this module sees what actually happened.  During a real
+``serve check`` / ``gateway check`` leg, :func:`instrument` patches the
+``threading.Lock`` / ``threading.RLock`` *factories* (the lock types are
+C-level and cannot be instance-patched) so every lock allocated inside
+the window is a :class:`_TracedLock` proxy that records, per thread:
+
+* the **acquisition-order graph**: an edge ``A -> B`` whenever a thread
+  *attempts* to take ``B`` while holding ``A``.  Edges are recorded at
+  the attempt, not the grant — a timed-out acquire in an inverted pair
+  still contributes its half of the cycle, so the audit catches the
+  deadlock shape without having to actually deadlock.
+* **held-lock-across-dispatch**: a ``profiling`` count hook watches the
+  dispatch counters (``serve.dispatch``, ``jit_call``, ...) and flags
+  any emitted while the emitting thread holds a traced lock — a device
+  dispatch under a service lock serializes the plane (the PR 11 "hooks
+  and dispatch OUTSIDE the lock" invariant, observed rather than
+  inferred).
+
+:func:`LockAudit.judge` turns both into **CONTRACT005**
+:class:`~pint_tpu.lint.findings.Finding` records with thread names and
+allocation-site attribution (``file.py:line`` of each lock's creation),
+so the sweep's inverted-order negative control exits 1 naming both
+locks.
+
+Activation follows the tracehooks save-patch-restore idiom: a singleton
+context manager, originals restored in ``finally``, ``RuntimeError`` on
+nesting.  :func:`maybe_instrument` is the cheap front door serve/gateway
+``check`` call unconditionally: it returns a null context unless
+``PINT_TPU_LOCKAUDIT=1`` or a concurrency failpoint
+(``racy_schedule`` / ``lock_order_invert``) is active, so the untraced
+hot path never pays for the machinery.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from typing import Iterator, List, Optional
+
+from pint_tpu.lint.findings import Finding
+
+__all__ = ["LockAudit", "instrument", "maybe_instrument", "judge_active"]
+
+#: profiling count names that mark a device/daemon dispatch — emitting
+#: one while holding a traced lock is a plane-serializing hazard
+_DISPATCH_COUNTS = ("serve.dispatch", "jit_call", "fleet.chunk_dispatch",
+                    "pta.chunk_dispatch")
+
+
+def _alloc_site() -> str:
+    """``file.py:line`` of the frame that called the lock factory,
+    skipping lockhooks/threading internals — the lock's identity in
+    every finding."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not (fn.endswith("lockhooks.py") or fn.endswith("threading.py")):
+            import os
+
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>:0"
+
+
+class _TracedLock:
+    """Proxy over a real ``threading.Lock``/``RLock`` that reports
+    acquire attempts and releases to the active :class:`LockAudit`.
+
+    Implements the full lock protocol *plus* the private
+    ``_is_owned``/``_acquire_restore``/``_release_save`` trio so a
+    ``threading.Condition`` built while instrumented (its internal
+    ``RLock()`` call returns a proxy) keeps working.
+    """
+
+    __slots__ = ("_inner", "_site", "_audit", "__weakref__")
+
+    def __init__(self, inner, site: str, audit: "LockAudit"):
+        self._inner = inner
+        self._site = site
+        self._audit = audit
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._audit._attempt(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._audit._acquired(self)
+        else:
+            self._audit._abandoned(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._audit._released(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # Condition-compatibility: delegate the private protocol, falling
+    # back to CPython's own plain-Lock shims (Condition binds these at
+    # construction; a bare ``_thread.lock`` has none of them), and keep
+    # the audit's held stack accurate across ``Condition.wait()``
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):   # the Condition probe fallback
+            inner.release()
+            return False
+        return True
+
+    def _acquire_restore(self, state):
+        self._audit._attempt(self)
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        self._audit._acquired(self)
+
+    def _release_save(self):
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            state = inner._release_save()
+        else:
+            inner.release()
+            state = None
+        self._audit._released(self)
+        return state
+
+    def __repr__(self):   # pragma: no cover - debugging aid
+        return f"<_TracedLock {self._site} over {self._inner!r}>"
+
+
+class LockAudit:
+    """Observed lock-order graph + held-across-dispatch records for one
+    instrumented window."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._mu = threading.Lock()   # guards the aggregate dicts below
+        # (site_a, site_b) -> (thread_name, "f1:l1 -> f2:l2" stack note)
+        self.edges: dict = {}
+        # [(count_name, thread_name, held-site tuple)]
+        self.dispatches_under_lock: list = []
+
+    # -- per-thread bookkeeping (proxy callbacks) --------------------------
+
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def _attempt(self, lock: _TracedLock) -> None:
+        held = self._held()
+        if held:
+            # racy_schedule widens the window between "decided to take
+            # the lock" and "took it" — poor-man's TSan
+            from pint_tpu import faultinject
+
+            faultinject.wrap("racy_schedule", lambda: None)()
+            edge = (held[-1]._site, lock._site)
+            if edge[0] != edge[1]:
+                t = threading.current_thread().name
+                note = " -> ".join(x._site for x in held) \
+                    + f" -> {lock._site}"
+                with self._mu:
+                    self.edges.setdefault(edge, (t, note))
+
+    def _acquired(self, lock: _TracedLock) -> None:
+        self._held().append(lock)
+
+    def _abandoned(self, lock: _TracedLock) -> None:
+        # non-blocking / timed-out acquire: the edge (attempt) stands,
+        # the hold does not
+        pass
+
+    def _released(self, lock: _TracedLock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+
+    def _on_count(self, name: str, n: int = 1) -> None:
+        if name not in _DISPATCH_COUNTS:
+            return
+        held = getattr(self._tls, "held", None)
+        if held:
+            t = threading.current_thread().name
+            sites = tuple(x._site for x in held)
+            with self._mu:
+                self.dispatches_under_lock.append((name, t, sites))
+
+    # -- judgement ---------------------------------------------------------
+
+    @staticmethod
+    def _site_loc(site: str):
+        path, _, line = site.rpartition(":")
+        try:
+            return path or site, int(line)
+        except ValueError:
+            return site, 0
+
+    def cycles(self) -> List[tuple]:
+        """Elementary cycles in the observed site-level order graph,
+        deduped by vertex set."""
+        adj: dict = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+        seen, out = set(), []
+        for start in sorted(adj):
+            stack = [(start, (start,))]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(adj.get(node, ())):
+                    if nxt == start and len(path) > 1:
+                        key = frozenset(path)
+                        if key not in seen:
+                            seen.add(key)
+                            out.append(path)
+                    elif nxt not in path and len(path) < 8:
+                        stack.append((nxt, path + (nxt,)))
+        return out
+
+    def judge(self) -> List[Finding]:
+        """CONTRACT005 findings: observed lock-order cycles (each edge
+        attributed to the thread and acquisition chain that recorded
+        it) and dispatches emitted while holding a traced lock."""
+        findings = []
+        for cyc in self.cycles():
+            edges = [(cyc[i], cyc[(i + 1) % len(cyc)])
+                     for i in range(len(cyc))]
+            attribution = "; ".join(
+                f"{a} -> {b} [thread {self.edges[(a, b)][0]}: "
+                f"{self.edges[(a, b)][1]}]"
+                for a, b in edges if (a, b) in self.edges)
+            path, line = self._site_loc(cyc[0])
+            findings.append(Finding(
+                code="CONTRACT005", path=path, line=line, col=0,
+                message=(f"observed lock-order cycle between "
+                         f"{' and '.join(sorted(set(cyc)))}: "
+                         f"{attribution}"),
+                source=f"lock-order cycle {' -> '.join(cyc)}",
+                origin="lockhooks"))
+        for name, thread, sites in self.dispatches_under_lock:
+            path, line = self._site_loc(sites[-1])
+            findings.append(Finding(
+                code="CONTRACT005", path=path, line=line, col=0,
+                message=(f"dispatch counter {name!r} emitted on thread "
+                         f"{thread!r} while holding traced lock(s) "
+                         f"{', '.join(sites)} — device dispatch under a "
+                         f"service lock serializes the plane"),
+                source=f"dispatch-under-lock {name} {sites[-1]}",
+                origin="lockhooks"))
+        findings.sort(key=lambda f: (f.path, f.line, f.message))
+        return findings
+
+
+#: the active audit window, if any (tracehooks-style singleton)
+_ACTIVE: Optional[LockAudit] = None
+
+
+def judge_active() -> List[Finding]:
+    """Findings from the currently-open window (empty when inactive) —
+    for in-process probes that want to look before the window closes."""
+    return _ACTIVE.judge() if _ACTIVE is not None else []
+
+
+@contextlib.contextmanager
+def instrument() -> Iterator[LockAudit]:
+    """Patch the ``threading.Lock``/``RLock`` factories so locks
+    allocated inside the window are traced; register the dispatch count
+    hook; fire the ``lock_order_invert`` failpoint (which, when active,
+    spawns the seeded two-lock inversion the sweep's negative control
+    judges).  Originals restored on exit; nesting is an error."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("lockhooks.instrument() already active")
+    from pint_tpu import faultinject, profiling
+
+    audit = LockAudit()
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+
+    def traced_lock():
+        return _TracedLock(orig_lock(), _alloc_site(), audit)
+
+    def traced_rlock():
+        return _TracedLock(orig_rlock(), _alloc_site(), audit)
+
+    threading.Lock = traced_lock
+    threading.RLock = traced_rlock
+    profiling.add_count_hook(audit._on_count)
+    _ACTIVE = audit
+    try:
+        # seeded inversion driver: a no-op unless the lock_order_invert
+        # failpoint is active, in which case the factory runs the
+        # two-thread inverted-acquire scenario against freshly-traced
+        # locks (timed acquires — the cycle is RECORDED, never entered)
+        faultinject.wrap("lock_order_invert", lambda: None)()
+        yield audit
+    finally:
+        _ACTIVE = None
+        threading.Lock = orig_lock
+        threading.RLock = orig_rlock
+        profiling.remove_count_hook(audit._on_count)
+
+
+def _wanted() -> bool:
+    import os
+
+    if os.environ.get("PINT_TPU_LOCKAUDIT") == "1":
+        return True
+    from pint_tpu import faultinject
+
+    return (faultinject.is_active("racy_schedule")
+            or faultinject.is_active("lock_order_invert"))
+
+
+@contextlib.contextmanager
+def maybe_instrument() -> Iterator[Optional[LockAudit]]:
+    """:func:`instrument` when the audit is requested
+    (``PINT_TPU_LOCKAUDIT=1`` or a concurrency failpoint is active),
+    else a null context yielding ``None`` — the zero-cost default path
+    for ``serve check`` / ``gateway check``."""
+    if not _wanted():
+        yield None
+        return
+    with instrument() as audit:
+        yield audit

@@ -619,6 +619,10 @@ def check_schema(doc: Dict[str, Any]) -> List[str]:
             problems.append(f"'{key}' is not an int")
     if "submetrics" in doc and not isinstance(doc["submetrics"], dict):
         problems.append("'submetrics' is not an object")
+    for key in ("precflow_clean", "concurrency_clean"):
+        if key in doc and doc[key] is not None \
+                and not isinstance(doc[key], bool):
+            problems.append(f"'{key}' is not a bool/null")
     cc = doc.get("cost_cards")
     if cc is not None:
         if not isinstance(cc, dict):
@@ -750,6 +754,15 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     if og is not None and ng is not None and ng > og:
         fail("gateway_retries", og, ng,
              "healthy-path gateway retries exceeded the prior round")
+    # concurrency audit verdict (ISSUE 20): like the steady-compile
+    # axes, absolute — an explicit False means the lock-guard/lock-
+    # order/signal/hook rules found something, regardless of the prior
+    # round.  Bools are invisible to _num, so read the dict directly;
+    # null/absent (skipped or pre-audit round) passes
+    if new.get("concurrency_clean") is False:
+        fail("concurrency_clean", old.get("concurrency_clean"), False,
+             "concurrency audit reported findings "
+             "(must stay clean, like steady compiles)")
     return failures
 
 

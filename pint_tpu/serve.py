@@ -512,9 +512,16 @@ class TimingService:
 
     def _batch_args(self, bucket: _ServeBucket, jobs: List[PreparedJob]):
         akey = (bucket.key, tuple(j.uid for j in jobs))
-        args = self._args_lru.get(akey)
+        # the LRU is shared between the dispatcher daemon and any
+        # caller-thread flush() — every touch happens under the lock
+        # (lint v5 LOCK001: the unlocked get/move_to_end/popitem here
+        # was a real OrderedDict race); the expensive staging below
+        # stays outside it
+        with self._cond:
+            args = self._args_lru.get(akey)
+            if args is not None:
+                self._args_lru.move_to_end(akey)
         if args is not None:
-            self._args_lru.move_to_end(akey)
             profiling.count("serve.args_reuse")
             return args
         stacked_p = jax.tree_util.tree_map(
@@ -529,15 +536,21 @@ class TimingService:
             jnp.asarray(np.stack([j.slot_row for j in jobs])),
             jnp.asarray(np.stack([j.pmask_row for j in jobs])),
             jnp.asarray(np.stack([j.rowmask_row for j in jobs]))))
-        self._args_lru[akey] = args
         # donation between dispatches: jit donate_argnums would
         # invalidate these cached inputs (and is a no-op on CPU), so
         # residency is bounded here instead — evicting the LRU tail
         # releases its device buffers back to the allocator before the
-        # next dispatch stages new ones
-        while len(self._args_lru) > self.args_cache_size:
-            self._args_lru.popitem(last=False)
-            profiling.count("serve.args_donate")
+        # next dispatch stages new ones.  Counting happens after the
+        # lock is released: profiling.count fans out to hooks, and
+        # hooks are never called with a service lock held
+        evicted = 0
+        with self._cond:
+            self._args_lru[akey] = args
+            while len(self._args_lru) > self.args_cache_size:
+                self._args_lru.popitem(last=False)
+                evicted += 1
+        if evicted:
+            profiling.count("serve.args_donate", evicted)
         return args
 
     # -- blast-radius containment (ISSUE 18) -----------------------------------
@@ -717,7 +730,12 @@ class TimingService:
         opened = False
         with self._cond:
             bucket.fails += 1
-            if bucket.fails >= self._breaker_n \
+            # snapshot under the lock: another thread's _breaker_ok can
+            # zero bucket.fails between release and the incident below
+            # (lint v5: stale-read race — the incident/log would claim
+            # 0 consecutive failures for a breaker that just opened)
+            fails = bucket.fails
+            if fails >= self._breaker_n \
                     and bucket.state != "open":
                 bucket.state = "open"
                 bucket.opened_at = time.monotonic()
@@ -727,11 +745,11 @@ class TimingService:
             profiling.count("serve.breaker_open")
             telemetry.incident("serve.breaker_open",
                                bucket=_bucket_label(bucket.key),
-                               fails=bucket.fails)
+                               fails=fails)
             _log.warning("bucket %s breaker OPEN after %d consecutive "
                          "dispatch failures; serving on the eager lane "
                          "until a half-open probe succeeds",
-                         _bucket_label(bucket.key), bucket.fails)
+                         _bucket_label(bucket.key), fails)
 
     def _eager_fit(self, job: PreparedJob) -> ServeResult:
         """Solo host-driven fit on the PR 3 guarded engine — the lane
@@ -1211,10 +1229,16 @@ class TimingService:
         if self.stats_path is None:
             return
         now = time.monotonic()
-        if not force and \
-                now - self._last_stats_write < self._stats_interval_s:
-            return
-        self._last_stats_write = now
+        # the rate-limit check-and-set is atomic under the lock (lint
+        # v5 LOCK001: the daemon's _loop and a caller-thread drain()
+        # could both pass the unlocked check and double-write); the
+        # file write itself happens after release — stats() retakes
+        # the same non-reentrant lock
+        with self._cond:
+            if not force and \
+                    now - self._last_stats_write < self._stats_interval_s:
+                return
+            self._last_stats_write = now
         try:
             telemetry.write_stats(self.stats_path, self.stats())
             with self._cond:
@@ -1330,6 +1354,27 @@ def _demo_service(*, batch_size: int = 2, maxiter: int = 3,
 
 
 def _check(args) -> int:
+    """The ``check`` subcommand: :func:`_check_body` under the dynamic
+    lock audit (``lint.lockhooks.maybe_instrument`` — a null context
+    unless ``PINT_TPU_LOCKAUDIT=1`` or a concurrency failpoint is
+    active).  CONTRACT005 findings go to STDERR (stdout must stay a
+    single JSON line — the chaos sweep parses it) and force rc 1."""
+    import sys
+
+    from pint_tpu.lint import lockhooks
+
+    with lockhooks.maybe_instrument() as audit:
+        rc = _check_body(args)
+    if audit is not None:
+        findings = audit.judge()
+        for f in findings:
+            print(f.format(), file=sys.stderr)
+        if findings:
+            return 1
+    return rc
+
+
+def _check_body(args) -> int:
     """The ``check`` subcommand body: demo/pta corpus through the
     daemon path -> one JSON line with per-job results (chi2 as
     ``float.hex`` for bit-exact comparison — the chaos-sweep judge's

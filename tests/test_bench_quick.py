@@ -224,6 +224,16 @@ def _assert_schema(d, fast=False):
     assert isinstance(pf, dict) and "error" not in pf, pf
     assert pf["precflow_clean"] is True and pf["findings"] == [], pf
     assert pf["wall_s"] >= 0
+    # concurrency axis (ISSUE 20): the serve plane's thread-safety
+    # rides the bench series as a boolean — a LOCK001/LOCK002/SIG001/
+    # HOOK001 regression flips it to False with the findings
+    # enumerated in the submetric (and `metrics compare` gates on it)
+    assert d.get("concurrency_clean") is True, \
+        d["submetrics"].get("concurrency")
+    cf = d["submetrics"].get("concurrency")
+    assert isinstance(cf, dict) and "error" not in cf, cf
+    assert cf["concurrency_clean"] is True and cf["findings"] == [], cf
+    assert cf["wall_s"] >= 0
 
 
 def test_quick_steady_state_never_recompiles(quick_line):

@@ -1,0 +1,783 @@
+"""The lint v5 concurrency & signal-safety gate (ISSUE 20).
+
+Three cases per static rule (triggering / clean / suppressed) plus the
+guard-inference corner cases (call-site held-set propagation for the
+``*_locked`` convention, construction exemption, the strict-majority
+threshold), the PR-19-idempotency-race-shaped fixture that LOCK001 must
+fire on, source-shaped regression fixtures for the races this PR fixed
+in ``serve.py``, the package-wide gate (``audit_concurrency`` must be
+clean on the shipped tree), and the dynamic CONTRACT005 layer
+(``lint.lockhooks``): in-process lock-order cycle + dispatch-under-lock
+detection, factory restore, and the ``racy_schedule`` /
+``lock_order_invert`` failpoint plumbing.  Set
+``PINT_TPU_SKIP_CONCURRENCY=1`` to skip on WIP branches (also honored
+by conftest.py).
+"""
+
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pint_tpu.lint.concurrency import (
+    RULES_CONCURRENCY,
+    audit_concurrency,
+    lint_concurrency_source,
+)
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PINT_TPU_SKIP_CONCURRENCY") == "1",
+    reason="PINT_TPU_SKIP_CONCURRENCY=1")
+
+
+def findings(src, filename="somemodule.py"):
+    return lint_concurrency_source(textwrap.dedent(src), filename)
+
+
+def codes(src, filename="somemodule.py"):
+    return [f.code for f in findings(src, filename)]
+
+
+# --- LOCK001: guard inference ------------------------------------------------
+
+#: the PR 19 idempotency-race shape: ``_requests_total`` bumped under
+#: ``self._lock`` at two admission sites but bare on the drain-thread
+#: path — exactly the bug the gateway review caught by hand
+_PR19_SHAPE = """
+import threading
+
+
+class Gateway:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests_total = 0
+        self._worker = threading.Thread(target=self._drain)
+        self._worker.start()
+
+    def admit(self, job):
+        with self._lock:
+            self._requests_total += 1
+
+    def replay(self, job):
+        with self._lock:
+            self._requests_total += 1
+
+    def _drain(self):
+        self._requests_total += 1
+"""
+
+
+class TestLOCK001:
+    def test_fires_on_pr19_race_shape(self):
+        f = findings(_PR19_SHAPE, "gateway_fixture.py")
+        assert [x.code for x in f] == ["LOCK001"], f
+        msg = f[0].message
+        # attribution: attribute, inferred guard, site tally, thread root
+        assert "self._requests_total" in msg and "self._lock" in msg
+        assert "2/3 write sites" in msg
+        assert "_drain" in msg
+
+    def test_clean_when_every_site_is_locked(self):
+        src = _PR19_SHAPE.replace(
+            "    def _drain(self):\n"
+            "        self._requests_total += 1",
+            "    def _drain(self):\n"
+            "        with self._lock:\n"
+            "            self._requests_total += 1")
+        assert codes(src, "gateway_fixture.py") == []
+
+    def test_suppressed(self):
+        src = _PR19_SHAPE.replace(
+            "    def _drain(self):\n"
+            "        self._requests_total += 1",
+            "    def _drain(self):\n"
+            "        # ddlint: disable=LOCK001 — approximate counter\n"
+            "        self._requests_total += 1")
+        assert codes(src, "gateway_fixture.py") == []
+
+    def test_mutator_write_counts(self):
+        src = _PR19_SHAPE.replace("self._requests_total += 1",
+                                  "self._requests_total.append(1)") \
+            .replace("self._requests_total = 0",
+                     "self._requests_total = []")
+        f = findings(src, "gateway_fixture.py")
+        assert [x.code for x in f] == ["LOCK001"], f
+
+    def test_construction_writes_are_exempt(self):
+        # the bare __init__ writes neither fire nor dilute the majority
+        src = """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._n = 1
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                with self._lock:
+                    self._n += 1
+        """
+        assert codes(src) == []
+
+    def test_no_strict_majority_no_inferred_guard(self):
+        # 1 locked / 1 unlocked write site: no dominating lock, so the
+        # rule stays quiet rather than guessing
+        src = """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                self._n += 1
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+        """
+        assert codes(src) == []
+
+    def test_locked_helper_convention_via_held_set_propagation(self):
+        # the repo's ``*_locked`` convention: a private helper only ever
+        # called with the lock held inherits the callers' held-set (the
+        # INTERSECTION over call sites), so its bare write is clean
+        src = """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def flush(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self._n += 1
+        """
+        assert codes(src) == []
+
+    def test_helper_called_unlocked_loses_the_held_set(self):
+        # one bare call site empties the intersection: the helper's
+        # write is judged unlocked and the majority (2 locked callers'
+        # inline writes) infers the guard -> fires
+        src = """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                with self._lock:
+                    self._n += 1
+                self._bump_locked()
+
+            def flush(self):
+                with self._lock:
+                    self._n += 1
+
+            def _bump_locked(self):
+                self._n += 1
+        """
+        f = findings(src)
+        assert [x.code for x in f] == ["LOCK001"], f
+        assert "self._n" in f[0].message
+
+    def test_unlocked_check_then_act_fires(self):
+        # the ``_maybe_write_stats`` shape this PR fixed in serve.py:
+        # test-then-set on shared state with the class's lock not held
+        src = """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._last = None
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                if self._last is None:
+                    self._last = 1.0
+        """
+        f = findings(src)
+        assert [x.code for x in f] == ["LOCK001"], f
+        assert "check-then-act" in f[0].message
+        assert "self._last" in f[0].message
+
+    def test_locked_check_then_act_is_clean(self):
+        src = """
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._last = None
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                with self._lock:
+                    if self._last is None:
+                        self._last = 1.0
+        """
+        assert codes(src) == []
+
+
+# --- LOCK002: lock-order cycles ----------------------------------------------
+
+class TestLOCK002:
+    def test_fires_on_nested_with_inversion(self):
+        src = """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+
+        def fwd():
+            with _a:
+                with _b:
+                    pass
+
+
+        def rev():
+            with _b:
+                with _a:
+                    pass
+        """
+        f = findings(src, "mod.py")
+        assert [x.code for x in f] == ["LOCK002"], f
+        msg = f[0].message
+        # both edges named with line + provenance
+        assert "mod._a -> mod._b" in msg and "mod._b -> mod._a" in msg
+        assert "fwd" in msg and "rev" in msg
+
+    def test_fires_through_the_call_graph(self):
+        # the inversion hides one hop away: takes_x holds _x and calls
+        # a helper that acquires _y, while takes_y nests _y -> _x
+        src = """
+        import threading
+
+        _x = threading.Lock()
+        _y = threading.Lock()
+
+
+        def takes_x():
+            with _x:
+                _helper()
+
+
+        def _helper():
+            with _y:
+                pass
+
+
+        def takes_y():
+            with _y:
+                with _x:
+                    pass
+        """
+        f = findings(src, "mod.py")
+        assert [x.code for x in f] == ["LOCK002"], f
+        assert "_helper" in f[0].message
+
+    def test_clean_on_consistent_order(self):
+        src = """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+
+        def two():
+            with _a:
+                with _b:
+                    pass
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+
+        def fwd():
+            with _a:
+                # ddlint: disable=LOCK002 — phase-gated, never concurrent
+                with _b:
+                    pass
+
+
+        def rev():
+            with _b:
+                with _a:
+                    pass
+        """
+        assert codes(src) == []
+
+
+# --- SIG001: signal-handler safety -------------------------------------------
+
+class TestSIG001:
+    _BASE = """
+    import signal
+    import threading
+
+    _lock = threading.{factory}()
+
+
+    def flush():
+        with _lock:
+            pass
+
+
+    def _handler(signum, frame):
+        with _lock:
+            pass
+
+
+    def install():
+        signal.signal(signal.SIGTERM, _handler)
+    """
+
+    def test_fires_on_nonreentrant_lock_shared_with_main_path(self):
+        f = findings(self._BASE.format(factory="Lock"), "mod.py")
+        assert [x.code for x in f] == ["SIG001"], f
+        assert "_handler" in f[0].message
+        assert "mod._lock" in f[0].message
+
+    def test_clean_with_rlock(self):
+        assert codes(self._BASE.format(factory="RLock")) == []
+
+    def test_clean_when_lock_is_handler_only(self):
+        src = textwrap.dedent(self._BASE.format(factory="Lock")).replace(
+            "def flush():\n"
+            "    with _lock:\n"
+            "        pass", "def flush():\n    pass")
+        assert codes(src) == []
+
+    def test_fires_on_unbounded_blocking_join(self):
+        src = """
+        import signal
+
+
+        def _handler(signum, frame):
+            worker.join()
+
+
+        def install(worker):
+            signal.signal(signal.SIGTERM, _handler)
+        """
+        f = findings(src)
+        assert [x.code for x in f] == ["SIG001"], f
+        assert ".join()" in f[0].message
+
+    def test_clean_with_bounded_join(self):
+        src = """
+        import signal
+
+
+        def _handler(signum, frame):
+            worker.join(timeout=0.5)
+
+
+        def install(worker):
+            signal.signal(signal.SIGTERM, _handler)
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = textwrap.dedent(self._BASE.format(factory="Lock")).replace(
+            "def _handler(signum, frame):\n"
+            "    with _lock:",
+            "def _handler(signum, frame):\n"
+            "    # ddlint: disable=SIG001 — handler only sets a flag\n"
+            "    with _lock:")
+        assert codes(src) == []
+
+
+# --- HOOK001: hook re-entrancy -----------------------------------------------
+
+class TestHOOK001:
+    def test_fires_when_hook_reenters_count(self):
+        src = """
+        from pint_tpu import profiling
+
+
+        def _on_count(name, n=1):
+            profiling.count("meta." + name, n)
+
+
+        def install():
+            profiling.add_count_hook(_on_count)
+        """
+        f = findings(src)
+        assert [x.code for x in f] == ["HOOK001"], f
+        assert "re-enters profiling.count" in f[0].message
+
+    def test_fires_when_hooks_called_under_lock(self):
+        src = """
+        import threading
+
+        _lock = threading.Lock()
+        _count_hooks = []
+
+
+        def emit(n):
+            with _lock:
+                for hook in _count_hooks:
+                    hook(n)
+        """
+        f = findings(src, "mod.py")
+        assert [x.code for x in f] == ["HOOK001"], f
+        assert "OUTSIDE" in f[0].message and "mod._lock" in f[0].message
+
+    def test_clean_when_hooks_called_after_release(self):
+        # the shipped profiling.count shape: snapshot under the lock,
+        # invoke outside it
+        src = """
+        import threading
+
+        _lock = threading.Lock()
+        _count_hooks = []
+
+
+        def emit(n):
+            with _lock:
+                hooks = tuple(_count_hooks)
+            for hook in hooks:
+                hook(n)
+        """
+        assert codes(src) == []
+
+    def test_suppressed(self):
+        src = """
+        from pint_tpu import profiling
+
+
+        def _on_count(name, n=1):
+            # ddlint: disable=HOOK001 — guarded by a recursion flag
+            profiling.count("meta." + name, n)
+
+
+        def install():
+            profiling.add_count_hook(_on_count)
+        """
+        assert codes(src) == []
+
+
+# --- serve.py race-fix regressions (ISSUE 20 satellite 1) --------------------
+
+class TestServeRaceRegressions:
+    """Source-shaped regression fixtures: the exact pre-fix shapes of
+    the races this PR fixed in ``serve.py`` must fire LOCK001, so a
+    reintroduction is caught by the gate, not a reviewer."""
+
+    def test_prefix_batch_args_lru_shape_fires(self):
+        # pre-fix ``_batch_args``: OrderedDict get/move_to_end/popitem
+        # outside ``self._cond`` while ``flush()`` dispatches on the
+        # CALLER's thread concurrently with the daemon loop
+        src = """
+        import threading
+        from collections import OrderedDict
+
+
+        class Service:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._args_lru = OrderedDict()
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                with self._cond:
+                    self._args_lru["k"] = 1
+                self.batch_args("k")
+
+            def batch_args(self, key):
+                if key in self._args_lru:
+                    self._args_lru.move_to_end(key)
+                    return self._args_lru[key]
+                self._args_lru[key] = 2
+                return self._args_lru[key]
+        """
+        f = findings(src, "serve_fixture.py")
+        assert any(x.code == "LOCK001" for x in f), f
+        assert any("_args_lru" in x.message for x in f), f
+
+    def test_prefix_maybe_write_stats_shape_fires(self):
+        # pre-fix ``_maybe_write_stats``: unlocked check-then-act on
+        # ``self._last_stats_write`` from the daemon thread
+        src = """
+        import threading
+        import time
+
+
+        class Service:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._last_stats_write = 0.0
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                self._maybe_write_stats()
+
+            def _maybe_write_stats(self):
+                now = time.monotonic()
+                if now - self._last_stats_write < 5.0:
+                    return
+                self._last_stats_write = now
+        """
+        f = findings(src, "serve_fixture.py")
+        assert any(x.code == "LOCK001"
+                   and "check-then-act" in x.message for x in f), f
+        assert any("_last_stats_write" in x.message for x in f), f
+
+    def test_shipped_serve_plane_is_clean(self):
+        # the fixed modules audit clean — the three serve.py race fixes
+        # (LRU under _cond, atomic stats check-and-set, breaker-fail
+        # snapshot) hold, as do telemetry/metrics/profiling
+        for mod in ("serve", "gateway", "telemetry", "metrics",
+                    "profiling"):
+            assert audit_concurrency([mod]) == [], mod
+
+
+# --- package gate + plumbing -------------------------------------------------
+
+class TestPackageGate:
+    def test_whole_package_audits_clean(self):
+        assert audit_concurrency() == []
+
+    def test_unknown_module_raises_keyerror(self):
+        with pytest.raises(KeyError):
+            audit_concurrency(["definitely_not_a_module"])
+
+    def test_rules_registered_with_cli(self):
+        from pint_tpu.lint import astrules
+
+        for code in RULES_CONCURRENCY:
+            assert code in astrules.RULES, code
+        assert "CONTRACT005" in astrules.RULES
+
+    def test_no_threading_surface_short_circuits(self):
+        assert findings("x = 1\n\n\ndef f():\n    return x\n") == []
+
+
+# --- dynamic layer: lint.lockhooks (CONTRACT005) -----------------------------
+
+class TestLockhooks:
+    def test_observed_inversion_yields_contract005(self):
+        from pint_tpu.lint import lockhooks
+
+        with lockhooks.instrument() as audit:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def fwd():
+                with a:
+                    time.sleep(0.05)
+                    if b.acquire(timeout=0.2):
+                        b.release()
+
+            def rev():
+                with b:
+                    time.sleep(0.05)
+                    if a.acquire(timeout=0.2):
+                        a.release()
+
+            t1 = threading.Thread(target=fwd, name="order-t1")
+            t2 = threading.Thread(target=rev, name="order-t2")
+            t1.start()
+            t2.start()
+            t1.join()
+            t2.join()
+        f = audit.judge()
+        cyc = [x for x in f if x.code == "CONTRACT005"
+               and "lock-order cycle" in x.message]
+        assert cyc, f
+        # per-thread attribution names BOTH threads and both sites
+        msg = cyc[0].message
+        assert "order-t1" in msg and "order-t2" in msg
+        assert msg.count("test_concurrency.py:") >= 2, msg
+
+    def test_dispatch_under_lock_is_flagged(self):
+        from pint_tpu import profiling
+        from pint_tpu.lint import lockhooks
+
+        with lockhooks.instrument() as audit:
+            lk = threading.Lock()
+            with lk:
+                profiling.count("serve.dispatch")
+        f = audit.judge()
+        assert any(x.code == "CONTRACT005"
+                   and "serve.dispatch" in x.message for x in f), f
+
+    def test_consistent_order_and_bare_dispatch_are_clean(self):
+        from pint_tpu import profiling
+        from pint_tpu.lint import lockhooks
+
+        with lockhooks.instrument() as audit:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with a:
+                with b:
+                    pass
+            profiling.count("serve.dispatch")   # no lock held: fine
+        assert audit.judge() == []
+
+    def test_factories_restored_and_nesting_rejected(self):
+        from pint_tpu.lint import lockhooks
+
+        orig_lock, orig_rlock = threading.Lock, threading.RLock
+        with lockhooks.instrument():
+            assert threading.Lock is not orig_lock
+            with pytest.raises(RuntimeError):
+                with lockhooks.instrument():
+                    pass
+        assert threading.Lock is orig_lock
+        assert threading.RLock is orig_rlock
+
+    def test_condition_wait_notify_under_instrumentation(self):
+        # Condition() built inside the window wraps a traced RLock via
+        # the private _is_owned/_acquire_restore/_release_save protocol
+        from pint_tpu.lint import lockhooks
+
+        with lockhooks.instrument() as audit:
+            cond = threading.Condition()
+            hit = []
+
+            def waiter():
+                with cond:
+                    cond.wait(timeout=2.0)
+                    hit.append(1)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cond:
+                cond.notify_all()
+            t.join()
+        assert hit == [1]
+        assert audit.judge() == []
+
+    def test_maybe_instrument_default_is_null(self, monkeypatch):
+        from pint_tpu.lint import lockhooks
+
+        monkeypatch.delenv("PINT_TPU_LOCKAUDIT", raising=False)
+        with lockhooks.maybe_instrument() as audit:
+            assert audit is None
+
+    def test_maybe_instrument_env_activation(self, monkeypatch):
+        from pint_tpu.lint import lockhooks
+
+        monkeypatch.setenv("PINT_TPU_LOCKAUDIT", "1")
+        with lockhooks.maybe_instrument() as audit:
+            assert audit is not None
+
+
+# --- the concurrency failpoints (ISSUE 20 satellite 2) -----------------------
+
+class TestConcurrencyFailpoints:
+    def test_lock_order_invert_records_cycle_through_instrument(self):
+        # the negative control, in-process: with the failpoint active,
+        # opening the audit window runs the seeded two-thread inversion
+        # and judge() must produce CONTRACT005 naming both locks and
+        # both inverter threads
+        from pint_tpu import faultinject
+        from pint_tpu.lint import lockhooks
+
+        with faultinject.lock_order_invert():
+            with lockhooks.instrument() as audit:
+                pass
+        f = audit.judge()
+        cyc = [x for x in f if x.code == "CONTRACT005"
+               and "lock-order cycle" in x.message]
+        assert cyc, f
+        msg = cyc[0].message
+        assert "lock-order-invert-1" in msg
+        assert "lock-order-invert-2" in msg
+        assert msg.count("faultinject.py:") >= 2, msg
+
+    def test_lock_order_invert_activates_maybe_instrument(self):
+        from pint_tpu import faultinject
+        from pint_tpu.lint import lockhooks
+
+        with faultinject.lock_order_invert():
+            with lockhooks.maybe_instrument() as audit:
+                assert audit is not None
+
+    def test_racy_schedule_is_timing_only(self):
+        from pint_tpu import faultinject
+
+        with faultinject.racy_schedule():
+            wrapped = faultinject.wrap("racy_schedule", lambda: "ok")
+            t0 = time.monotonic()
+            assert wrapped() == "ok"       # jitter, same result
+            assert time.monotonic() - t0 < 0.5
+            from pint_tpu.lint import lockhooks
+
+            with lockhooks.maybe_instrument() as audit:
+                assert audit is not None   # jitter implies the audit
+        # inactive: wrap is the identity
+        fn = lambda: 1   # noqa: E731
+        assert faultinject.wrap("racy_schedule", fn) is fn
+
+    def test_racy_schedule_rides_the_default_sweep_set(self):
+        from pint_tpu.faultinject import _SWEEP_FAULTS
+
+        assert "racy_schedule" in _SWEEP_FAULTS
+        assert "lock_order_invert" not in _SWEEP_FAULTS
+
+    def test_sweep_judge_attributes_audit_findings_on_rc1(self):
+        # when the dynamic lock audit flips a leg to rc 1, the sweep's
+        # problem line must carry the CONTRACT005 attribution (both
+        # lock sites), not the generic jobs-unaccounted message
+        from pint_tpu.faultinject import _sweep_judge
+
+        doc = {"results": {}}
+        finding = ("faultinject.py:847:0: CONTRACT005 observed "
+                   "lock-order cycle between faultinject.py:847 and "
+                   "faultinject.py:848")
+        probs = _sweep_judge("lock_order_invert", ("lock_order_invert",),
+                             1, doc, finding + "\n", {})
+        assert len(probs) == 1
+        assert "concurrency audit findings" in probs[0], probs
+        assert finding in probs[0], probs
+        # an rc 1 with no audit finding keeps the generic attribution
+        probs = _sweep_judge("slow_dispatch", ("slow_dispatch",),
+                             1, doc, "", {})
+        assert "jobs unaccounted for" in probs[0], probs

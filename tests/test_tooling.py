@@ -547,6 +547,113 @@ class TestPrecflowGate:
         assert "phase_critical" in proc.stdout, proc.stdout
 
 
+class TestConcurrencyGate:
+    """The ``--concurrency`` console/CLI subprocess leg (ISSUE 20; the
+    in-process gate rides tier-1 in tests/test_concurrency.py): the
+    concurrency & signal-safety audit must exit 0 clean on the shipped
+    package, annotate a seeded race fixture in ``--format=github``
+    form, and the ``lock_order_invert`` negative control (crossing the
+    process boundary via ``PINT_TPU_FAULTS``, the same leg the chaos
+    sweep drives with ``--inject lock_order_invert``) must flip a real
+    ``serve check`` to exit 1 with CONTRACT005 attribution on stderr
+    while stdout stays one parseable JSON line."""
+
+    pytestmark = pytest.mark.skipif(
+        __import__("os").environ.get("PINT_TPU_SKIP_CONCURRENCY") == "1",
+        reason="PINT_TPU_SKIP_CONCURRENCY=1")
+
+    @staticmethod
+    def _run(args, env_extra=None, module="pint_tpu.lint"):
+        import os
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PINT_TPU_FAULTS", None)
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", module, *args],
+            capture_output=True, text=True, timeout=600, env=env)
+
+    def test_package_clean_exits_zero_json(self):
+        import json
+
+        proc = self._run(["--concurrency", "--format=json"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["findings"] == []
+
+    def test_seeded_fixture_github_annotation(self, tmp_path):
+        """A PR-19-race-shaped fixture surfaces as ``::error``
+        workflow-command annotations so CI pins LOCK001 to the diff."""
+        fixture = tmp_path / "racy_gateway.py"
+        fixture.write_text(
+            "import threading\n\n\n"
+            "class Gateway:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._requests_total = 0\n"
+            "        threading.Thread(target=self._drain).start()\n\n"
+            "    def admit(self):\n"
+            "        with self._lock:\n"
+            "            self._requests_total += 1\n\n"
+            "    def replay(self):\n"
+            "        with self._lock:\n"
+            "            self._requests_total += 1\n\n"
+            "    def _drain(self):\n"
+            "        self._requests_total += 1\n")
+        proc = self._run(["--concurrency", "--format=github",
+                          str(fixture)])
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        lines = proc.stdout.splitlines()
+        errs = [ln for ln in lines if ln.startswith("::error file=")]
+        assert errs and any("LOCK001" in ln for ln in errs), lines
+        assert any(ln.startswith("::notice::pint-tpu-lint")
+                   for ln in lines), lines
+
+    def test_unknown_module_is_a_usage_error(self):
+        proc = self._run(["--concurrency=not_a_module"])
+        assert proc.returncode == 2
+        assert "not_a_module" in proc.stderr
+
+    def test_lock_order_invert_leg_exits_one_with_attribution(self):
+        """ISSUE 20 acceptance: the inverted-order negative control —
+        ``serve check`` under ``PINT_TPU_FAULTS=lock_order_invert``
+        must exit 1, name BOTH lock allocation sites and both inverter
+        threads in a CONTRACT005 stderr finding, and keep stdout a
+        single parseable JSON line (the chaos sweep's
+        ``--inject lock_order_invert`` leg judges exactly this rc)."""
+        import json
+
+        proc = self._run(["check", "--jobs", "2", "--wait-ms", "20"],
+                         {"PINT_TPU_FAULTS": "lock_order_invert"},
+                         module="pint_tpu.serve")
+        assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+        hits = [ln for ln in proc.stderr.splitlines()
+                if "CONTRACT005" in ln and "lock-order cycle" in ln]
+        assert hits, proc.stderr[-2000:]
+        assert hits[0].count("faultinject.py:") >= 2, hits[0]
+        assert "lock-order-invert-1" in hits[0], hits[0]
+        assert "lock-order-invert-2" in hits[0], hits[0]
+        # stdout purity: the sweep parses the last stdout JSON line
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert doc["completed"] == 2, doc
+
+    def test_racy_schedule_leg_is_clean_and_audited(self):
+        """The jitter failpoint (default chaos-sweep set) is timing-
+        only: the audited ``serve check`` completes every job, exits 0,
+        and reports no CONTRACT005."""
+        import json
+
+        proc = self._run(["check", "--jobs", "2", "--wait-ms", "20"],
+                         {"PINT_TPU_FAULTS": "racy_schedule"},
+                         module="pint_tpu.serve")
+        assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+        assert "CONTRACT005" not in proc.stderr
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert doc["completed"] == 2, doc
+
+
 class TestAotColdStart:
     """The REAL two-process cold-start proof (ISSUE 7 acceptance):
     process A prebuilds the AOT store (``python -m pint_tpu.aot warm``
